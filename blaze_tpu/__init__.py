@@ -1,0 +1,33 @@
+"""blaze-tpu: a TPU-native Spark SQL acceleration framework.
+
+A brand-new framework with the capabilities of the Blaze Spark accelerator
+(reference: /root/reference, a Rust/DataFusion CPU engine): it accepts a
+serialized physical-plan tree per Spark task partition and executes it on
+columnar data — but the engine here is jax/XLA on TPU. Columnar batches are
+device arrays with static (bucketed) shapes, operators are fused into
+`jax.jit`-compiled pipelines, hash tables are replaced by sort-based
+algorithms (grouping, joins), and the shuffle partitioning step can run as
+collectives over a TPU ICI mesh.
+
+Layer map (mirrors SURVEY.md §1, re-designed TPU-first):
+  - plan/       plan contract (protobuf + in-memory IR) — ref: blaze-serde
+  - exprs/      expression compiler pb-expr -> jax        — ref: datafusion-ext-exprs
+  - columnar/   device batch model + Arrow interop        — ref: arrow-rs usage
+  - ops/        physical operators                        — ref: datafusion-ext-plans
+  - parallel/   device-mesh collectives (ICI shuffle)     — (TPU-native, no ref analog)
+  - runtime/    per-task executor, memory, metrics, jit   — ref: blaze/src/rt.rs
+  - native/     C++ layer: wire serde, JNI bridge         — ref: blaze-jni-bridge
+  - spark/      Spark-side planner logic                  — ref: spark-extension
+"""
+
+__version__ = "0.1.0"
+
+# Spark semantics need real int64/float64 columns; jax disables 64-bit by
+# default. Must run before any jax array is created anywhere in the package.
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from blaze_tpu.config import BlazeConf, conf
+
+__all__ = ["BlazeConf", "conf", "__version__"]

@@ -1,0 +1,27 @@
+from blaze_tpu.columnar.types import (
+    DataType,
+    TypeKind,
+    BOOLEAN,
+    INT8,
+    INT16,
+    INT32,
+    INT64,
+    FLOAT32,
+    FLOAT64,
+    STRING,
+    BINARY,
+    DATE,
+    TIMESTAMP,
+    NULL,
+    decimal,
+    Field,
+    Schema,
+)
+from blaze_tpu.columnar.batch import Column, StringData, ColumnBatch, bucket_capacity, bucket_width
+
+__all__ = [
+    "DataType", "TypeKind", "BOOLEAN", "INT8", "INT16", "INT32", "INT64",
+    "FLOAT32", "FLOAT64", "STRING", "BINARY", "DATE", "TIMESTAMP", "NULL",
+    "decimal", "Field", "Schema", "Column", "StringData", "ColumnBatch",
+    "bucket_capacity", "bucket_width",
+]

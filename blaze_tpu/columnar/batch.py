@@ -1,0 +1,294 @@
+"""Device-resident columnar batches with static (bucketed) shapes.
+
+This is the engine's unit of data flow — the TPU-native replacement for the
+reference's Arrow `RecordBatch` streaming (every operator there is a stream of
+RecordBatches re-chunked by CoalesceStream, streams/coalesce_stream.rs). XLA
+wants static shapes, so a batch here is:
+
+  * a static `capacity` (bucketed power of two — the jit-cache key),
+  * a traced `num_rows` scalar: rows [0, num_rows) are live, the rest padding,
+  * one `Column` per field: dense device array + optional validity mask;
+    strings/binary are fixed-width uint8 matrices (capacity, W) + lengths,
+    with W bucketed as well.
+
+Invariants ops may rely on:
+  * invalid slots among LIVE rows contain the dtype's zero (see
+    `Column.normalized`), so hashing/sorting null slots is deterministic;
+  * padding rows (>= num_rows) have UNSPECIFIED content — any op that
+    reduces, hashes, sorts, or serializes full-capacity arrays MUST mask
+    with `row_mask()` first;
+  * `validity is None` means all live rows valid.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from blaze_tpu.config import conf
+from blaze_tpu.columnar.types import DataType, Field, Schema, TypeKind
+
+Array = jax.Array
+
+
+def bucket_capacity(n: int) -> int:
+    """Round row count up to a power-of-two capacity bucket."""
+    cap = max(int(conf.min_capacity), 1)
+    while cap < n:
+        cap <<= 1
+    return cap
+
+
+def bucket_width(w: int) -> int:
+    """Round string byte-width up to a power-of-two bucket (min 4).
+
+    Raises beyond conf.max_string_width — a single huge value would otherwise
+    inflate the whole (capacity, width) matrix; such columns must take a host
+    fallback path instead.
+    """
+    if w > conf.max_string_width:
+        raise ValueError(
+            f"string width {w} exceeds max_string_width={conf.max_string_width}")
+    b = max(int(conf.min_string_width), 4)
+    while b < w:
+        b <<= 1
+    return b
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class StringData:
+    """Fixed-width string/binary storage: (capacity, width) uint8 + lengths."""
+
+    bytes: Array    # uint8 (capacity, width)
+    lengths: Array  # int32 (capacity,)
+
+    @property
+    def capacity(self) -> int:
+        return self.bytes.shape[0]
+
+    @property
+    def width(self) -> int:
+        return self.bytes.shape[1]
+
+    def tree_flatten(self):
+        return (self.bytes, self.lengths), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class Column:
+    dtype: DataType
+    data: Union[Array, StringData]
+    validity: Optional[Array] = None  # bool (capacity,); None = all valid
+
+    @property
+    def capacity(self) -> int:
+        return self.data.capacity if isinstance(self.data, StringData) else self.data.shape[0]
+
+    @property
+    def is_string(self) -> bool:
+        return isinstance(self.data, StringData)
+
+    def valid_mask(self) -> Array:
+        if self.validity is None:
+            return jnp.ones((self.capacity,), dtype=jnp.bool_)
+        return self.validity
+
+    def normalized(self) -> "Column":
+        """Zero out data in invalid slots (canonical form for hash/sort/serde)."""
+        if self.validity is None:
+            return self
+        if self.is_string:
+            v = self.validity
+            b = jnp.where(v[:, None], self.data.bytes, jnp.uint8(0))
+            l = jnp.where(v, self.data.lengths, jnp.int32(0))
+            return Column(self.dtype, StringData(b, l), v)
+        zero = jnp.zeros((), dtype=self.data.dtype)
+        return Column(self.dtype, jnp.where(self.validity, self.data, zero), self.validity)
+
+    def take(self, indices: Array, *, index_valid: Optional[Array] = None) -> "Column":
+        """Gather rows by index. `index_valid=False` slots become null."""
+        idx = jnp.clip(indices, 0, self.capacity - 1)
+        v = self.validity
+        if self.is_string:
+            data = StringData(self.data.bytes[idx], self.data.lengths[idx])
+        else:
+            data = self.data[idx]
+        v = v[idx] if v is not None else None
+        if index_valid is not None:
+            v = index_valid if v is None else (v & index_valid)
+        return Column(self.dtype, data, v)
+
+    def tree_flatten(self):
+        return (self.data, self.validity), self.dtype
+
+    @classmethod
+    def tree_unflatten(cls, dtype, children):
+        data, validity = children
+        return cls(dtype, data, validity)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class ColumnBatch:
+    schema: Schema
+    columns: List[Column]
+    num_rows: Array  # int32 scalar (traced)
+    capacity: int    # static
+
+    # ---- construction ----
+    @staticmethod
+    def make(schema: Schema, columns: Sequence[Column], num_rows) -> "ColumnBatch":
+        cap = columns[0].capacity if columns else bucket_capacity(0)
+        return ColumnBatch(schema, list(columns), jnp.asarray(num_rows, jnp.int32), cap)
+
+    @staticmethod
+    def empty(schema: Schema, capacity: Optional[int] = None) -> "ColumnBatch":
+        cap = capacity or bucket_capacity(0)
+        cols = [_zero_column(f.dtype, cap) for f in schema]
+        return ColumnBatch(schema, cols, jnp.asarray(0, jnp.int32), cap)
+
+    @staticmethod
+    def from_numpy(data: Dict[str, np.ndarray], schema: Schema,
+                   capacity: Optional[int] = None,
+                   validity: Optional[Dict[str, np.ndarray]] = None) -> "ColumnBatch":
+        """Test/ingest helper: numpy (or list-of-str) per field -> device batch."""
+        n = len(next(iter(data.values()))) if data else 0
+        cap = capacity or bucket_capacity(n)
+        cols = []
+        for f in schema:
+            raw = data[f.name]
+            v_np = None if validity is None else validity.get(f.name)
+            cols.append(_host_to_column(f.dtype, raw, cap, v_np))
+        return ColumnBatch(schema, cols, jnp.asarray(n, jnp.int32), cap)
+
+    # ---- views ----
+    def column(self, i: int) -> Column:
+        return self.columns[i]
+
+    def by_name(self, name: str) -> Column:
+        return self.columns[self.schema.index_of(name)]
+
+    def row_mask(self) -> Array:
+        return jnp.arange(self.capacity, dtype=jnp.int32) < self.num_rows
+
+    def live_valid(self, i: int) -> Array:
+        """validity AND row-liveness for column i."""
+        return self.columns[i].valid_mask() & self.row_mask()
+
+    # ---- transforms ----
+    def with_columns(self, schema: Schema, columns: Sequence[Column]) -> "ColumnBatch":
+        return ColumnBatch(schema, list(columns), self.num_rows, self.capacity)
+
+    def with_num_rows(self, num_rows) -> "ColumnBatch":
+        return ColumnBatch(self.schema, self.columns, jnp.asarray(num_rows, jnp.int32), self.capacity)
+
+    def select(self, indices: Sequence[int]) -> "ColumnBatch":
+        fields = [self.schema.fields[i] for i in indices]
+        cols = [self.columns[i] for i in indices]
+        return ColumnBatch(Schema(fields), cols, self.num_rows, self.capacity)
+
+    def take(self, indices: Array, num_rows, *, index_valid: Optional[Array] = None) -> "ColumnBatch":
+        cols = [c.take(indices, index_valid=index_valid) for c in self.columns]
+        cap = int(indices.shape[0])
+        return ColumnBatch(self.schema, cols, jnp.asarray(num_rows, jnp.int32), cap)
+
+    def compact(self, keep: Array) -> "ColumnBatch":
+        """Filter: keep rows where `keep & row_mask`, compacted to the front.
+
+        Static-shape: uses size-bounded nonzero + gather; output capacity equals
+        input capacity (a later coalesce can re-bucket downward).
+        """
+        mask = keep & self.row_mask()
+        n = jnp.sum(mask, dtype=jnp.int32)
+        (idx,) = jnp.nonzero(mask, size=self.capacity, fill_value=0)
+        out = self.take(idx, n)
+        return out
+
+    def normalized(self) -> "ColumnBatch":
+        return self.with_columns(self.schema, [c.normalized() for c in self.columns])
+
+    # ---- host export (tests / serde) ----
+    def to_numpy(self) -> Dict[str, object]:
+        """Pull live rows to host. Strings -> list[bytes|None]; numerics ->
+        numpy masked to live rows with None for nulls (object arrays)."""
+        n = int(self.num_rows)
+        out: Dict[str, object] = {}
+        for f, c in zip(self.schema, self.columns):
+            valid = np.asarray(c.valid_mask())[:n]
+            if c.is_string:
+                b = np.asarray(c.data.bytes)[:n]
+                l = np.asarray(c.data.lengths)[:n]
+                vals = [bytes(b[i, : l[i]]) if valid[i] else None for i in range(n)]
+                out[f.name] = vals
+            else:
+                d = np.asarray(c.data)[:n]
+                if valid.all():
+                    out[f.name] = d
+                else:
+                    o = d.astype(object)
+                    o[~valid] = None
+                    out[f.name] = o
+        return out
+
+    def tree_flatten(self):
+        return (self.columns, self.num_rows), (self.schema, self.capacity)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        schema, capacity = aux
+        columns, num_rows = children
+        return cls(schema, list(columns), num_rows, capacity)
+
+
+def _zero_column(dtype: DataType, cap: int) -> Column:
+    if dtype.is_string_like:
+        w = bucket_width(1)
+        return Column(dtype, StringData(jnp.zeros((cap, w), jnp.uint8),
+                                        jnp.zeros((cap,), jnp.int32)), None)
+    if dtype.kind == TypeKind.NULL:
+        return Column(dtype, jnp.zeros((cap,), jnp.int8), jnp.zeros((cap,), jnp.bool_))
+    return Column(dtype, jnp.zeros((cap,), dtype.jnp_dtype()), None)
+
+
+def _host_to_column(dtype: DataType, raw, cap: int, validity_np: Optional[np.ndarray]) -> Column:
+    if dtype.is_string_like:
+        vals = [v if v is not None else b"" for v in raw]
+        vals = [v.encode() if isinstance(v, str) else bytes(v) for v in vals]
+        if validity_np is None and any(v is None for v in raw):
+            validity_np = np.array([v is not None for v in raw], bool)
+        n = len(vals)
+        w = bucket_width(max((len(v) for v in vals), default=1) or 1)
+        mat = np.zeros((cap, w), np.uint8)
+        lens = np.zeros((cap,), np.int32)
+        for i, v in enumerate(vals):
+            mat[i, : len(v)] = np.frombuffer(v, np.uint8)
+            lens[i] = len(v)
+        col = Column(dtype, StringData(jnp.asarray(mat), jnp.asarray(lens)), _pad_validity(validity_np, n, cap))
+        return col.normalized()
+    arr = np.asarray(raw)
+    n = arr.shape[0]
+    if validity_np is None and arr.dtype == object:
+        validity_np = np.array([v is not None for v in arr], bool)
+        arr = np.array([v if v is not None else 0 for v in arr])
+    out = np.zeros((cap,), dtype.np_dtype())
+    out[:n] = arr.astype(dtype.np_dtype())
+    col = Column(dtype, jnp.asarray(out), _pad_validity(validity_np, n, cap))
+    return col.normalized()
+
+
+def _pad_validity(validity_np: Optional[np.ndarray], n: int, cap: int) -> Optional[Array]:
+    if validity_np is None:
+        return None
+    v = np.zeros((cap,), bool)
+    v[:n] = np.asarray(validity_np, bool)[:n]
+    return jnp.asarray(v)

@@ -1,0 +1,58 @@
+"""Config/flag system — three tiers like the reference (SURVEY.md §5.6).
+
+Ref: spark-extension BlazeConf.java (batchSize/memoryFraction/... read lazily
+from native over JNI). Here the native side IS this process, so the conf is a
+plain singleton the JVM bridge (or tests) can populate; defaults mirror the
+reference's (BlazeConf.java:23-70) where semantics carry over, with
+TPU-specific knobs added.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict
+
+
+@dataclasses.dataclass
+class BlazeConf:
+    # -- reference-equivalent knobs (BlazeConf.java) --
+    batch_size: int = 8192  # ref default 10000; 8192 is TPU/XLA tile friendly
+    memory_fraction: float = 0.6
+    enable_smj_inequality_join: bool = False
+    enable_bhj_fallbacks_to_smj: bool = True
+    bhj_fallback_rows_threshold: int = 1_000_000
+    bhj_fallback_mem_threshold: int = 128 << 20
+    enable_caseconvert_functions: bool = False
+    udf_wrapper_num_threads: int = 1
+    enable_input_batch_statistics: bool = False
+    ignore_corrupt_files: bool = False
+
+    # -- TPU-native knobs --
+    # capacity buckets are powers of two: jit cache is keyed on (plan, capacity,
+    # string-width) so padding to buckets bounds the number of compilations.
+    min_capacity: int = 1024
+    # string columns are fixed-width uint8 matrices; width is bucketed too.
+    min_string_width: int = 4
+    max_string_width: int = 4096
+    # HBM budget for MemManager (bytes); 0 = derive from device memory stats.
+    memory_budget: int = 0
+    # spill directory for host spill files
+    spill_dir: str = os.environ.get("BLAZE_TPU_SPILL_DIR", "/tmp/blaze_tpu_spill")
+    # zstd level for shuffle/spill/broadcast frames (ref uses level 1)
+    zstd_level: int = 1
+    # per-operator enable flags (tier b, spark.blaze.enable.<op>)
+    enable_ops: Dict[str, bool] = dataclasses.field(default_factory=dict)
+
+    def op_enabled(self, op: str) -> bool:
+        return self.enable_ops.get(op, True)
+
+    def update(self, **kwargs: Any) -> "BlazeConf":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise KeyError(f"unknown conf key: {k}")
+            setattr(self, k, v)
+        return self
+
+
+conf = BlazeConf()

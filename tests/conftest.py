@@ -1,0 +1,25 @@
+"""Test harness: force an 8-virtual-device CPU platform before jax loads.
+
+Mirrors the reference's JNI-free unit-test strategy (SURVEY.md §4: operators
+run with MemoryExec fakes and tempfile spills, no JVM): here operators run on
+a virtual 8-device CPU mesh, no TPU required. Bench and the driver's
+compile-check run on real hardware separately.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,89 @@
+"""Columnar batch model + Arrow interop tests.
+
+Ref test analog: arrow round-trips exercised implicitly by batch_serde tests
+(datafusion-ext-commons io/batch_serde.rs roundtrip pattern).
+"""
+
+from decimal import Decimal
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from blaze_tpu.columnar import (
+    ColumnBatch, Schema, Field, INT32, INT64, FLOAT64, STRING, BOOLEAN, decimal,
+)
+from blaze_tpu.columnar.arrow_io import batch_from_arrow, batch_to_arrow
+
+
+def test_from_numpy_roundtrip():
+    schema = Schema([Field("a", INT32), Field("b", FLOAT64), Field("s", STRING)])
+    batch = ColumnBatch.from_numpy(
+        {"a": np.array([1, 2, 3]), "b": np.array([1.5, -2.5, 0.0]),
+         "s": ["foo", "barbaz", ""]},
+        schema,
+    )
+    assert batch.capacity >= 3
+    out = batch.to_numpy()
+    np.testing.assert_array_equal(out["a"], [1, 2, 3])
+    np.testing.assert_allclose(out["b"], [1.5, -2.5, 0.0])
+    assert out["s"] == [b"foo", b"barbaz", b""]
+
+
+def test_nulls_normalized():
+    schema = Schema([Field("a", INT64)])
+    batch = ColumnBatch.from_numpy(
+        {"a": np.array([10, 99, 30])}, schema,
+        validity={"a": np.array([True, False, True])},
+    )
+    col = batch.columns[0]
+    # invalid slots are zeroed (canonical form)
+    assert np.asarray(col.data)[1] == 0
+    out = batch.to_numpy()
+    assert list(out["a"]) == [10, None, 30]
+
+
+def test_compact():
+    schema = Schema([Field("a", INT32), Field("s", STRING)])
+    batch = ColumnBatch.from_numpy(
+        {"a": np.arange(10, dtype=np.int32), "s": [f"r{i}" for i in range(10)]}, schema)
+    keep = np.asarray(np.arange(batch.capacity) % 2 == 0)
+    import jax.numpy as jnp
+
+    out = batch.compact(jnp.asarray(keep))
+    r = out.to_numpy()
+    np.testing.assert_array_equal(r["a"], [0, 2, 4, 6, 8])
+    assert r["s"] == [b"r0", b"r2", b"r4", b"r6", b"r8"]
+
+
+def test_arrow_roundtrip():
+    rb = pa.record_batch({
+        "i": pa.array([1, None, 3], pa.int32()),
+        "l": pa.array([10**12, 2, None], pa.int64()),
+        "f": pa.array([1.25, None, -3.5], pa.float64()),
+        "s": pa.array(["hello", None, "x" * 33], pa.string()),
+        "b": pa.array([True, False, None], pa.bool_()),
+        "d": pa.array([None, Decimal("123.45"), Decimal("-0.01")], pa.decimal128(10, 2)),
+    })
+    batch = batch_from_arrow(rb)
+    assert int(batch.num_rows) == 3
+    back = batch_to_arrow(batch)
+    assert back.column(0).to_pylist() == [1, None, 3]
+    assert back.column(1).to_pylist() == [10**12, 2, None]
+    assert back.column(2).to_pylist() == [1.25, None, -3.5]
+    assert back.column(3).to_pylist() == ["hello", None, "x" * 33]
+    assert back.column(4).to_pylist() == [True, False, None]
+    assert [str(v) if v is not None else None for v in back.column(5).to_pylist()] == [
+        None, "123.45", "-0.01"]
+
+
+def test_take_with_index_valid():
+    import jax.numpy as jnp
+
+    schema = Schema([Field("a", INT32)])
+    batch = ColumnBatch.from_numpy({"a": np.array([5, 6, 7])}, schema)
+    idx = jnp.asarray(np.zeros(batch.capacity, np.int32))
+    iv = jnp.asarray(np.array([True, False] + [False] * (batch.capacity - 2)))
+    out = batch.take(idx, 2, index_valid=iv)
+    r = out.to_numpy()
+    assert list(r["a"]) == [5, None]
