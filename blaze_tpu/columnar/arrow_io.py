@@ -138,13 +138,18 @@ def column_from_arrow(arr, dtype: T.DataType, cap: int) -> Column:
                      _pad_validity(validity, n, cap))
         return col.normalized()
     if dtype.kind == T.TypeKind.NULL:
-        return Column(dtype, jnp.zeros((cap,), jnp.int8), jnp.zeros((cap,), jnp.bool_))
+        from blaze_tpu.columnar.batch import _zero_column
+
+        return _zero_column(dtype, cap)
     if dtype.is_decimal:
         if dtype.wide_decimal:
             raise TypeError(f"decimal precision {dtype.precision} > 18 not device-native")
-        np_vals = np.array([int(v.scaleb(dtype.scale)) if v is not None else 0 for v in
-                            arr.cast(pa.decimal128(dtype.precision, dtype.scale)).to_pylist()],
-                           np.int64)
+        d = arr.cast(pa.decimal128(dtype.precision, dtype.scale)).fill_null(0)
+        # decimal128 buffer = 16-byte LE two's complement; p<=18 fits in the
+        # low 8 bytes, so the low int64 word IS the unscaled value
+        buf = d.buffers()[1]
+        np_vals = np.frombuffer(buf, np.int64, count=2 * n,
+                                offset=d.offset * 16)[0::2].copy()
     elif dtype.kind == T.TypeKind.TIMESTAMP:
         np_vals = np.asarray(arr.cast(pa.timestamp("us")).fill_null(0), np.int64)
     elif dtype.kind == T.TypeKind.BOOLEAN:

@@ -50,12 +50,13 @@ def bucket_width(w: int) -> int:
     inflate the whole (capacity, width) matrix; such columns must take a host
     fallback path instead.
     """
-    if w > conf.max_string_width:
-        raise ValueError(
-            f"string width {w} exceeds max_string_width={conf.max_string_width}")
     b = max(int(conf.min_string_width), 4)
     while b < w:
         b <<= 1
+    if b > conf.max_string_width:
+        raise ValueError(
+            f"string width {w} (bucket {b}) exceeds max_string_width="
+            f"{conf.max_string_width}")
     return b
 
 
@@ -198,6 +199,8 @@ class ColumnBatch:
         return ColumnBatch(Schema(fields), cols, self.num_rows, self.capacity)
 
     def take(self, indices: Array, num_rows, *, index_valid: Optional[Array] = None) -> "ColumnBatch":
+        # output capacity = len(indices): callers must pass bucket-sized index
+        # arrays (compact/sort/join all do) to preserve the jit-cache invariant
         cols = [c.take(indices, index_valid=index_valid) for c in self.columns]
         cap = int(indices.shape[0])
         return ColumnBatch(self.schema, cols, jnp.asarray(num_rows, jnp.int32), cap)

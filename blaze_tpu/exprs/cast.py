@@ -1,0 +1,458 @@
+"""Spark cast semantics on device (TryCast: invalid -> null, ANSI off).
+
+Ref: datafusion-ext-exprs/src/cast.rs (TryCastExpr) and
+datafusion-ext-commons/src/cast.rs (spark-specific rules: float->int
+saturation, string parsing, decimal rescale with HALF_UP). Implemented as
+dense jax ops over fixed-width columns; string parsing runs on device over
+the byte matrix (no host round-trip).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from blaze_tpu.columnar.batch import Column, StringData, bucket_width
+from blaze_tpu.columnar.types import DataType, TypeKind
+
+Array = jax.Array
+
+_INT_BOUNDS = {
+    TypeKind.INT8: (-(2**7), 2**7 - 1),
+    TypeKind.INT16: (-(2**15), 2**15 - 1),
+    TypeKind.INT32: (-(2**31), 2**31 - 1),
+    TypeKind.INT64: (-(2**63), 2**63 - 1),
+}
+
+
+def cast_column(col: Column, target: DataType) -> Column:
+    src = col.dtype
+    if src == target:
+        return col
+    if src.is_string_like and target.is_string_like:
+        return Column(target, col.data, col.validity)
+
+    if src.is_string_like:
+        return _from_string(col, target)
+    if target.is_string_like:
+        return _to_string(col, target)
+
+    k, tk = src.kind, target.kind
+    valid = col.validity
+    data = col.data
+
+    if k == TypeKind.NULL:
+        from blaze_tpu.columnar.batch import _zero_column
+
+        z = _zero_column(target, col.capacity)
+        return Column(target, z.data, jnp.zeros((col.capacity,), jnp.bool_))
+
+    if k == TypeKind.BOOLEAN:
+        if target.is_integral or target.is_floating:
+            return Column(target, data.astype(target.jnp_dtype()), valid)
+        if target.is_decimal:
+            return _int_to_decimal(data.astype(jnp.int64), valid, target)
+    if tk == TypeKind.BOOLEAN:
+        if src.is_numeric and not src.is_decimal:
+            return Column(target, data != 0, valid)
+        if src.is_decimal:
+            return Column(target, data != 0, valid)
+
+    # date/timestamp as their underlying ints
+    if k == TypeKind.DATE and target.is_integral:
+        return _int_to_int(data, valid, src, target)
+    if src.is_integral and tk == TypeKind.DATE:
+        return _int_to_int(data, valid, src, target)
+    if k == TypeKind.TIMESTAMP and (target.is_integral or target.is_floating):
+        # spark: timestamp -> long = seconds; -> double = fractional seconds
+        if target.is_integral:
+            secs = jnp.floor_divide(data, 1_000_000)
+            return _int_to_int(secs, valid, DataType(TypeKind.INT64), target)
+        return Column(target, data.astype(jnp.float64) / 1e6, valid)
+    if src.is_integral and tk == TypeKind.TIMESTAMP:
+        return Column(target, data.astype(jnp.int64) * 1_000_000, valid)
+    if k == TypeKind.DATE and tk == TypeKind.TIMESTAMP:
+        return Column(target, data.astype(jnp.int64) * 86_400_000_000, valid)
+    if k == TypeKind.TIMESTAMP and tk == TypeKind.DATE:
+        return Column(target, jnp.floor_divide(data, 86_400_000_000).astype(jnp.int32), valid)
+
+    if src.is_integral:
+        if target.is_integral:
+            return _int_to_int(data, valid, src, target)
+        if target.is_floating:
+            return Column(target, data.astype(target.jnp_dtype()), valid)
+        if target.is_decimal:
+            return _int_to_decimal(data.astype(jnp.int64), valid, target)
+    if src.is_floating:
+        if target.is_floating:
+            return Column(target, data.astype(target.jnp_dtype()), valid)
+        if target.is_integral:
+            return _float_to_int(data, valid, target)
+        if target.is_decimal:
+            return _float_to_decimal(data, valid, target)
+    if src.is_decimal:
+        scale_div = 10 ** src.scale
+        if target.is_floating:
+            return Column(target, data.astype(jnp.float64) / scale_div, valid)
+        if target.is_integral:
+            trunc = jnp.sign(data) * (jnp.abs(data) // scale_div)  # toward zero
+            return _int_to_int(trunc, valid, DataType(TypeKind.INT64), target)
+        if target.is_decimal:
+            return _decimal_rescale(data, valid, src, target)
+
+    raise TypeError(f"unsupported cast {src} -> {target}")
+
+
+# ---- numeric helpers ----
+
+def _int_to_int(data: Array, valid, src: DataType, target: DataType) -> Column:
+    # Java narrowing semantics: wrap (two's complement truncation)
+    return Column(target, data.astype(target.jnp_dtype()), valid)
+
+
+def _float_to_int(data: Array, valid, target: DataType) -> Column:
+    lo, hi = _INT_BOUNDS[target.kind if target.kind in _INT_BOUNDS else TypeKind.INT64]
+    # saturate; NaN -> 0 (spark semantics, ext-commons cast.rs)
+    clamped = jnp.clip(data, lo, hi)
+    out = jnp.where(jnp.isnan(data), 0, clamped).astype(target.jnp_dtype())
+    return Column(target, out, valid)
+
+
+def _int_to_decimal(data: Array, valid, target: DataType) -> Column:
+    mul = 10 ** target.scale
+    out = data * mul
+    bound = 10 ** target.precision
+    overflow = (jnp.abs(out) >= bound) | (data != out // mul)  # mul overflow
+    return Column(target, jnp.where(overflow, 0, out), _and_valid(valid, ~overflow))
+
+
+def _float_to_decimal(data: Array, valid, target: DataType) -> Column:
+    scaled = data.astype(jnp.float64) * (10.0 ** target.scale)
+    # HALF_UP
+    rounded = jnp.where(scaled >= 0, jnp.floor(scaled + 0.5), jnp.ceil(scaled - 0.5))
+    bound = float(10 ** target.precision)
+    bad = jnp.isnan(scaled) | (jnp.abs(rounded) >= bound)
+    out = jnp.where(bad, 0.0, rounded).astype(jnp.int64)
+    return Column(target, out, _and_valid(valid, ~bad))
+
+
+def _decimal_rescale(data: Array, valid, src: DataType, target: DataType) -> Column:
+    ds = target.scale - src.scale
+    if ds >= 0:
+        out = data * (10 ** ds)
+        ok = (out // (10 ** ds)) == data if ds > 0 else jnp.ones_like(data, jnp.bool_)
+    else:
+        div = 10 ** (-ds)
+        q = jnp.abs(data) // div
+        r = jnp.abs(data) % div
+        q = q + jnp.where(2 * r >= div, 1, 0)  # HALF_UP on magnitude
+        out = jnp.sign(data) * q
+        ok = jnp.ones_like(data, jnp.bool_)
+    bound = 10 ** min(target.precision, 18)
+    ok = ok & (jnp.abs(out) < bound)
+    return Column(target, jnp.where(ok, out, 0), _and_valid(valid, ok))
+
+
+def check_overflow(col: Column, precision: int, scale: int) -> Column:
+    """Ref proto CheckOverflow: null out values exceeding precision."""
+    bound = 10 ** min(precision, 18)
+    ok = jnp.abs(col.data) < bound
+    return Column(DataType(TypeKind.DECIMAL, precision=precision, scale=scale),
+                  jnp.where(ok, col.data, 0), _and_valid(col.validity, ok))
+
+
+def _and_valid(valid, extra):
+    return extra if valid is None else (valid & extra)
+
+
+# ---- string parsing (device) ----
+
+def _trimmed(s: StringData):
+    """start index and length after trimming ASCII spaces."""
+    j = jnp.arange(s.width, dtype=jnp.int32)
+    in_len = j[None, :] < s.lengths[:, None]
+    nonspace = in_len & (s.bytes != 0x20)
+    any_ns = jnp.any(nonspace, axis=1)
+    first = jnp.argmax(nonspace, axis=1).astype(jnp.int32)
+    last = (s.width - 1 - jnp.argmax(nonspace[:, ::-1], axis=1)).astype(jnp.int32)
+    start = jnp.where(any_ns, first, 0)
+    length = jnp.where(any_ns, last + 1 - first, 0)
+    return start, length
+
+
+def _parse_int64(s: StringData):
+    """(value, ok): optional sign + digits; overflow or junk -> not ok."""
+    start, length = _trimmed(s)
+    j = jnp.arange(s.width, dtype=jnp.int32)
+    idx = jnp.clip(start[:, None] + j[None, :], 0, s.width - 1)
+    b = jnp.take_along_axis(s.bytes, idx, axis=1)
+    first = b[:, 0]
+    neg = first == 0x2D
+    has_sign = neg | (first == 0x2B)
+    ndigits = length - has_sign.astype(jnp.int32)
+
+    acc = jnp.zeros((s.capacity,), jnp.int64)
+    ok = (ndigits > 0) & (ndigits <= 19)
+    overflow = jnp.zeros((s.capacity,), jnp.bool_)
+    for pos in range(min(s.width, 20)):
+        p = pos + has_sign.astype(jnp.int32)
+        c = jnp.take_along_axis(b, jnp.clip(p, 0, s.width - 1)[:, None], axis=1)[:, 0]
+        in_num = pos < ndigits
+        is_digit = (c >= 0x30) & (c <= 0x39)
+        ok = ok & (~in_num | is_digit)
+        new_acc = acc * 10 + jnp.where(in_num, (c - 0x30).astype(jnp.int64), 0)
+        overflow = overflow | (in_num & (new_acc < acc) & (acc > 0))
+        acc = jnp.where(in_num, new_acc, acc)
+    # values longer than width can't be digits-complete
+    ok = ok & (ndigits <= s.width) & ~overflow
+    val = jnp.where(neg, -acc, acc)
+    return val, ok
+
+
+def _parse_float64(s: StringData):
+    """(value, ok): [+-]digits[.digits][eE[+-]digits]."""
+    start, length = _trimmed(s)
+    j = jnp.arange(s.width, dtype=jnp.int32)
+    idx = jnp.clip(start[:, None] + j[None, :], 0, s.width - 1)
+    b = jnp.take_along_axis(s.bytes, idx, axis=1)
+    in_len = j[None, :] < length[:, None]
+
+    is_digit = (b >= 0x30) & (b <= 0x39) & in_len
+    is_dot = (b == 0x2E) & in_len
+    is_e = ((b == 0x65) | (b == 0x45)) & in_len
+    is_sign = ((b == 0x2B) | (b == 0x2D)) & in_len
+
+    # locate 'e' (first occurrence) and '.' before e
+    has_e = jnp.any(is_e, axis=1)
+    e_pos = jnp.where(has_e, jnp.argmax(is_e, axis=1).astype(jnp.int32), length)
+    before_e = j[None, :] < e_pos[:, None]
+    dot_in_mant = is_dot & before_e
+    has_dot = jnp.any(dot_in_mant, axis=1)
+    dot_pos = jnp.where(has_dot, jnp.argmax(dot_in_mant, axis=1).astype(jnp.int32), e_pos)
+
+    neg = (b[:, 0] == 0x2D) & in_len[:, 0]
+    msign = ((b[:, 0] == 0x2B) | (b[:, 0] == 0x2D)) & in_len[:, 0]
+    mstart = msign.astype(jnp.int32)
+
+    # mantissa digits: positions in [mstart, e_pos) except dot_pos
+    mant = jnp.zeros((s.capacity,), jnp.float64)
+    frac_digits = jnp.zeros((s.capacity,), jnp.int32)
+    valid_chars = jnp.ones((s.capacity,), jnp.bool_)
+    for pos in range(s.width):
+        here = (pos >= mstart) & (pos < e_pos) & in_len[:, pos]
+        d = here & is_digit[:, pos]
+        dot_here = here & (pos == dot_pos) & has_dot
+        valid_chars = valid_chars & (~here | d | dot_here)
+        mant = jnp.where(d, mant * 10 + (b[:, pos] - 0x30).astype(jnp.float64), mant)
+        frac_digits = frac_digits + jnp.where(d & (pos > dot_pos) & has_dot, 1, 0)
+    any_mant_digit = jnp.any(is_digit & (j[None, :] < e_pos[:, None]), axis=1)
+
+    # exponent
+    es_start = e_pos + 1
+    esign_b = jnp.take_along_axis(b, jnp.clip(es_start, 0, s.width - 1)[:, None], axis=1)[:, 0]
+    eneg = has_e & (esign_b == 0x2D)
+    e_has_sign = has_e & ((esign_b == 0x2B) | (esign_b == 0x2D))
+    ed_start = es_start + e_has_sign.astype(jnp.int32)
+    exp = jnp.zeros((s.capacity,), jnp.int32)
+    any_exp_digit = jnp.zeros((s.capacity,), jnp.bool_)
+    for pos in range(s.width):
+        here = has_e & (pos >= ed_start) & (pos < length) & in_len[:, pos]
+        d = here & is_digit[:, pos]
+        valid_chars = valid_chars & (~here | d)
+        exp = jnp.where(d, jnp.minimum(exp * 10 + (b[:, pos] - 0x30), 400), exp)
+        any_exp_digit = any_exp_digit | d
+    exp = jnp.where(eneg, -exp, exp).astype(jnp.float64)
+
+    ok = (length > 0) & valid_chars & any_mant_digit & (~has_e | any_exp_digit)
+    val = mant * jnp.power(10.0, exp - frac_digits.astype(jnp.float64))
+    val = jnp.where(neg, -val, val)
+    return val, ok
+
+
+def _from_string(col: Column, target: DataType) -> Column:
+    s: StringData = col.data
+    tk = target.kind
+    if target.is_integral or tk == TypeKind.DATE:
+        val, ok = _parse_int64(s)
+        if tk == TypeKind.DATE:
+            return _string_to_date(col)
+        lo, hi = _INT_BOUNDS[tk]
+        ok = ok & (val >= lo) & (val <= hi)
+        return Column(target, jnp.where(ok, val, 0).astype(target.jnp_dtype()),
+                      _and_valid(col.validity, ok))
+    if target.is_floating:
+        val, ok = _parse_float64(s)
+        return Column(target, jnp.where(ok, val, 0.0).astype(target.jnp_dtype()),
+                      _and_valid(col.validity, ok))
+    if target.is_decimal:
+        val, ok = _parse_float64(s)
+        c = _float_to_decimal(jnp.where(ok, val, 0.0), _and_valid(col.validity, ok), target)
+        return c
+    if tk == TypeKind.BOOLEAN:
+        from blaze_tpu.exprs import strings as S
+
+        low = S.lower_ascii(s)
+        truthy = jnp.zeros((col.capacity,), jnp.bool_)
+        falsy = jnp.zeros((col.capacity,), jnp.bool_)
+        for t in (b"true", b"t", b"yes", b"y", b"1"):
+            truthy = truthy | S.equals(low, _const_string(t, col.capacity, s.width))
+        for f in (b"false", b"f", b"no", b"n", b"0"):
+            falsy = falsy | S.equals(low, _const_string(f, col.capacity, s.width))
+        ok = truthy | falsy
+        return Column(target, truthy, _and_valid(col.validity, ok))
+    if tk == TypeKind.TIMESTAMP:
+        raise TypeError("string->timestamp not yet device-native")
+    raise TypeError(f"unsupported cast string -> {target}")
+
+
+def _string_to_date(col: Column) -> Column:
+    """Parse yyyy-[m]m-[d]d (also bare yyyy / yyyy-mm) -> days since epoch."""
+    s: StringData = col.data
+    start, length = _trimmed(s)
+    j = jnp.arange(s.width, dtype=jnp.int32)
+    idx = jnp.clip(start[:, None] + j[None, :], 0, s.width - 1)
+    b = jnp.take_along_axis(s.bytes, idx, axis=1)
+    in_len = j[None, :] < length[:, None]
+    is_digit = (b >= 0x30) & (b <= 0x39)
+    is_dash = (b == 0x2D)
+
+    # split on dashes into up to 3 numeric parts
+    part = jnp.cumsum(jnp.where(is_dash & in_len, 1, 0), axis=1)
+    part = jnp.concatenate([jnp.zeros((s.capacity, 1), part.dtype), part[:, :-1]], axis=1)
+    vals = jnp.zeros((s.capacity, 3), jnp.int32)
+    counts = jnp.zeros((s.capacity, 3), jnp.int32)
+    ok = jnp.ones((s.capacity,), jnp.bool_)
+    for pos in range(s.width):
+        here = in_len[:, pos]
+        p = jnp.clip(part[:, pos], 0, 2)
+        d = here & is_digit[:, pos]
+        dash = here & is_dash[:, pos]
+        ok = ok & (~here | d | dash) & (~here | (part[:, pos] <= 2))
+        onehot = jax.nn.one_hot(p, 3, dtype=jnp.int32)
+        digit = (b[:, pos] - 0x30).astype(jnp.int32)
+        vals = jnp.where(d[:, None],
+                         vals * jnp.where(onehot == 1, 10, 1) + onehot * digit[:, None],
+                         vals)
+        counts = counts + jnp.where(d[:, None], onehot, 0)
+    nparts = jnp.clip(jnp.max(jnp.where(in_len, part, 0), axis=1), 0, 2) + 1
+    year, month, day = vals[:, 0], vals[:, 1], vals[:, 2]
+    month = jnp.where(nparts >= 2, month, 1)
+    day = jnp.where(nparts >= 3, day, 1)
+    ok = ok & (length > 0) & (counts[:, 0] >= 1) & (counts[:, 0] <= 4)
+    ok = ok & ((nparts < 2) | (counts[:, 1] >= 1)) & ((nparts < 3) | (counts[:, 2] >= 1))
+    ok = ok & (month >= 1) & (month <= 12) & (day >= 1) & (day <= 31)
+    days = days_from_civil(year, month, day)
+    from blaze_tpu.columnar.types import DATE
+
+    return Column(DATE, jnp.where(ok, days, 0).astype(jnp.int32),
+                  _and_valid(col.validity, ok))
+
+
+def days_from_civil(y: Array, m: Array, d: Array) -> Array:
+    """Howard Hinnant's algorithm; vectorized integer math."""
+    y = y.astype(jnp.int64)
+    m = m.astype(jnp.int64)
+    d = d.astype(jnp.int64)
+    y = y - (m <= 2)
+    era = jnp.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400
+    mp = (m + 9) % 12
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return (era * 146097 + doe - 719468).astype(jnp.int32)
+
+
+def civil_from_days(z: Array):
+    z = z.astype(jnp.int64) + 719468
+    era = jnp.where(z >= 0, z, z - 146096) // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = mp + jnp.where(mp < 10, 3, -9)
+    y = y + (m <= 2)
+    return y.astype(jnp.int32), m.astype(jnp.int32), d.astype(jnp.int32)
+
+
+def _const_string(value: bytes, cap: int, min_width: int = 4) -> StringData:
+    import numpy as np
+
+    w = bucket_width(max(len(value), 1))
+    w = max(w, min_width if min_width % 4 == 0 else bucket_width(min_width))
+    mat = np.zeros((cap, w), np.uint8)
+    if value:
+        mat[:, : len(value)] = np.frombuffer(value, np.uint8)
+    lens = np.full((cap,), len(value), np.int32)
+    return StringData(jnp.asarray(mat), jnp.asarray(lens))
+
+
+# ---- number -> string (device digit formatting) ----
+
+def _int_to_string(data: Array, valid, capacity: int) -> Column:
+    """int64 -> decimal digits. Width 20 covers -9223372036854775808."""
+    from blaze_tpu.columnar.types import STRING
+
+    v = data.astype(jnp.int64)
+    neg = v < 0
+    # abs in unsigned space to handle INT64_MIN
+    mag = jnp.where(neg, (-(v + 1)).astype(jnp.uint64) + 1, v.astype(jnp.uint64))
+    W = 20
+    digits = []
+    rem = mag
+    for _ in range(W):
+        digits.append((rem % 10).astype(jnp.uint8))
+        rem = rem // 10
+    digit_mat = jnp.stack(digits[::-1], axis=1)  # most significant first
+    ndig = jnp.maximum(
+        W - jnp.argmax(digit_mat != 0, axis=1).astype(jnp.int32),
+        1)
+    ndig = jnp.where(mag == 0, 1, ndig)
+    total = ndig + neg.astype(jnp.int32)
+    w = bucket_width(W + 1)
+    j = jnp.arange(w, dtype=jnp.int32)
+    # output char j: '-' at 0 if neg; digit index = W - ndig + (j - neg)
+    src = W - ndig[:, None] + j[None, :] - neg.astype(jnp.int32)[:, None]
+    dig = jnp.take_along_axis(digit_mat, jnp.clip(src, 0, W - 1), axis=1) + 0x30
+    out = jnp.where(neg[:, None] & (j[None, :] == 0), jnp.uint8(0x2D), dig.astype(jnp.uint8))
+    mask = j[None, :] < total[:, None]
+    return Column(STRING, StringData(jnp.where(mask, out, jnp.uint8(0)), total), valid)
+
+
+def _to_string(col: Column, target: DataType) -> Column:
+    k = col.dtype.kind
+    if col.dtype.is_integral or k == TypeKind.BOOLEAN:
+        if k == TypeKind.BOOLEAN:
+            # spark: 'true' / 'false'
+            from blaze_tpu.exprs import strings as S
+
+            t = _const_string(b"true", col.capacity)
+            f = _const_string(b"false", col.capacity)
+            t, f = S.common_width(t, f)
+            bts = jnp.where(col.data[:, None], t.bytes, f.bytes)
+            lens = jnp.where(col.data, t.lengths, f.lengths)
+            return Column(target, StringData(bts, lens), col.validity)
+        return _int_to_string(col.data, col.validity, col.capacity)
+    if k == TypeKind.DATE:
+        return _date_to_string(col, target)
+    raise TypeError(f"cast {col.dtype} -> string not yet device-native")
+
+
+def _date_to_string(col: Column, target: DataType) -> Column:
+    y, m, d = civil_from_days(col.data)
+    w = bucket_width(10)
+    cap = col.capacity
+    chars = []
+    for div in (1000, 100, 10, 1):
+        chars.append((jnp.clip(y, 0, 9999) // div % 10 + 0x30).astype(jnp.uint8))
+    chars.append(jnp.full((cap,), 0x2D, jnp.uint8))
+    chars.append((m // 10 + 0x30).astype(jnp.uint8))
+    chars.append((m % 10 + 0x30).astype(jnp.uint8))
+    chars.append(jnp.full((cap,), 0x2D, jnp.uint8))
+    chars.append((d // 10 + 0x30).astype(jnp.uint8))
+    chars.append((d % 10 + 0x30).astype(jnp.uint8))
+    mat = jnp.stack(chars, axis=1)
+    pad = jnp.zeros((cap, w - 10), jnp.uint8)
+    return Column(target, StringData(jnp.concatenate([mat, pad], axis=1),
+                                     jnp.full((cap,), 10, jnp.int32)), col.validity)

@@ -1,0 +1,378 @@
+"""Scalar function registry — Spark-compatible kernels on device columns.
+
+Ref: the 64-entry ScalarFunction enum of the plan contract (blaze.proto:
+186-252) plus the spark-ext functions (datafusion-ext-functions lib.rs:28-53:
+NullIfZero, UnscaledValue, MakeDecimal, CheckOverflow, Murmur3Hash,
+StringSpace/Repeat/Split/Concat/ConcatWs/Lower/Upper, MakeArray, json fns).
+Math functions map 1:1 to jnp ops; string functions ride the fixed-width
+kernels in strings.py. Functions with no device story yet (regex, crypto
+digests, json) raise NotImplementedError at compile time so the planner can
+keep those subtrees on the JVM/fallback path — same degradation contract as
+the reference's tryConvert (BlazeConverters.scala:224-236).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+import jax.numpy as jnp
+
+from blaze_tpu.columnar.batch import Column, ColumnBatch, StringData
+from blaze_tpu.columnar.types import (
+    BOOLEAN, DataType, FLOAT64, INT32, INT64, STRING, TypeKind,
+)
+from blaze_tpu.exprs import ir
+from blaze_tpu.exprs import strings as S
+from blaze_tpu.exprs.cast import _and_valid, civil_from_days
+
+# fn(cols, batch, expr) -> Column
+FunctionImpl = Callable[[List[Column], ColumnBatch, ir.ScalarFn], Column]
+
+_REGISTRY: Dict[str, FunctionImpl] = {}
+
+
+def register(name: str):
+    def deco(fn):
+        _REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def compile_function(expr: ir.ScalarFn, schema):
+    from blaze_tpu.exprs.compiler import compile_expr
+
+    name = expr.name.lower()
+    if name not in _REGISTRY:
+        raise NotImplementedError(f"scalar function {expr.name} not supported on device")
+    impl = _REGISTRY[name]
+    arg_fns = [compile_expr(a, schema) for a in expr.args]
+    return lambda b: impl([f(b) for f in arg_fns], b, expr)
+
+
+def _strict(cols: List[Column]):
+    v = None
+    for c in cols:
+        if c.validity is not None:
+            v = c.validity if v is None else (v & c.validity)
+    return v
+
+
+def _math1(jnp_fn, domain=None, out_dtype: DataType = FLOAT64):
+    def impl(cols, batch, expr):
+        (c,) = cols
+        x = c.data.astype(jnp.float64)
+        valid = _strict(cols)
+        if domain is not None:
+            ok = domain(x)
+            x = jnp.where(ok, x, 1.0)
+            valid = _and_valid(valid, ok)
+        return Column(out_dtype, jnp_fn(x), valid)
+
+    return impl
+
+
+for _name, _fn, _dom in [
+    ("sqrt", jnp.sqrt, lambda x: x >= 0),
+    ("abs", jnp.abs, None),
+    ("exp", jnp.exp, None),
+    ("ln", jnp.log, lambda x: x > 0),
+    ("log", jnp.log, lambda x: x > 0),
+    ("log10", jnp.log10, lambda x: x > 0),
+    ("log2", jnp.log2, lambda x: x > 0),
+    ("sin", jnp.sin, None),
+    ("cos", jnp.cos, None),
+    ("tan", jnp.tan, None),
+    ("asin", jnp.arcsin, lambda x: jnp.abs(x) <= 1),
+    ("acos", jnp.arccos, lambda x: jnp.abs(x) <= 1),
+    ("atan", jnp.arctan, None),
+    ("signum", jnp.sign, None),
+]:
+    _REGISTRY[_name] = _math1(_fn, _dom)
+
+
+@register("abs")
+def _abs(cols, batch, expr):
+    (c,) = cols
+    return Column(c.dtype, jnp.abs(c.data), c.validity)
+
+
+@register("ceil")
+def _ceil(cols, batch, expr):
+    (c,) = cols
+    if c.dtype.is_integral:
+        return Column(INT64, c.data.astype(jnp.int64), c.validity)
+    return Column(INT64, jnp.ceil(c.data.astype(jnp.float64)).astype(jnp.int64), c.validity)
+
+
+@register("floor")
+def _floor(cols, batch, expr):
+    (c,) = cols
+    if c.dtype.is_integral:
+        return Column(INT64, c.data.astype(jnp.int64), c.validity)
+    return Column(INT64, jnp.floor(c.data.astype(jnp.float64)).astype(jnp.int64), c.validity)
+
+
+@register("round")
+def _round(cols, batch, expr):
+    c = cols[0]
+    scale = 0
+    if len(cols) > 1:
+        import numpy as np
+
+        scale = int(np.asarray(cols[1].data)[0])
+    if c.dtype.is_integral and scale >= 0:
+        return c
+    x = c.data.astype(jnp.float64) * (10.0 ** scale)
+    # spark rounds HALF_UP (away from zero), not banker's
+    r = jnp.where(x >= 0, jnp.floor(x + 0.5), jnp.ceil(x - 0.5)) / (10.0 ** scale)
+    if c.dtype.is_integral:
+        return Column(c.dtype, r.astype(c.dtype.jnp_dtype()), c.validity)
+    return Column(c.dtype if c.dtype.is_floating else FLOAT64,
+                  r.astype(jnp.float64 if not c.dtype.is_floating else c.dtype.jnp_dtype()),
+                  c.validity)
+
+
+@register("trunc")
+def _trunc(cols, batch, expr):
+    (c,) = cols
+    return Column(c.dtype, jnp.trunc(c.data.astype(jnp.float64)).astype(c.data.dtype),
+                  c.validity)
+
+
+@register("pow")
+@register("power")
+def _pow(cols, batch, expr):
+    a, b = cols
+    x = a.data.astype(jnp.float64)
+    y = b.data.astype(jnp.float64)
+    return Column(FLOAT64, jnp.power(x, y), _strict(cols))
+
+
+@register("atan2")
+def _atan2(cols, batch, expr):
+    a, b = cols
+    return Column(FLOAT64, jnp.arctan2(a.data.astype(jnp.float64),
+                                       b.data.astype(jnp.float64)), _strict(cols))
+
+
+@register("nullif")
+def _nullif(cols, batch, expr):
+    a, b = cols
+    if a.is_string:
+        eq = S.equals(a.data, b.data)
+    else:
+        eq = a.data == b.data
+    return Column(a.dtype, a.data, _and_valid(a.validity, ~(eq & b.valid_mask())))
+
+
+@register("nullifzero")
+def _nullifzero(cols, batch, expr):
+    (a,) = cols
+    return Column(a.dtype, a.data, _and_valid(a.validity, a.data != 0))
+
+
+@register("coalesce")
+def _coalesce(cols, batch, expr):
+    out_dtype = cols[0].dtype
+    if cols[0].is_string:
+        w = max(c.data.width for c in cols)
+        cols = [Column(c.dtype, S.ensure_width(c.data, w), c.validity) for c in cols]
+        acc_b = jnp.zeros_like(cols[0].data.bytes)
+        acc_l = jnp.zeros_like(cols[0].data.lengths)
+        acc_v = jnp.zeros((batch.capacity,), jnp.bool_)
+        for c in cols:
+            fire = c.valid_mask() & ~acc_v
+            acc_b = jnp.where(fire[:, None], c.data.bytes, acc_b)
+            acc_l = jnp.where(fire, c.data.lengths, acc_l)
+            acc_v = acc_v | fire
+        return Column(out_dtype, StringData(acc_b, acc_l), acc_v)
+    acc = jnp.zeros_like(cols[0].data)
+    acc_v = jnp.zeros((batch.capacity,), jnp.bool_)
+    for c in cols:
+        fire = c.valid_mask() & ~acc_v
+        acc = jnp.where(fire, c.data.astype(acc.dtype), acc)
+        acc_v = acc_v | fire
+    return Column(out_dtype, acc, acc_v)
+
+
+# ---- string functions ----
+
+@register("upper")
+def _upper(cols, batch, expr):
+    (c,) = cols
+    return Column(c.dtype, S.upper_ascii(c.data), c.validity)
+
+
+@register("lower")
+def _lower(cols, batch, expr):
+    (c,) = cols
+    return Column(c.dtype, S.lower_ascii(c.data), c.validity)
+
+
+@register("character_length")
+@register("char_length")
+@register("length")
+def _char_length(cols, batch, expr):
+    (c,) = cols
+    return Column(INT32, S.char_length(c.data), c.validity)
+
+
+@register("octet_length")
+def _octet_length(cols, batch, expr):
+    (c,) = cols
+    return Column(INT32, c.data.lengths, c.validity)
+
+
+@register("bit_length")
+def _bit_length(cols, batch, expr):
+    (c,) = cols
+    return Column(INT32, c.data.lengths * 8, c.validity)
+
+
+@register("ascii")
+def _ascii(cols, batch, expr):
+    (c,) = cols
+    first = c.data.bytes[:, 0].astype(jnp.int32)
+    return Column(INT32, jnp.where(c.data.lengths > 0, first, 0), c.validity)
+
+
+@register("substr")
+@register("substring")
+def _substr(cols, batch, expr):
+    c = cols[0]
+    start = cols[1].data.astype(jnp.int32)
+    if len(cols) > 2:
+        length = cols[2].data.astype(jnp.int32)
+    else:
+        length = jnp.full((batch.capacity,), c.data.width, jnp.int32)
+    return Column(c.dtype, S.substring(c.data, start, length), _strict(cols))
+
+
+@register("concat")
+def _concat(cols, batch, expr):
+    # spark concat: null if any arg null
+    return Column(STRING, S.concat([c.data for c in cols]), _strict(cols))
+
+
+@register("concat_ws")
+def _concat_ws(cols, batch, expr):
+    """First arg separator; null args are SKIPPED (spark semantics)."""
+    sep = cols[0].data
+    parts = cols[1:]
+    if not parts:
+        from blaze_tpu.exprs.cast import _const_string
+
+        return Column(STRING, _const_string(b"", batch.capacity), None)
+    # build: for each part, an effective (possibly empty) piece + conditional sep
+    pieces = []
+    seen_any = jnp.zeros((batch.capacity,), jnp.bool_)
+    for c in parts:
+        v = c.valid_mask()
+        need_sep = seen_any & v
+        sep_piece = StringData(sep.bytes, jnp.where(need_sep, sep.lengths, 0))
+        body = StringData(c.data.bytes, jnp.where(v, c.data.lengths, 0))
+        pieces += [sep_piece, body]
+        seen_any = seen_any | v
+    return Column(STRING, S.concat(pieces), cols[0].validity)
+
+
+@register("trim")
+@register("btrim")
+def _trim(cols, batch, expr):
+    (c,) = cols[:1]
+    return Column(c.dtype, S.trim(c.data, True, True), c.validity)
+
+
+@register("ltrim")
+def _ltrim(cols, batch, expr):
+    (c,) = cols[:1]
+    return Column(c.dtype, S.trim(c.data, True, False), c.validity)
+
+
+@register("rtrim")
+def _rtrim(cols, batch, expr):
+    (c,) = cols[:1]
+    return Column(c.dtype, S.trim(c.data, False, True), c.validity)
+
+
+@register("repeat")
+def _repeat(cols, batch, expr):
+    import numpy as np
+
+    c = cols[0]
+    n = int(np.asarray(cols[1].data)[0])
+    return Column(c.dtype, S.repeat(c.data, n), c.validity)
+
+
+@register("string_space")
+def _string_space(cols, batch, expr):
+    (n,) = cols
+    from blaze_tpu.columnar.batch import bucket_width
+
+    count = jnp.clip(n.data.astype(jnp.int32), 0, 128)
+    w = bucket_width(128)
+    j = jnp.arange(w, dtype=jnp.int32)
+    mat = jnp.where(j[None, :] < count[:, None], jnp.uint8(0x20), jnp.uint8(0))
+    return Column(STRING, StringData(mat, count), n.validity)
+
+
+# ---- date functions ----
+
+@register("year")
+def _year(cols, batch, expr):
+    (c,) = cols
+    y, _, _ = civil_from_days(c.data)
+    return Column(INT32, y, c.validity)
+
+
+@register("month")
+def _month(cols, batch, expr):
+    (c,) = cols
+    _, m, _ = civil_from_days(c.data)
+    return Column(INT32, m, c.validity)
+
+
+@register("day")
+@register("dayofmonth")
+def _day(cols, batch, expr):
+    (c,) = cols
+    _, _, d = civil_from_days(c.data)
+    return Column(INT32, d, c.validity)
+
+
+@register("dayofweek")
+def _dayofweek(cols, batch, expr):
+    (c,) = cols
+    # 1970-01-01 is Thursday; spark dayofweek: 1=Sunday..7=Saturday
+    dow = (c.data.astype(jnp.int64) + 4) % 7  # 0=Sunday
+    dow = jnp.where(dow < 0, dow + 7, dow)
+    return Column(INT32, (dow + 1).astype(jnp.int32), c.validity)
+
+
+@register("date_add")
+def _date_add(cols, batch, expr):
+    a, b = cols
+    return Column(a.dtype, a.data + b.data.astype(jnp.int32), _strict(cols))
+
+
+@register("date_sub")
+def _date_sub(cols, batch, expr):
+    a, b = cols
+    return Column(a.dtype, a.data - b.data.astype(jnp.int32), _strict(cols))
+
+
+@register("datediff")
+def _datediff(cols, batch, expr):
+    a, b = cols
+    return Column(INT32, a.data - b.data, _strict(cols))
+
+
+# ---- hash ----
+
+@register("murmur3_hash")
+@register("hash")
+def _murmur3(cols, batch, expr):
+    from blaze_tpu.exprs.hash import hash_columns
+
+    return Column(INT32, hash_columns(cols, 42), None)

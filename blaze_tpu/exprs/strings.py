@@ -1,0 +1,270 @@
+"""String kernels over fixed-width byte matrices — all pure jax, TPU-friendly.
+
+Ref analogs: the specialized string expressions (datafusion-ext-exprs
+string_starts_with.rs / string_ends_with.rs / string_contains.rs) and the
+spark string kernels (datafusion-ext-functions spark_strings.rs). Where the
+reference walks per-row byte slices, we compute on (capacity, width) uint8
+matrices with static widths so everything vectorizes on the VPU.
+
+Conventions: bytes beyond a row's length are zero; lexicographic order over
+zero-padded matrices + length tiebreak equals true byte-wise order (zero is
+the minimum byte; a content byte equal to zero only matters when all earlier
+bytes tie, in which case the length tiebreak resolves consistently).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from blaze_tpu.columnar.batch import StringData
+
+Array = jax.Array
+
+
+def ensure_width(s: StringData, width: int) -> StringData:
+    """Pad (never truncate) the byte matrix to `width` columns."""
+    if s.width == width:
+        return s
+    if s.width > width:
+        raise ValueError("ensure_width cannot shrink")
+    pad = jnp.zeros((s.capacity, width - s.width), jnp.uint8)
+    return StringData(jnp.concatenate([s.bytes, pad], axis=1), s.lengths)
+
+
+def common_width(a: StringData, b: StringData) -> Tuple[StringData, StringData]:
+    w = max(a.width, b.width)
+    return ensure_width(a, w), ensure_width(b, w)
+
+
+def pack_words_be(s: StringData) -> Array:
+    """(cap, W) uint8 -> (cap, W//4) uint32 big-endian words.
+
+    Unsigned big-endian word order preserves byte-wise lexicographic order —
+    these words are directly usable as sort/join/group keys (the TPU-native
+    replacement for the reference's row-encoded sort keys, sort_exec.rs).
+    """
+    cap, w = s.bytes.shape
+    assert w % 4 == 0, "string width must be a multiple of 4"
+    b = s.bytes.reshape(cap, w // 4, 4).astype(jnp.uint32)
+    return (b[..., 0] << 24) | (b[..., 1] << 16) | (b[..., 2] << 8) | b[..., 3]
+
+
+def compare(a: StringData, b: StringData) -> Tuple[Array, Array]:
+    """Row-wise (lt, eq) byte-wise comparison."""
+    a, b = common_width(a, b)
+    wa, wb = pack_words_be(a), pack_words_be(b)
+    nwords = wa.shape[1]
+    lt = a.lengths < b.lengths
+    eq = a.lengths == b.lengths
+    # fold from last word to first: first differing word decides
+    for j in range(nwords - 1, -1, -1):
+        wlt = wa[:, j] < wb[:, j]
+        weq = wa[:, j] == wb[:, j]
+        lt = jnp.where(weq, lt, wlt)
+        eq = weq & eq
+    return lt, eq
+
+
+def equals(a: StringData, b: StringData) -> Array:
+    a, b = common_width(a, b)
+    return jnp.all(a.bytes == b.bytes, axis=1) & (a.lengths == b.lengths)
+
+
+def _pattern_array(pattern: bytes) -> jnp.ndarray:
+    import numpy as np
+
+    return jnp.asarray(np.frombuffer(pattern, np.uint8))
+
+
+def starts_with(s: StringData, pattern: bytes) -> Array:
+    p = len(pattern)
+    if p == 0:
+        return jnp.ones((s.capacity,), jnp.bool_)
+    if p > s.width:
+        return jnp.zeros((s.capacity,), jnp.bool_)
+    pat = _pattern_array(pattern)
+    return jnp.all(s.bytes[:, :p] == pat[None, :], axis=1) & (s.lengths >= p)
+
+
+def ends_with(s: StringData, pattern: bytes) -> Array:
+    p = len(pattern)
+    if p == 0:
+        return jnp.ones((s.capacity,), jnp.bool_)
+    if p > s.width:
+        return jnp.zeros((s.capacity,), jnp.bool_)
+    pat = _pattern_array(pattern)
+    start = jnp.maximum(s.lengths - p, 0)
+    acc = s.lengths >= p
+    for t in range(p):
+        got = jnp.take_along_axis(s.bytes, jnp.clip(start + t, 0, s.width - 1)[:, None],
+                                  axis=1)[:, 0]
+        acc = acc & (got == pat[t])
+    return acc
+
+
+def match_positions(s: StringData, pattern: bytes) -> Array:
+    """(cap, W-P+1) bool: pattern matches at shift j (ignoring length)."""
+    p = len(pattern)
+    pat = _pattern_array(pattern)
+    nshift = s.width - p + 1
+    acc = jnp.ones((s.capacity, nshift), jnp.bool_)
+    for t in range(p):
+        acc = acc & (s.bytes[:, t: t + nshift] == pat[t])
+    return acc
+
+
+def contains(s: StringData, pattern: bytes) -> Array:
+    p = len(pattern)
+    if p == 0:
+        return jnp.ones((s.capacity,), jnp.bool_)
+    if p > s.width:
+        return jnp.zeros((s.capacity,), jnp.bool_)
+    pos = match_positions(s, pattern)
+    shifts = jnp.arange(pos.shape[1], dtype=jnp.int32)
+    return jnp.any(pos & (shifts[None, :] + p <= s.lengths[:, None]), axis=1)
+
+
+def like_match(s: StringData, pattern: bytes, escape: bytes = b"\\") -> Array:
+    """SQL LIKE via a vectorized NFA over pattern positions.
+
+    Tokens: literal byte, '_' (any one char), '%' (any run). State `reach[j]`
+    = "first i chars can match first j tokens". The char loop runs over the
+    static width; the token loop is unrolled (patterns are short).
+    """
+    esc = escape[0] if escape else 0x5C
+    tokens = []  # (kind, byte) kind: 0 literal, 1 '_', 2 '%'
+    i = 0
+    while i < len(pattern):
+        c = pattern[i]
+        if c == esc and i + 1 < len(pattern):
+            tokens.append((0, pattern[i + 1]))
+            i += 2
+            continue
+        if c == 0x25:  # %
+            tokens.append((2, 0))
+        elif c == 0x5F:  # _
+            tokens.append((1, 0))
+        else:
+            tokens.append((0, c))
+        i += 1
+    P = len(tokens)
+    cap = s.capacity
+
+    # reach[:, j] for j in 0..P; epsilon closure over leading '%' runs
+    def closure(reach):
+        out = [reach[:, 0]]
+        for j in range(1, P + 1):
+            r = reach[:, j]
+            if tokens[j - 1][0] == 2:
+                r = r | out[j - 1]
+            out.append(r)
+        return jnp.stack(out, axis=1)
+
+    init = jnp.zeros((cap, P + 1), jnp.bool_).at[:, 0].set(True)
+    reach = closure(init)
+    lens = s.lengths
+    for pos in range(s.width):
+        c = s.bytes[:, pos]
+        in_range = pos < lens
+        nxt = [jnp.zeros((cap,), jnp.bool_)]
+        for j in range(1, P + 1):
+            kind, tb = tokens[j - 1]
+            if kind == 0:
+                r = reach[:, j - 1] & (c == tb)
+            elif kind == 1:
+                r = reach[:, j - 1]
+            else:  # '%' consumes this char (stay) — closure handles skipping
+                r = reach[:, j]
+            nxt.append(r)
+        stepped = closure(jnp.stack(nxt, axis=1))
+        reach = jnp.where(in_range[:, None], stepped, reach)
+    return reach[:, P]
+
+
+def upper_ascii(s: StringData) -> StringData:
+    b = s.bytes
+    is_lower = (b >= 0x61) & (b <= 0x7A)
+    return StringData(jnp.where(is_lower, b - 32, b), s.lengths)
+
+
+def lower_ascii(s: StringData) -> StringData:
+    b = s.bytes
+    is_upper = (b >= 0x41) & (b <= 0x5A)
+    return StringData(jnp.where(is_upper, b + 32, b), s.lengths)
+
+
+def char_length(s: StringData) -> Array:
+    """UTF-8 character count = bytes that are not continuation bytes."""
+    pos = jnp.arange(s.width, dtype=jnp.int32)
+    in_len = pos[None, :] < s.lengths[:, None]
+    is_cont = (s.bytes & 0xC0) == 0x80
+    return jnp.sum(in_len & ~is_cont, axis=1, dtype=jnp.int32)
+
+
+def octet_length(s: StringData) -> Array:
+    return s.lengths
+
+
+def substring(s: StringData, start: Array, length: Array) -> StringData:
+    """1-based SQL substring over BYTES (caller handles utf-8 if needed).
+
+    start may be negative (from end, SQL semantics). Output width = input
+    width (lengths shrink)."""
+    slen = s.lengths
+    start0 = jnp.where(start > 0, start - 1,
+                       jnp.where(start < 0, jnp.maximum(slen + start, 0), 0))
+    start0 = jnp.minimum(start0, slen)
+    out_len = jnp.clip(jnp.minimum(length, slen - start0), 0, s.width)
+    j = jnp.arange(s.width, dtype=jnp.int32)
+    src = jnp.clip(start0[:, None] + j[None, :], 0, s.width - 1)
+    taken = jnp.take_along_axis(s.bytes, src, axis=1)
+    mask = j[None, :] < out_len[:, None]
+    return StringData(jnp.where(mask, taken, jnp.uint8(0)), out_len)
+
+
+def concat(parts: list) -> StringData:
+    """Concatenate StringData columns row-wise. Output width = bucketed sum."""
+    from blaze_tpu.columnar.batch import bucket_width
+
+    total_w = bucket_width(sum(p.width for p in parts))
+    cap = parts[0].capacity
+    out_len = sum([p.lengths for p in parts], jnp.zeros((cap,), jnp.int32))
+    j = jnp.arange(total_w, dtype=jnp.int32)
+    result = jnp.zeros((cap, total_w), jnp.uint8)
+    offset = jnp.zeros((cap,), jnp.int32)
+    for p in parts:
+        # place p at per-row offset: out[i, offset[i] + k] = p[i, k]
+        rel = j[None, :] - offset[:, None]
+        in_part = (rel >= 0) & (rel < p.lengths[:, None])
+        src = jnp.clip(rel, 0, p.width - 1)
+        gathered = jnp.take_along_axis(p.bytes, src, axis=1)
+        result = jnp.where(in_part, gathered, result)
+        offset = offset + p.lengths
+    return StringData(result, out_len)
+
+
+def repeat(s: StringData, n: int) -> StringData:
+    return concat([s] * max(n, 1)) if n >= 1 else StringData(
+        jnp.zeros_like(s.bytes), jnp.zeros_like(s.lengths))
+
+
+def trim(s: StringData, left: bool = True, right: bool = True,
+         chars: bytes = b" ") -> StringData:
+    """Trim leading/trailing characters in `chars` (default space)."""
+    j = jnp.arange(s.width, dtype=jnp.int32)
+    in_len = j[None, :] < s.lengths[:, None]
+    is_trim = jnp.zeros_like(s.bytes, dtype=jnp.bool_)
+    for c in list(chars):
+        is_trim = is_trim | (s.bytes == c)
+    keep = in_len & ~is_trim
+    any_keep = jnp.any(keep, axis=1)
+    first = jnp.argmax(keep, axis=1).astype(jnp.int32)
+    last = (s.width - 1 - jnp.argmax(keep[:, ::-1], axis=1)).astype(jnp.int32)
+    start = jnp.where(any_keep, first, s.lengths) if left else jnp.zeros_like(s.lengths)
+    end = (jnp.where(any_keep, last + 1, start) if right
+           else jnp.maximum(s.lengths, start))
+    new_len = jnp.maximum(end - start, 0)
+    return substring(s, start + 1, new_len)

@@ -1,0 +1,100 @@
+"""Operator protocol + execution context.
+
+Ref: DataFusion's ExecutionPlan trait as used by every operator in
+datafusion-ext-plans, and the per-task runtime in blaze/src/rt.rs. The
+streaming model carries over (operators yield batches, bounded memory); the
+TPU twist is the *fused pipeline*: consecutive map-like operators (filter/
+project/rename/...) expose a pure `batch_fn` and the executor composes them
+into ONE jit-compiled program per shape bucket, so a scan->filter->project
+chain is a single XLA executable instead of three interpreted operators.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Iterator, List, Optional, Tuple
+
+from blaze_tpu.columnar.batch import ColumnBatch
+from blaze_tpu.columnar.types import Schema
+from blaze_tpu.runtime.metrics import MetricsSet
+
+BatchStream = Iterator[ColumnBatch]
+
+
+@dataclasses.dataclass
+class ExecContext:
+    """Per-task context (ref: TaskContext + SessionContext in exec.rs)."""
+
+    partition: int = 0
+    num_partitions: int = 1
+    batch_size: Optional[int] = None
+    # populated by runtime.memory when spilling is enabled
+    mem_manager: Optional[object] = None
+    # task-kill cooperation (ref JniBridge.isTaskRunning polling)
+    is_running: Callable[[], bool] = lambda: True
+
+    def check_running(self) -> None:
+        if not self.is_running():
+            raise TaskKilledError("task killed")
+
+
+class TaskKilledError(RuntimeError):
+    pass
+
+
+class Operator:
+    """Base physical operator."""
+
+    def __init__(self, children: List["Operator"]) -> None:
+        self.children = children
+        self.metrics = MetricsSet()
+
+    @property
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+    def execute(self, ctx: ExecContext) -> BatchStream:
+        raise NotImplementedError
+
+    # plan-structure key for the jit cache (must be stable across tasks)
+    def plan_key(self) -> tuple:
+        return (type(self).__name__,) + tuple(c.plan_key() for c in self.children)
+
+    def name(self) -> str:
+        return type(self).__name__
+
+    def tree_string(self, indent: int = 0) -> str:
+        s = "  " * indent + self.name() + "\n"
+        return s + "".join(c.tree_string(indent + 1) for c in self.children)
+
+
+class MapLikeOp(Operator):
+    """Operator expressible as a pure per-batch transform — fusable.
+
+    Subclasses implement `make_batch_fn()` returning a jittable
+    `fn(ColumnBatch) -> ColumnBatch`. `execute` exists for standalone use;
+    the executor normally fuses chains of these into a single jit.
+    """
+
+    def __init__(self, child: Operator) -> None:
+        super().__init__([child])
+
+    @property
+    def child(self) -> Operator:
+        return self.children[0]
+
+    def make_batch_fn(self) -> Callable[[ColumnBatch], ColumnBatch]:
+        raise NotImplementedError
+
+    def execute(self, ctx: ExecContext) -> BatchStream:
+        from blaze_tpu.runtime.executor import execute_fused
+
+        return execute_fused(self, ctx)
+
+
+def count_stream(op: Operator, stream: BatchStream) -> BatchStream:
+    """Wrap a stream updating the operator's baseline metrics."""
+    for batch in stream:
+        op.metrics.add("output_batches", 1)
+        op.metrics.add("output_rows", int(batch.num_rows))
+        yield batch

@@ -1,0 +1,74 @@
+"""Shared operator utilities: batch concatenation / re-chunking.
+
+Ref: concat_batches in datafusion-ext-commons lib.rs:33-61 and the
+CoalesceStream wrapper (streams/coalesce_stream.rs) that re-chunks every
+operator's output to the configured batch size.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from blaze_tpu.columnar.batch import (
+    Column, ColumnBatch, StringData, bucket_capacity,
+)
+from blaze_tpu.columnar.types import Schema
+from blaze_tpu.exprs import strings as S
+
+
+def concat_batches(batches: List[ColumnBatch], schema: Optional[Schema] = None,
+                   capacity: Optional[int] = None) -> ColumnBatch:
+    """Concatenate live rows of several batches into one.
+
+    Materialization point: reads num_rows to host (this only happens at
+    pipeline breakers — sort/agg/join build — mirroring where the reference
+    materializes memory tables)."""
+    assert batches, "concat_batches needs at least one batch"
+    schema = schema or batches[0].schema
+    counts = [int(b.num_rows) for b in batches]
+    total = sum(counts)
+    cap = capacity or bucket_capacity(total)
+
+    # gather indices: position in the virtual concatenation of capacities
+    idx_np = np.zeros((cap,), np.int64)
+    pos = 0
+    offset = 0
+    for b, n in zip(batches, counts):
+        idx_np[pos : pos + n] = np.arange(n) + offset
+        pos += n
+        offset += b.capacity
+    idx = jnp.asarray(idx_np)
+
+    out_cols = []
+    for ci, field in enumerate(schema):
+        parts = [b.columns[ci] for b in batches]
+        if parts[0].is_string:
+            w = max(p.data.width for p in parts)
+            datas = [S.ensure_width(p.data, w) for p in parts]
+            big_bytes = jnp.concatenate([d.bytes for d in datas], axis=0)
+            big_lens = jnp.concatenate([d.lengths for d in datas], axis=0)
+            data = StringData(big_bytes[idx], big_lens[idx])
+        else:
+            big = jnp.concatenate([p.data for p in parts], axis=0)
+            data = big[idx]
+        vs = [p.valid_mask() if p.validity is not None else None for p in parts]
+        if any(v is not None for v in vs):
+            big_v = jnp.concatenate(
+                [v if v is not None else jnp.ones((p.capacity,), jnp.bool_)
+                 for v, p in zip(vs, parts)], axis=0)
+            validity = big_v[idx]
+        else:
+            validity = None
+        out_cols.append(Column(field.dtype, data, validity))
+    return ColumnBatch(schema, out_cols, jnp.asarray(total, jnp.int32), cap)
+
+
+def slice_batch(batch: ColumnBatch, start: int, count: int) -> ColumnBatch:
+    """Static slice of live rows [start, start+count) into a fresh batch."""
+    cap = bucket_capacity(count)
+    idx = jnp.asarray(np.arange(cap, dtype=np.int64) + start)
+    return batch.take(jnp.clip(idx, 0, batch.capacity - 1),
+                      jnp.minimum(jnp.maximum(batch.num_rows - start, 0), count))

@@ -1,0 +1,89 @@
+"""Per-task execution runtime: pipeline fusion + streaming drive loop.
+
+Ref: blaze/src/rt.rs NativeExecutionRuntime — there, `plan.execute(partition)`
+wires a tokio stream pipeline and a producer loop polls batches across the
+FFI boundary. Here the pipeline is *compiled*: maximal chains of map-like
+operators become one jit-compiled function (cached globally by plan key, see
+jit_cache.py), and the drive loop is a plain Python generator pulling from
+the chain's root source.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, List, Optional
+
+from blaze_tpu.columnar.batch import ColumnBatch
+from blaze_tpu.ops.base import BatchStream, ExecContext, MapLikeOp, Operator, count_stream
+from blaze_tpu.runtime import jit_cache
+from blaze_tpu.runtime.metrics import MetricNode
+
+
+def _fused_chain(op: MapLikeOp) -> tuple:
+    """Longest chain of MapLikeOps ending at `op` (top-down order)."""
+    chain = [op]
+    while isinstance(chain[-1].child, MapLikeOp):
+        chain.append(chain[-1].child)
+    return chain[0], chain[-1].child, list(reversed(chain))
+
+
+def execute_fused(op: MapLikeOp, ctx: ExecContext) -> BatchStream:
+    """Execute a map-like operator, fusing its maximal map-like chain."""
+    top, source, chain = _fused_chain(op)
+    key = ("fused", top.plan_key())
+
+    def make():
+        fns = [c.make_batch_fn() for c in chain]
+
+        def fused(batch: ColumnBatch) -> ColumnBatch:
+            for fn in fns:
+                batch = fn(batch)
+            return batch
+
+        return fused
+
+    def gen():
+        for batch in source.execute(ctx):
+            ctx.check_running()
+            fused = jit_cache.get_or_compile(key + _shape_key(batch), make)
+            with op.metrics.timer():
+                out = fused(batch)
+            yield out
+
+    return count_stream(op, gen())
+
+
+def _shape_key(batch: ColumnBatch) -> tuple:
+    parts = [batch.capacity]
+    for c in batch.columns:
+        if c.is_string:
+            parts.append(("s", c.data.width, c.validity is not None))
+        else:
+            parts.append((str(c.data.dtype), c.validity is not None))
+    return tuple(parts)
+
+
+def execute_plan(root: Operator, ctx: Optional[ExecContext] = None) -> BatchStream:
+    ctx = ctx or ExecContext()
+    return root.execute(ctx)
+
+
+def collect(root: Operator, ctx: Optional[ExecContext] = None) -> ColumnBatch:
+    """Materialize all output into one batch (test/driver helper)."""
+    from blaze_tpu.ops.common import concat_batches
+
+    batches = list(execute_plan(root, ctx))
+    if not batches:
+        return ColumnBatch.empty(root.schema)
+    if len(batches) == 1:
+        return batches[0]
+    return concat_batches(batches, root.schema)
+
+
+def collect_arrow(root: Operator, ctx: Optional[ExecContext] = None):
+    from blaze_tpu.columnar.arrow_io import batch_to_arrow
+
+    return batch_to_arrow(collect(root, ctx))
+
+
+def metric_tree(root: Operator) -> MetricNode:
+    return MetricNode.from_operator(root)

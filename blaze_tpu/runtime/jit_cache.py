@@ -1,0 +1,44 @@
+"""Global jit-compile cache keyed on plan structure.
+
+Ref analog: none in the reference (DataFusion interprets plans); this is the
+TPU-specific cost center called out in SURVEY.md §7(f): AQE re-plans every
+stage, so per-stage compiled pipelines must be cached across tasks. jax.jit
+already caches per (shapes, dtypes) *per function object*; operators are
+rebuilt per task, so we key the function object itself on the plan's
+structural key — same plan + same shape bucket => zero recompiles.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Hashable, Tuple
+
+import jax
+
+_lock = threading.Lock()
+_cache: Dict[Hashable, Callable] = {}
+_stats = {"hits": 0, "misses": 0}
+
+
+def get_or_compile(key: Hashable, make_fn: Callable[[], Callable],
+                   **jit_kwargs) -> Callable:
+    """Return a jitted function for `key`, building it once."""
+    with _lock:
+        fn = _cache.get(key)
+        if fn is not None:
+            _stats["hits"] += 1
+            return fn
+        _stats["misses"] += 1
+    built = jax.jit(make_fn(), **jit_kwargs)
+    with _lock:
+        return _cache.setdefault(key, built)
+
+
+def stats() -> Dict[str, int]:
+    return dict(_stats)
+
+
+def clear() -> None:
+    with _lock:
+        _cache.clear()
+        _stats.update(hits=0, misses=0)

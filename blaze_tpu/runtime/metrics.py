@@ -1,0 +1,66 @@
+"""Per-operator metrics tree.
+
+Ref: DataFusion MetricsSet per operator + the JVM MetricNode tree walked in
+lockstep on finalize (blaze/src/metrics.rs:21-50, MetricNode.scala:21-34).
+Same shape here: every operator owns a `MetricsSet`; `MetricNode` mirrors the
+plan tree and carries an optional value handler so an embedding layer (JVM
+bridge) can remap values into Spark's metric system.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+
+class MetricsSet:
+    def __init__(self) -> None:
+        self.values: Dict[str, int] = {
+            "output_rows": 0,
+            "output_batches": 0,
+            "elapsed_compute_ns": 0,
+        }
+
+    def add(self, name: str, delta: int) -> None:
+        self.values[name] = self.values.get(name, 0) + int(delta)
+
+    def timer(self, name: str = "elapsed_compute_ns"):
+        return _Timer(self, name)
+
+    def __getitem__(self, name: str) -> int:
+        return self.values.get(name, 0)
+
+
+class _Timer:
+    def __init__(self, ms: MetricsSet, name: str) -> None:
+        self.ms, self.name = ms, name
+
+    def __enter__(self):
+        self.t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        self.ms.add(self.name, time.perf_counter_ns() - self.t0)
+        return False
+
+
+class MetricNode:
+    """Mirror of the plan tree for metric export (ref MetricNode.scala)."""
+
+    def __init__(self, metrics: MetricsSet, children: List["MetricNode"],
+                 handler: Optional[Callable[[str, int], None]] = None) -> None:
+        self.metrics = metrics
+        self.children = children
+        self.handler = handler
+
+    def push(self) -> None:
+        """Walk the tree pushing values through handlers (task finalize)."""
+        if self.handler is not None:
+            for k, v in self.metrics.values.items():
+                self.handler(k, v)
+        for c in self.children:
+            c.push()
+
+    @staticmethod
+    def from_operator(op) -> "MetricNode":
+        return MetricNode(op.metrics, [MetricNode.from_operator(c) for c in op.children])

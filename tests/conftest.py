@@ -16,6 +16,13 @@ if "xla_force_host_platform_device_count" not in flags:
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# The axon site hook (/root/.axon_site) force-sets jax_platforms=axon,cpu at
+# import, overriding JAX_PLATFORMS — override it back so tests run on the
+# virtual 8-device CPU mesh.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
