@@ -1,0 +1,133 @@
+"""Benchmark: columnar scan->filter->project->group-by-sum on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The workload is the q06-style core slice of BASELINE.json config 2 — a
+store_sales-shaped scan with a selective filter, an arithmetic projection and
+a grouped SUM. Grouping is sort-based (sort + cumsum + boundary gather), the
+TPU-native design this engine uses instead of hash tables (SURVEY.md §7b).
+
+Timing notes: the remote-TPU tunnel has a large per-sync latency floor, and
+`block_until_ready` does not reliably block on the axon platform — so the
+pipeline is iterated *inside* one jit via `lax.scan` with a data-dependent
+carry, synced once by a device->host pull, and the per-iteration time is the
+difference between a long and a short scan (cancels compile + sync floor).
+
+`vs_baseline`: the reference publishes no per-chip GB/s (its headline is a
+1.72x TPC-DS cluster speedup, BASELINE.md), so vs_baseline is the speedup
+over a single-core numpy implementation of the same pipeline on this host —
+a proxy for the reference's per-core vectorized-CPU engine.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+ROWS = 1 << 21  # per batch
+GROUPS = 1 << 16
+K_SHORT, K_LONG = 2, 12
+
+
+def _make_data(seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "ss_item_sk": rng.integers(0, GROUPS, size=ROWS).astype(np.int32),
+        "ss_quantity": rng.integers(1, 100, size=ROWS).astype(np.int32),
+        "ss_sales_price": rng.random(ROWS) * 100,
+        "ss_ext_sales_price": rng.random(ROWS) * 500,
+    }
+
+
+def _input_bytes(data):
+    return sum(a.nbytes for a in data.values())
+
+
+def _numpy_pipeline(data):
+    keep = (data["ss_quantity"] <= 50) & (data["ss_sales_price"] > 10.0)
+    k = data["ss_item_sk"][keep]
+    amount = data["ss_quantity"][keep].astype(np.float64) * \
+        data["ss_sales_price"][keep]
+    out = np.zeros(GROUPS, np.float64)
+    np.add.at(out, k, amount)
+    return out
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from blaze_tpu.columnar import types as T
+    from blaze_tpu.columnar.batch import ColumnBatch
+
+    data = _make_data()
+    schema = T.Schema([
+        T.Field("ss_item_sk", T.INT32),
+        T.Field("ss_quantity", T.INT32),
+        T.Field("ss_sales_price", T.FLOAT64),
+        T.Field("ss_ext_sales_price", T.FLOAT64),
+    ])
+    batch = ColumnBatch.from_numpy(data, schema, capacity=ROWS)
+
+    def pipeline(b: ColumnBatch, carry):
+        qty = b.columns[1].data
+        price = b.columns[2].data
+        keep = (qty <= 50) & (price > 10.0) & b.row_mask()
+        amount = jnp.where(keep, qty.astype(jnp.float64) * price, 0.0)
+        key = jnp.where(keep, b.columns[0].data, jnp.int32(GROUPS - 1))
+        # sort-based grouped sum: sort pairs, cumsum, segment-boundary diff
+        ks, vs = jax.lax.sort((key, amount), num_keys=1)
+        csum = jnp.concatenate([jnp.zeros((1,), vs.dtype), jnp.cumsum(vs)])
+        bounds = jnp.searchsorted(
+            ks, jnp.arange(GROUPS + 1, dtype=ks.dtype), side="left")
+        sums = csum[bounds[1:]] - csum[bounds[:-1]]
+        return sums + carry * 1e-300
+
+    def make_scan(K):
+        def fn(b):
+            def step(c, _):
+                return pipeline(b, c), None
+            c0 = jnp.zeros((GROUPS,), jnp.float64)
+            c, _ = jax.lax.scan(step, c0, None, length=K)
+            return c
+        return fn
+
+    def timed(fn, reps=3):
+        f = jax.jit(fn)
+        out = np.asarray(f(batch))  # compile + warm
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = np.asarray(f(batch))
+            best = min(best, time.perf_counter() - t0)
+        return best, out
+
+    t_short, out = timed(make_scan(K_SHORT))
+    t_long, out = timed(make_scan(K_LONG))
+    per_iter = max((t_long - t_short) / (K_LONG - K_SHORT), 1e-9)
+    gbps = _input_bytes(data) / per_iter / 1e9
+
+    # numpy single-core proxy baseline (best of 3)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        ref = _numpy_pipeline(data)
+        best = min(best, time.perf_counter() - t0)
+    base_gbps = _input_bytes(data) / best / 1e9
+
+    # correctness: grouped sums must match numpy (last group absorbs the
+    # filtered-out sentinel rows with amount 0, so it matches too)
+    np.testing.assert_allclose(out, ref, rtol=1e-9)
+
+    print(json.dumps({
+        "metric": "scan_filter_project_groupby_sum",
+        "value": round(gbps, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(gbps / base_gbps, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

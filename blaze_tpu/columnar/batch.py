@@ -182,6 +182,16 @@ class ColumnBatch:
     def row_mask(self) -> Array:
         return jnp.arange(self.capacity, dtype=jnp.int32) < self.num_rows
 
+    def shape_key(self) -> tuple:
+        """Jit-cache shape-bucket signature (capacity, per-column layout)."""
+        parts: list = [self.capacity]
+        for c in self.columns:
+            if c.is_string:
+                parts.append(("s", c.data.width, c.validity is not None))
+            else:
+                parts.append((str(c.data.dtype), c.validity is not None))
+        return tuple(parts)
+
     def live_valid(self, i: int) -> Array:
         """validity AND row-liveness for column i."""
         return self.columns[i].valid_mask() & self.row_mask()

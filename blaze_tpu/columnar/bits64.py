@@ -1,0 +1,96 @@
+"""64-bit key/bit manipulation that works on TPU's emulated 64-bit types.
+
+TPU has no 64-bit bitcast: `x.view(uint64)` fails to compile, and float64 is
+emulated as double-double (hi/lo float32 pair, ~49-bit mantissa) so IEEE f64
+bits do not exist on device at all. This module centralizes the dtype-bending
+needed by sort-key encoding (ops/sort_keys.py) and Spark-murmur3 hashing
+(exprs/hash.py):
+
+  * int64 -> order-preserving uint64 : arithmetic sign-bit flip (no bitcast)
+  * int64 -> (hi, lo) uint32 halves  : mask/shift (for 32-bit hash mixing)
+  * float64 -> total-order key(s)    : exact IEEE encoding on CPU; on TPU a
+    (hi=f32(x), lo=f32(x-hi)) double-double decomposition encoded as two
+    32-bit total-order words — order-correct for every value the emulated
+    f64 can represent
+  * float64 -> 64 hash bits          : exact IEEE bits on CPU (bit-exact
+    with Spark); on TPU the hi/lo words (engine-consistent but NOT
+    Spark-bit-exact for doubles — double hash keys diverge on TPU, see
+    README; int/string/decimal hashing stays bit-exact everywhere)
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+_I64_MIN = -(1 << 63)
+
+
+def backend_has_bitcast64() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+def i64_ordered_u64(x: Array) -> Array:
+    """Order-preserving uint64 encoding of int64 (arithmetic sign flip)."""
+    return (x ^ jnp.int64(_I64_MIN)).astype(jnp.uint64)
+
+
+def i64_halves(x: Array) -> tuple[Array, Array]:
+    """(high, low) uint32 words of an int64, no bitcast."""
+    lo = (x & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
+    hi = ((x >> 32) & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
+    return hi, lo
+
+
+def _f32_total_order(x32: Array) -> Array:
+    """uint32 whose unsigned order is IEEE-f32 total order, NaN above +inf."""
+    x32 = jnp.where(jnp.isnan(x32), jnp.float32(jnp.nan), x32)
+    x32 = jnp.where(x32 == 0, jnp.float32(0.0), x32)
+    u = x32.view(jnp.uint32)
+    neg = (u >> 31) != 0
+    return jnp.where(neg, ~u, u ^ jnp.uint32(1 << 31))
+
+
+def f64_total_order_keys(x: Array) -> List[Array]:
+    """Unsigned key array(s) whose lexicographic order is the f64 order
+    (NaN last, -0.0 == 0.0)."""
+    if backend_has_bitcast64():
+        x = jnp.where(jnp.isnan(x), jnp.float64(jnp.nan), x)
+        x = jnp.where(x == 0, jnp.float64(0.0), x)
+        u = x.view(jnp.uint64)
+        neg = (u >> 63) != 0
+        return [jnp.where(neg, ~u, u ^ jnp.uint64(1 << 63))]
+    hi, lo = _dd_split(x)
+    return [_f32_total_order(hi), _f32_total_order(lo)]
+
+
+def _dd_split(x: Array) -> tuple[Array, Array]:
+    """Double-double decomposition: x ~= f64(hi) + f64(lo), both f32.
+
+    Monotone: hi = round-to-nearest-f32(x) is non-decreasing; within a hi
+    tie, lo = f32(x - hi) orders the residual. NaN propagates to both.
+    """
+    hi = x.astype(jnp.float32)
+    lo = (x - hi.astype(x.dtype)).astype(jnp.float32)
+    lo = jnp.where(jnp.isfinite(hi), lo, jnp.float32(0.0))
+    lo = jnp.where(jnp.isnan(x), jnp.float32(jnp.nan), lo)
+    return hi, lo
+
+
+def f64_hash_halves(x: Array) -> tuple[Array, Array]:
+    """(high, low) uint32 words to feed the murmur3 long path.
+
+    CPU: the exact IEEE-754 bits (Spark-bit-exact, -0.0 normalized).
+    TPU: bits of the (hi, lo) double-double words — deterministic and
+    consistent across this engine's shuffle/agg, but not Spark's value.
+    """
+    x = jnp.where(x == 0, jnp.zeros((), x.dtype), x)
+    if backend_has_bitcast64():
+        u = x.view(jnp.int64)
+        return i64_halves(u)
+    hi, lo = _dd_split(x)
+    return hi.view(jnp.uint32), lo.view(jnp.uint32)

@@ -272,10 +272,10 @@ def _parse_float64(s: StringData):
 def _from_string(col: Column, target: DataType) -> Column:
     s: StringData = col.data
     tk = target.kind
-    if target.is_integral or tk == TypeKind.DATE:
+    if tk == TypeKind.DATE:
+        return _string_to_date(col)
+    if target.is_integral:
         val, ok = _parse_int64(s)
-        if tk == TypeKind.DATE:
-            return _string_to_date(col)
         lo, hi = _INT_BOUNDS[tk]
         ok = ok & (val >= lo) & (val <= hi)
         return Column(target, jnp.where(ok, val, 0).astype(target.jnp_dtype()),

@@ -347,6 +347,12 @@ def _compile_inlist(expr: ir.InList, schema) -> CompiledExpr:
     lits = [compile_expr(v, schema) for v in expr.values]
     negated = expr.negated
 
+    # Spark 3VL: `x IN (a, b, NULL)` is TRUE on a match, NULL when x is null
+    # or the (unmatched) list contains a null, FALSE otherwise; NOT IN flips
+    # the value and keeps nullness.
+    has_null_lit = any(isinstance(v, ir.Literal) and v.value is None
+                       for v in expr.values)
+
     def run(b: ColumnBatch) -> Column:
         ccol = cf(b)
         hit = jnp.zeros((b.capacity,), jnp.bool_)
@@ -359,6 +365,11 @@ def _compile_inlist(expr: ir.InList, schema) -> CompiledExpr:
                 eq = ld == rd
             hit = hit | (eq & lcol.valid_mask())
         res = ~hit if negated else hit
-        return Column(BOOLEAN, res, ccol.validity)
+        if ccol.validity is None and not has_null_lit:
+            return Column(BOOLEAN, res, None)
+        valid = ccol.valid_mask()
+        if has_null_lit:
+            valid = valid & hit
+        return Column(BOOLEAN, res, valid)
 
     return run

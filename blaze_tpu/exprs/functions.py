@@ -74,7 +74,6 @@ def _math1(jnp_fn, domain=None, out_dtype: DataType = FLOAT64):
 
 for _name, _fn, _dom in [
     ("sqrt", jnp.sqrt, lambda x: x >= 0),
-    ("abs", jnp.abs, None),
     ("exp", jnp.exp, None),
     ("ln", jnp.log, lambda x: x > 0),
     ("log", jnp.log, lambda x: x > 0),
@@ -113,14 +112,24 @@ def _floor(cols, batch, expr):
     return Column(INT64, jnp.floor(c.data.astype(jnp.float64)).astype(jnp.int64), c.validity)
 
 
+def _static_int_arg(expr, i: int, what: str) -> int:
+    """Read a literal int argument from the IR (jit-safe; non-literal args
+    make the whole expression fall back at plan time, ref tryConvert)."""
+    from blaze_tpu.exprs import ir as _ir
+
+    arg = expr.args[i]
+    if not isinstance(arg, _ir.Literal) or arg.value is None:
+        raise NotImplementedError(
+            f"{expr.name}: {what} must be a non-null literal")
+    return int(arg.value)
+
+
 @register("round")
 def _round(cols, batch, expr):
     c = cols[0]
     scale = 0
     if len(cols) > 1:
-        import numpy as np
-
-        scale = int(np.asarray(cols[1].data)[0])
+        scale = _static_int_arg(expr, 1, "scale")
     if c.dtype.is_integral and scale >= 0:
         return c
     x = c.data.astype(jnp.float64) * (10.0 ** scale)
@@ -298,10 +307,8 @@ def _rtrim(cols, batch, expr):
 
 @register("repeat")
 def _repeat(cols, batch, expr):
-    import numpy as np
-
     c = cols[0]
-    n = int(np.asarray(cols[1].data)[0])
+    n = _static_int_arg(expr, 1, "repeat count")
     return Column(c.dtype, S.repeat(c.data, n), c.validity)
 
 

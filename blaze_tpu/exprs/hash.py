@@ -21,6 +21,7 @@ from typing import Optional, Sequence
 import jax
 import jax.numpy as jnp
 
+from blaze_tpu.columnar import bits64
 from blaze_tpu.columnar.batch import Column, StringData
 from blaze_tpu.columnar.types import TypeKind
 
@@ -65,9 +66,12 @@ def hash_int32(v: Array, seed: Array) -> Array:
 
 
 def hash_int64(v: Array, seed: Array) -> Array:
-    v = v.astype(jnp.int64).view(jnp.uint64)
-    low = (v & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
-    high = (v >> 32).astype(jnp.uint32)
+    high, low = bits64.i64_halves(v.astype(jnp.int64))
+    return hash_u32_halves(high, low, seed)
+
+
+def hash_u32_halves(high: Array, low: Array, seed: Array) -> Array:
+    """hashLong over pre-split 64-bit words (low mixed first, like Spark)."""
     h1 = _mix_h1(seed.astype(jnp.uint32), _mix_k1(low))
     h1 = _mix_h1(h1, _mix_k1(high))
     return _fmix(h1, jnp.uint32(8))
@@ -117,9 +121,8 @@ def hash_column(col: Column, seed: Array, row_mask: Optional[Array] = None) -> A
         f = jnp.where(f == 0.0, jnp.float32(0.0), f)  # -0.0 -> 0.0
         h = hash_int32(f.view(jnp.int32), seed)
     elif k == TypeKind.FLOAT64:
-        d = col.data
-        d = jnp.where(d == 0.0, jnp.float64(0.0), d)
-        h = hash_int64(d.view(jnp.int64), seed)
+        hi32, lo32 = bits64.f64_hash_halves(col.data)
+        h = hash_u32_halves(hi32, lo32, seed)
     elif k == TypeKind.NULL:
         h = jnp.broadcast_to(seed.astype(jnp.uint32), (col.capacity,))
     else:

@@ -210,7 +210,7 @@ class Like(Expr):
         return (self.child,)
 
     def key(self):
-        return ("like", self.pattern, self.child.key())
+        return ("like", self.pattern, self.escape, self.child.key())
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,184 @@
+"""Segment (group-run) utilities over key-sorted batches.
+
+The TPU-native replacement for the reference's open-addressing agg hash
+tables (agg_tables.rs): rows are first sorted by their grouping key, after
+which every grouped computation is a *segmented scan* — boundary detection by
+neighbor equality, group ids by cumsum, reductions by prefix-scan + boundary
+gather. No scatters, no data-dependent shapes.
+
+Used by agg (group-by), window (partition boundaries) and SMJ (run-length
+matching).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from blaze_tpu.columnar.batch import Column, ColumnBatch
+
+Array = jax.Array
+
+
+def _col_neighbor_eq(col: Column) -> Array:
+    """eq[i] = row i equals row i-1 in this column (eq[0] = False).
+
+    Null == null here (Spark grouping/ordering semantics: null is its own
+    group; NaN normalization is the sort encoder's job and cumsum-grouping
+    only ever runs on sort output).
+    """
+    cap = col.capacity
+    valid = col.valid_mask()
+    vprev = jnp.roll(valid, 1)
+    both_valid = valid & vprev
+    both_null = (~valid) & (~vprev)
+    if col.is_string:
+        b, l = col.data.bytes, col.data.lengths
+        lprev = jnp.roll(l, 1)
+        bprev = jnp.roll(b, 1, axis=0)
+        w = b.shape[1]
+        pos = jnp.arange(w, dtype=jnp.int32)[None, :]
+        in_len = pos < l[:, None]
+        data_eq = (l == lprev) & jnp.all(
+            jnp.where(in_len, b == bprev, True), axis=1)
+    else:
+        data_eq = col.data == jnp.roll(col.data, 1)
+        if jnp.issubdtype(col.data.dtype, jnp.floating):
+            # NaN == NaN for grouping (Spark), -0.0 == 0.0
+            d, p = col.data, jnp.roll(col.data, 1)
+            data_eq = data_eq | (jnp.isnan(d) & jnp.isnan(p))
+    eq = jnp.where(both_valid, data_eq, both_null)
+    return eq.at[0].set(False) if cap > 0 else eq
+
+
+def group_starts(batch: ColumnBatch, key_indices: Sequence[int]) -> Array:
+    """True at the first live row of each key run; False at padding rows.
+
+    Requires the batch to be sorted by the keys (padding compacted last).
+    """
+    mask = batch.row_mask()
+    if not key_indices:
+        # single global group: one start at row 0 if any rows
+        return (jnp.arange(batch.capacity, dtype=jnp.int32) == 0) & mask
+    eq = None
+    for i in key_indices:
+        e = _col_neighbor_eq(batch.columns[i])
+        eq = e if eq is None else (eq & e)
+    return (~eq) & mask
+
+
+@dataclasses.dataclass
+class GroupLayout:
+    """Everything downstream aggs need about the runs of a sorted batch."""
+    starts: Array      # bool (cap,) — first row of each group
+    gid: Array         # int32 (cap,) — group index per row (garbage at padding)
+    num_groups: Array  # int32 scalar
+    start_idx: Array   # int32 (cap,) — row index of group g's first row
+    end_idx: Array     # int32 (cap,) — row index of group g's last row
+    row_mask: Array    # bool (cap,) — live rows
+    group_mask: Array  # bool (cap,) — slots < num_groups
+
+
+def group_layout(batch: ColumnBatch, key_indices: Sequence[int]) -> GroupLayout:
+    cap = batch.capacity
+    mask = batch.row_mask()
+    starts = group_starts(batch, key_indices)
+    gid = jnp.cumsum(starts.astype(jnp.int32)) - 1
+    num_groups = jnp.sum(starts, dtype=jnp.int32)
+    (start_idx,) = jnp.nonzero(starts, size=cap, fill_value=0)
+    start_idx = start_idx.astype(jnp.int32)
+    # end of group g = start of g+1 minus 1; last group ends at num_rows-1
+    nxt = jnp.concatenate([start_idx[1:], jnp.zeros((1,), jnp.int32)])
+    gslot = jnp.arange(cap, dtype=jnp.int32)
+    end_idx = jnp.where(gslot == num_groups - 1, batch.num_rows - 1, nxt - 1)
+    group_mask = gslot < num_groups
+    end_idx = jnp.where(group_mask, end_idx, 0)
+    return GroupLayout(starts, gid, num_groups, start_idx, end_idx, mask,
+                       group_mask)
+
+
+def segmented_scan(values: Array, starts: Array,
+                   combine: Callable[[Array, Array], Array]) -> Array:
+    """Inclusive scan of `combine` restarting at each segment start."""
+    def op(a, b):
+        fa, va = a
+        fb, vb = b
+        return (fa | fb, jnp.where(fb, vb, combine(va, vb)))
+
+    _, out = lax.associative_scan(op, (starts, values))
+    return out
+
+
+# ---- per-group reductions (results compacted to slots [0, num_groups)) ----
+
+def seg_sum(values: Array, layout: GroupLayout, valid: Array) -> Array:
+    v = jnp.where(valid & layout.row_mask, values, jnp.zeros((), values.dtype))
+    csum = jnp.cumsum(v, dtype=v.dtype)
+    z = jnp.concatenate([jnp.zeros((1,), csum.dtype), csum])
+    return z[layout.end_idx + 1] - z[layout.start_idx]
+
+
+def seg_count(valid: Array, layout: GroupLayout) -> Array:
+    return seg_sum(valid.astype(jnp.int64), layout,
+                   jnp.ones_like(valid))
+
+
+def seg_reduce_scan(values: Array, layout: GroupLayout, valid: Array,
+                    combine: Callable[[Array, Array], Array],
+                    identity) -> Tuple[Array, Array]:
+    """Generic per-group reduce skipping nulls. Returns (values, any_valid)."""
+    live_valid = valid & layout.row_mask
+    ident = jnp.asarray(identity, values.dtype)
+    v = jnp.where(live_valid, values, ident)
+    scanned = segmented_scan(v, layout.starts, combine)
+    any_valid = segmented_scan(live_valid.astype(jnp.int32), layout.starts,
+                               lambda a, b: a | b)
+    return scanned[layout.end_idx], any_valid[layout.end_idx].astype(jnp.bool_)
+
+
+def seg_min(values, layout, valid):
+    info = jnp.finfo if jnp.issubdtype(values.dtype, jnp.floating) else jnp.iinfo
+    return seg_reduce_scan(values, layout, valid, jnp.minimum,
+                           info(values.dtype).max)
+
+
+def seg_max(values, layout, valid):
+    info = jnp.finfo if jnp.issubdtype(values.dtype, jnp.floating) else jnp.iinfo
+    return seg_reduce_scan(values, layout, valid, jnp.maximum,
+                           info(values.dtype).min)
+
+
+def seg_first(values: Array, layout: GroupLayout, valid: Array,
+              ignores_null: bool) -> Tuple[Array, Array]:
+    """First (optionally first non-null) value per group (ref agg/first.rs,
+    first_ignores_null.rs)."""
+    if not ignores_null:
+        first_vals = values[layout.start_idx]
+        first_valid = (valid & layout.row_mask)[layout.start_idx]
+        return first_vals, first_valid
+    live_valid = valid & layout.row_mask
+    # carry (has_value, value): keep the leftmost valid value in the segment
+    def op(a, b):
+        ha, va = a
+        hb, vb = b
+        return (ha | hb, jnp.where(ha, va, vb))
+
+    def combine2(a, b):
+        return op(a, b)
+
+    # segmented variant: restart at starts
+    def seg_op(x, y):
+        fx, hx, vx = x
+        fy, hy, vy = y
+        h, v = combine2((hx, vx), (hy, vy))
+        return (fx | fy, jnp.where(fy, hy, h), jnp.where(fy, vy, v))
+
+    zero = jnp.zeros((), values.dtype)
+    v0 = jnp.where(live_valid, values, zero)
+    _, has, val = lax.associative_scan(
+        seg_op, (layout.starts, live_valid, v0))
+    return val[layout.end_idx], has[layout.end_idx]

@@ -1,0 +1,118 @@
+"""SortExec / TakeOrderedExec — sort-based pipeline breakers.
+
+Ref: datafusion-ext-plans sort_exec.rs (external merge-sort with loser-tree
+spill merge, optional fetch limit) and take_ordered_exec (NativeTakeOrdered).
+TPU-first redesign: in-memory runs are concatenated and sorted by ONE
+variadic `lax.sort` program per shape bucket (no pairwise merge levels —
+XLA's sort is the merge network); the fetch-limited path keeps a bounded
+top-k state folded over the stream so unbounded inputs never materialize.
+Host spill of sorted runs plugs in at the runtime.memory layer.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+
+from blaze_tpu.columnar.batch import ColumnBatch, bucket_capacity
+from blaze_tpu.columnar.types import Schema
+from blaze_tpu.ops.base import BatchStream, ExecContext, Operator, count_stream
+from blaze_tpu.ops.common import concat_batches
+from blaze_tpu.ops.sort_keys import SortSpec, sort_batch
+from blaze_tpu.runtime import jit_cache
+
+
+def sorted_batch_jit(batch: ColumnBatch, specs: Sequence[SortSpec],
+                     plan_key: tuple) -> ColumnBatch:
+    """Jit-cached whole-batch sort."""
+    key = ("sort_kernel", plan_key, tuple(s.key() for s in specs),
+           batch.shape_key())
+    fn = jit_cache.get_or_compile(
+        key, lambda: (lambda b: sort_batch(b, specs)))
+    return fn(batch)
+
+
+def truncate(batch: ColumnBatch, limit: int) -> ColumnBatch:
+    """Keep the first `limit` live rows (batch must be front-compact)."""
+    cap = bucket_capacity(limit)
+    if cap >= batch.capacity:
+        return batch.with_num_rows(jnp.minimum(batch.num_rows, limit))
+    cols = []
+    from blaze_tpu.columnar.batch import Column, StringData
+
+    for c in batch.columns:
+        if c.is_string:
+            data = StringData(c.data.bytes[:cap], c.data.lengths[:cap])
+        else:
+            data = c.data[:cap]
+        v = c.validity[:cap] if c.validity is not None else None
+        cols.append(Column(c.dtype, data, v))
+    n = jnp.minimum(batch.num_rows, limit)
+    return ColumnBatch(batch.schema, cols, n, cap)
+
+
+class SortExec(Operator):
+    """Full sort (optionally fetch-limited top-k)."""
+
+    def __init__(self, child: Operator, specs: Sequence[SortSpec],
+                 fetch: Optional[int] = None) -> None:
+        super().__init__([child])
+        self.specs = list(specs)
+        self.fetch = fetch
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def plan_key(self) -> tuple:
+        return ("sort", tuple(s.key() for s in self.specs), self.fetch,
+                self.children[0].plan_key())
+
+    def execute(self, ctx: ExecContext) -> BatchStream:
+        def gen():
+            child = self.children[0]
+            if self.fetch is not None:
+                out = self._topk(child.execute(ctx), ctx)
+            else:
+                batches = list(child.execute(ctx))
+                if not batches:
+                    return
+                with self.metrics.timer():
+                    big = concat_batches(batches, self.schema)
+                    out = sorted_batch_jit(big, self.specs, self.plan_key())
+            if out is not None:
+                yield out
+
+        return count_stream(self, gen())
+
+    def _topk(self, stream: BatchStream, ctx: ExecContext
+              ) -> Optional[ColumnBatch]:
+        """Fold a bounded top-k over the stream (ref sort_exec.rs fetch)."""
+        state: Optional[ColumnBatch] = None
+        for batch in stream:
+            ctx.check_running()
+            with self.metrics.timer():
+                part = truncate(
+                    sorted_batch_jit(batch, self.specs, self.plan_key()),
+                    self.fetch)
+                if state is None:
+                    state = part
+                else:
+                    both = concat_batches([state, part], self.schema)
+                    state = truncate(
+                        sorted_batch_jit(both, self.specs, self.plan_key()),
+                        self.fetch)
+        return state
+
+
+class TakeOrderedExec(SortExec):
+    """Ref: NativeTakeOrderedBase — limit + sort in one node."""
+
+    def __init__(self, child: Operator, specs: Sequence[SortSpec],
+                 limit: int) -> None:
+        super().__init__(child, specs, fetch=limit)
+
+    def plan_key(self) -> tuple:
+        return ("take_ordered", tuple(s.key() for s in self.specs),
+                self.fetch, self.children[0].plan_key())

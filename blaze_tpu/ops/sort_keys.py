@@ -1,0 +1,182 @@
+"""Sort-key encoding: columns -> unsigned arrays whose ascending order is the
+requested (asc/desc, nulls first/last) Spark ordering.
+
+TPU-native substitute for the reference's row-encoded comparison keys
+(sort_exec.rs builds Arrow `Rows` for memcmp-able keys). Here every key
+column becomes one or more unsigned device arrays fed to a single variadic
+`lax.sort(num_keys=k)` — measured far cheaper than argsort+gather on TPU
+(see memory: sort-pairs ~3.5ms vs gather ~15ms per 2M rows).
+
+Encodings (all produce arrays that sort ascending-unsigned):
+  * signed ints / date / timestamp / decimal: sign-bit flip
+  * bool: as uint8 (false < true, Spark order)
+  * float32/64: IEEE total order (negative -> all bits flipped, positive ->
+    sign flipped); NaN canonicalized to positive qNaN, sorting after +inf
+    (Spark: NaN is largest, NaN == NaN)
+  * string/binary: big-endian uint64 words of the padded byte matrix, plus
+    the length as a final tiebreak (strict lexicographic; limited to
+    `max_words` leading words — ORDER BY beyond that prefix is approximate,
+    equality paths use full-width neighbor compares instead, segment.py)
+  * nulls: a separate uint8 flag key emitted before the value key(s)
+  * descending: bitwise complement of the value encoding
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from blaze_tpu.columnar import bits64
+from blaze_tpu.columnar.batch import Column, ColumnBatch, StringData
+from blaze_tpu.columnar.types import TypeKind
+
+Array = jax.Array
+
+# default prefix words for string ORDER BY keys (8 bytes each)
+DEFAULT_MAX_STRING_WORDS = 8
+
+
+@dataclasses.dataclass(frozen=True)
+class SortSpec:
+    """One ORDER BY term (ref: PhysicalExprNode sort field asc/nulls_first)."""
+    col: int
+    asc: bool = True
+    nulls_first: bool = True
+
+    def key(self) -> tuple:
+        return (self.col, self.asc, self.nulls_first)
+
+
+def _flip_sign(x: Array) -> List[Array]:
+    if x.dtype.itemsize == 8:  # int64 family: no 64-bit bitcast on TPU
+        return [bits64.i64_ordered_u64(x.astype(jnp.int64))]
+    x32 = x.astype(jnp.int32)  # int8/16/32/date sign-extend
+    return [x32.view(jnp.uint32) ^ jnp.uint32(1 << 31)]
+
+
+def _float_total_order(x: Array) -> List[Array]:
+    if x.dtype == jnp.float32:
+        return [bits64._f32_total_order(x)]
+    return bits64.f64_total_order_keys(x)
+
+
+def string_words(s: StringData, max_words: Optional[int] = None) -> List[Array]:
+    """Big-endian uint64 word columns of the padded byte matrix."""
+    cap, w = s.bytes.shape
+    nwords = (w + 7) // 8
+    if max_words is not None:
+        nwords = min(nwords, max_words)
+    padded_w = nwords * 8
+    b = s.bytes[:, :padded_w] if padded_w <= w else jnp.pad(
+        s.bytes, ((0, 0), (0, padded_w - w)))
+    words = b.reshape(cap, nwords, 8).astype(jnp.uint64)
+    shifts = jnp.asarray([56, 48, 40, 32, 24, 16, 8, 0], jnp.uint64)
+    packed = jnp.sum(words << shifts[None, None, :], axis=-1, dtype=jnp.uint64)
+    return [packed[:, i] for i in range(nwords)]
+
+
+def encode_column(col: Column, asc: bool, nulls_first: bool,
+                  row_mask: Array,
+                  max_string_words: int = DEFAULT_MAX_STRING_WORDS,
+                  ) -> List[Array]:
+    """Key arrays for one column; earlier arrays are more significant."""
+    keys: List[Array] = []
+    valid = col.valid_mask() & row_mask
+    if col.validity is not None:
+        # 0 sorts first: null -> 0 iff nulls_first
+        flag = jnp.where(valid, jnp.uint8(1 if nulls_first else 0),
+                         jnp.uint8(0 if nulls_first else 1))
+        keys.append(flag)
+
+    k = col.dtype.kind
+    if col.is_string:
+        vals = string_words(col.data, max_string_words)
+        vals.append(col.data.lengths.astype(jnp.uint32))
+    elif k == TypeKind.BOOLEAN:
+        vals = [col.data.astype(jnp.uint8)]
+    elif k in (TypeKind.FLOAT32, TypeKind.FLOAT64):
+        vals = _float_total_order(col.data)
+    elif k == TypeKind.NULL:
+        vals = []
+    else:  # signed integral family
+        vals = _flip_sign(col.data)
+
+    for v in vals:
+        # zero out nulls so key content is deterministic (flag already ranks)
+        v = jnp.where(valid, v, jnp.zeros((), v.dtype))
+        keys.append(v if asc else ~v)
+    return keys
+
+
+def batch_sort_keys(batch: ColumnBatch, specs: Sequence[SortSpec],
+                    max_string_words: int = DEFAULT_MAX_STRING_WORDS,
+                    ) -> List[Array]:
+    """All key arrays for a multi-column sort, padding rows last.
+
+    The leading liveness key forces padding rows (>= num_rows) to the end
+    regardless of direction/null flags, so sorted outputs stay front-compact.
+    """
+    mask = batch.row_mask()
+    keys: List[Array] = [jnp.where(mask, jnp.uint8(0), jnp.uint8(1))]
+    for spec in specs:
+        keys.extend(encode_column(batch.columns[spec.col], spec.asc,
+                                  spec.nulls_first, mask, max_string_words))
+    return keys
+
+
+def sort_batch(batch: ColumnBatch, specs: Sequence[SortSpec],
+               max_string_words: int = DEFAULT_MAX_STRING_WORDS,
+               ) -> ColumnBatch:
+    """Reorder all rows by the sort specs (jit-safe, shape-preserving).
+
+    1-D column leaves ride the variadic sort as payload operands; 2-D string
+    byte matrices are gathered afterwards through the sorted iota (the only
+    gather, unavoidable for matrix payloads).
+    """
+    keys = batch_sort_keys(batch, specs, max_string_words)
+    iota = jnp.arange(batch.capacity, dtype=jnp.int32)
+
+    payload: List[Array] = [iota]
+    slots = []  # (col_idx, kind) mirrors payload[1:]
+    for ci, c in enumerate(batch.columns):
+        if c.is_string:
+            payload.append(c.data.lengths)
+            slots.append((ci, "len"))
+        else:
+            data = c.data
+            if data.dtype == jnp.bool_:
+                data = data.astype(jnp.uint8)
+                kind = "bool"
+            else:
+                kind = "data"
+            payload.append(data)
+            slots.append((ci, kind))
+        if c.validity is not None:
+            payload.append(c.validity.astype(jnp.uint8))
+            slots.append((ci, "validity"))
+
+    out = jax.lax.sort(tuple(keys) + tuple(payload), num_keys=len(keys),
+                       is_stable=True)
+    perm = out[len(keys)]
+    sorted_payload = out[len(keys) + 1:]
+
+    parts = {}
+    for (ci, kind), arr in zip(slots, sorted_payload):
+        parts.setdefault(ci, {})[kind] = arr
+    new_cols = []
+    for ci, c in enumerate(batch.columns):
+        p = parts.get(ci, {})
+        validity = None
+        if c.validity is not None:
+            validity = p["validity"].astype(jnp.bool_)
+        if c.is_string:
+            data = StringData(c.data.bytes[perm], p["len"])
+        elif "bool" in p:
+            data = p["bool"].astype(jnp.bool_)
+        else:
+            data = p["data"]
+        new_cols.append(Column(c.dtype, data, validity))
+    return ColumnBatch(batch.schema, new_cols, batch.num_rows, batch.capacity)

@@ -1,0 +1,16 @@
+"""Device-mesh parallelism: the ICI shuffle path.
+
+TPU-native replacement for the reference's shuffle *transport* when all
+partitions of a stage live on one TPU slice: instead of writing per-partition
+IPC files and letting Spark netty move blocks (SURVEY.md §2.6), the exchange
+is a `lax.all_to_all` over a `jax.sharding.Mesh` that never leaves HBM.
+Cross-slice exchanges still use the file/IPC container (ops/shuffle.py).
+"""
+
+from blaze_tpu.parallel.shuffle import (
+    mesh_shuffle_batch,
+    partition_ids,
+    staged_all_to_all,
+)
+
+__all__ = ["mesh_shuffle_batch", "partition_ids", "staged_all_to_all"]

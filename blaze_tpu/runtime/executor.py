@@ -44,22 +44,12 @@ def execute_fused(op: MapLikeOp, ctx: ExecContext) -> BatchStream:
     def gen():
         for batch in source.execute(ctx):
             ctx.check_running()
-            fused = jit_cache.get_or_compile(key + _shape_key(batch), make)
+            fused = jit_cache.get_or_compile(key + batch.shape_key(), make)
             with op.metrics.timer():
                 out = fused(batch)
             yield out
 
     return count_stream(op, gen())
-
-
-def _shape_key(batch: ColumnBatch) -> tuple:
-    parts = [batch.capacity]
-    for c in batch.columns:
-        if c.is_string:
-            parts.append(("s", c.data.width, c.validity is not None))
-        else:
-            parts.append((str(c.data.dtype), c.validity is not None))
-    return tuple(parts)
 
 
 def execute_plan(root: Operator, ctx: Optional[ExecContext] = None) -> BatchStream:

@@ -1,0 +1,104 @@
+"""Segmented-scan grouping utilities vs numpy oracles."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from blaze_tpu.columnar import types as T
+from blaze_tpu.columnar.batch import ColumnBatch
+from blaze_tpu.ops import segment as seg
+from blaze_tpu.ops.sort_keys import SortSpec, sort_batch
+
+SCHEMA = T.Schema([T.Field("k", T.INT64), T.Field("v", T.FLOAT64)])
+
+
+def _sorted_batch(rng, n, nulls=False, nkeys=7):
+    k = rng.integers(0, nkeys, n).astype(np.int64)
+    v = rng.random(n) * 10
+    validity = {"v": rng.random(n) > 0.3} if nulls else None
+    b = ColumnBatch.from_numpy({"k": k, "v": v}, SCHEMA, validity=validity)
+    return sort_batch(b, [SortSpec(0)])
+
+
+def test_group_layout_counts(rng):
+    b = _sorted_batch(rng, 500)
+    layout = seg.group_layout(b, [0])
+    d = b.to_numpy()
+    uniq = np.unique(np.asarray(d["k"][: 500]))
+    assert int(layout.num_groups) == len(uniq)
+
+
+def test_seg_sum_count_min_max(rng):
+    b = _sorted_batch(rng, 400, nulls=True)
+    layout = seg.group_layout(b, [0])
+    vcol = b.columns[1]
+    valid = vcol.valid_mask()
+    sums = np.asarray(seg.seg_sum(vcol.data, layout, valid))
+    counts = np.asarray(seg.seg_count(valid & b.row_mask(), layout))
+    mins, mins_ok = seg.seg_min(vcol.data, layout, valid)
+    maxs, maxs_ok = seg.seg_max(vcol.data, layout, valid)
+    mins, maxs = np.asarray(mins), np.asarray(maxs)
+
+    d = b.to_numpy()
+    ks = np.asarray([k for k in d["k"]])
+    vs = d["v"]
+    G = int(layout.num_groups)
+    uniq = sorted(set(ks.tolist()))
+    assert G == len(uniq)
+    for g, kv in enumerate(uniq):
+        idx = [i for i in range(len(ks)) if ks[i] == kv]
+        vals = [vs[i] for i in idx if vs[i] is not None]
+        np.testing.assert_allclose(sums[g], sum(vals) if vals else 0.0,
+                                   rtol=1e-12)
+        assert counts[g] == len(vals)
+        if vals:
+            np.testing.assert_allclose(mins[g], min(vals))
+            np.testing.assert_allclose(maxs[g], max(vals))
+            assert bool(np.asarray(mins_ok)[g])
+        else:
+            assert not bool(np.asarray(mins_ok)[g])
+
+
+def test_seg_first(rng):
+    b = _sorted_batch(rng, 300, nulls=True)
+    layout = seg.group_layout(b, [0])
+    vcol = b.columns[1]
+    valid = vcol.valid_mask()
+    fv, fok = seg.seg_first(vcol.data, layout, valid, ignores_null=False)
+    iv, iok = seg.seg_first(vcol.data, layout, valid, ignores_null=True)
+    d = b.to_numpy()
+    ks, vs = list(d["k"]), d["v"]
+    uniq = sorted(set(ks))
+    for g, kv in enumerate(uniq):
+        group_vals = [vs[i] for i in range(len(ks)) if ks[i] == kv]
+        # first (with nulls): first element, validity = not-null
+        if group_vals[0] is None:
+            assert not bool(np.asarray(fok)[g])
+        else:
+            assert bool(np.asarray(fok)[g])
+            np.testing.assert_allclose(np.asarray(fv)[g], group_vals[0])
+        nonnull = [x for x in group_vals if x is not None]
+        if nonnull:
+            assert bool(np.asarray(iok)[g])
+            np.testing.assert_allclose(np.asarray(iv)[g], nonnull[0])
+        else:
+            assert not bool(np.asarray(iok)[g])
+
+
+def test_global_group(rng):
+    b = _sorted_batch(rng, 100)
+    layout = seg.group_layout(b, [])
+    assert int(layout.num_groups) == 1
+    sums = seg.seg_sum(b.columns[1].data, layout, b.columns[1].valid_mask())
+    d = b.to_numpy()
+    np.testing.assert_allclose(np.asarray(sums)[0], np.sum(d["v"]), rtol=1e-12)
+
+
+def test_string_group_boundaries(rng):
+    schema = T.Schema([T.Field("s", T.STRING), T.Field("v", T.FLOAT64)])
+    s = ["aa", "aa", "ab", "b", "b", "b", "", ""]
+    v = np.arange(8.0)
+    b = ColumnBatch.from_numpy({"s": s, "v": v}, schema)
+    b = sort_batch(b, [SortSpec(0)])
+    layout = seg.group_layout(b, [0])
+    assert int(layout.num_groups) == 4  # "", aa, ab, b
