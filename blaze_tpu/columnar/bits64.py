@@ -84,11 +84,13 @@ def _dd_split(x: Array) -> tuple[Array, Array]:
 def f64_hash_halves(x: Array) -> tuple[Array, Array]:
     """(high, low) uint32 words to feed the murmur3 long path.
 
-    CPU: the exact IEEE-754 bits (Spark-bit-exact, -0.0 normalized).
+    CPU: the exact IEEE-754 bits (Spark-bit-exact: doubleToLongBits
+    canonicalizes every NaN and we normalize -0.0 like spark_hash.rs).
     TPU: bits of the (hi, lo) double-double words — deterministic and
     consistent across this engine's shuffle/agg, but not Spark's value.
     """
     x = jnp.where(x == 0, jnp.zeros((), x.dtype), x)
+    x = jnp.where(jnp.isnan(x), jnp.asarray(jnp.nan, x.dtype), x)
     if backend_has_bitcast64():
         u = x.view(jnp.int64)
         return i64_halves(u)

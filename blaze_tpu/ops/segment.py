@@ -141,15 +141,42 @@ def seg_reduce_scan(values: Array, layout: GroupLayout, valid: Array,
 
 
 def seg_min(values, layout, valid):
-    info = jnp.finfo if jnp.issubdtype(values.dtype, jnp.floating) else jnp.iinfo
+    """Per-group MIN skipping nulls, Spark NaN semantics (NaN is the
+    GREATEST value: min picks non-NaN when one exists, NaN only when the
+    group is all-NaN)."""
+    if jnp.issubdtype(values.dtype, jnp.floating):
+        inf = jnp.asarray(jnp.inf, values.dtype)
+        v = jnp.where(valid & layout.row_mask, values, inf)
+        scanned = segmented_scan(v, layout.starts, _fmin)
+        mins = scanned[layout.end_idx]
+        nonnan = valid & ~jnp.isnan(values)
+        any_valid = _any(valid, layout)
+        any_nonnan = _any(nonnan, layout)
+        nan = jnp.asarray(jnp.nan, values.dtype)
+        return jnp.where(any_valid & ~any_nonnan, nan, mins), any_valid
     return seg_reduce_scan(values, layout, valid, jnp.minimum,
-                           info(values.dtype).max)
+                           jnp.iinfo(values.dtype).max)
 
 
 def seg_max(values, layout, valid):
-    info = jnp.finfo if jnp.issubdtype(values.dtype, jnp.floating) else jnp.iinfo
+    """Per-group MAX skipping nulls; jnp.maximum propagates NaN, which IS
+    Spark's answer (NaN greatest)."""
+    if jnp.issubdtype(values.dtype, jnp.floating):
+        return seg_reduce_scan(values, layout, valid, jnp.maximum,
+                               -jnp.inf)
     return seg_reduce_scan(values, layout, valid, jnp.maximum,
-                           info(values.dtype).min)
+                           jnp.iinfo(values.dtype).min)
+
+
+def _fmin(a, b):
+    return jnp.fmin(a, b)
+
+
+def _any(flags, layout):
+    live = flags & layout.row_mask
+    scanned = segmented_scan(live.astype(jnp.int32), layout.starts,
+                             lambda a, b: a | b)
+    return scanned[layout.end_idx].astype(jnp.bool_)
 
 
 def seg_first(values: Array, layout: GroupLayout, valid: Array,
@@ -161,20 +188,13 @@ def seg_first(values: Array, layout: GroupLayout, valid: Array,
         first_valid = (valid & layout.row_mask)[layout.start_idx]
         return first_vals, first_valid
     live_valid = valid & layout.row_mask
-    # carry (has_value, value): keep the leftmost valid value in the segment
-    def op(a, b):
-        ha, va = a
-        hb, vb = b
-        return (ha | hb, jnp.where(ha, va, vb))
 
-    def combine2(a, b):
-        return op(a, b)
-
-    # segmented variant: restart at starts
+    # segmented scan keeping the leftmost valid (has, value) per segment
     def seg_op(x, y):
         fx, hx, vx = x
         fy, hy, vy = y
-        h, v = combine2((hx, vx), (hy, vy))
+        h = hx | hy
+        v = jnp.where(hx, vx, vy)
         return (fx | fy, jnp.where(fy, hy, h), jnp.where(fy, vy, v))
 
     zero = jnp.zeros((), values.dtype)

@@ -1,0 +1,280 @@
+"""Shuffle write/read operators: Spark-format .data/.index files, IPC
+streams, RSS hooks.
+
+Ref: datafusion-ext-plans shuffle_writer_exec.rs / rss_shuffle_writer_exec.rs
++ shuffle/{sort,bucket,single}_repartitioner.rs (write side) and
+ipc_reader_exec.rs / ipc_writer_exec.rs (read + broadcast side), with the
+file formats of SURVEY.md §2.6: one `.data` file of concatenated
+per-partition zstd frames and a little-endian u64 offsets `.index` file
+committed through Spark's IndexShuffleBlockResolver.
+
+TPU-first redesign of the repartitioner: partition ids are computed on
+device with the bit-exact Spark murmur3 kernel (exprs/hash.py), rows are
+grouped per partition by ONE variadic sort (no per-partition array builders
+or radix-sorted PI vectors), and the sorted batch is pulled to host once,
+then sliced into per-partition frames (columnar/serde.py). The on-mesh
+all_to_all variant lives in parallel/shuffle.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import BinaryIO, Callable, Iterable, Iterator, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from blaze_tpu.columnar import serde
+from blaze_tpu.columnar.batch import ColumnBatch
+from blaze_tpu.columnar.types import Schema
+from blaze_tpu.exprs import ir
+from blaze_tpu.exprs.compiler import compile_expr
+from blaze_tpu.exprs.hash import SPARK_SHUFFLE_SEED, hash_columns, pmod
+from blaze_tpu.ops.base import BatchStream, ExecContext, Operator, count_stream
+from blaze_tpu.ops.join import sort_batch_by_keys
+from blaze_tpu.runtime import jit_cache, resources
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class Partitioning:
+    """Ref: pb.PhysicalHashRepartition (blaze.proto) — hash | single |
+    round_robin over `num_partitions`."""
+    kind: str                       # "hash" | "single" | "round_robin"
+    num_partitions: int
+    key_exprs: tuple = ()           # hash only: ir.Expr tuple
+
+    def key(self) -> tuple:
+        return (self.kind, self.num_partitions,
+                tuple(e.key() for e in self.key_exprs))
+
+
+def partition_and_sort(batch: ColumnBatch, part: Partitioning,
+                       key_fns) -> tuple:
+    """(sorted batch grouped by partition id, per-partition counts)."""
+    P = part.num_partitions
+    mask = batch.row_mask()
+    if part.kind == "hash":
+        keys = [fn(batch) for fn in key_fns]
+        h = hash_columns(keys, SPARK_SHUFFLE_SEED, row_mask=mask)
+        pid = pmod(h, P)
+    elif part.kind == "single":
+        pid = jnp.zeros((batch.capacity,), jnp.int32)
+    elif part.kind == "round_robin":
+        pid = jnp.arange(batch.capacity, dtype=jnp.int32) % P
+    else:
+        raise ValueError(part.kind)
+    pid = jnp.where(mask, pid, jnp.int32(P))  # padding last
+    sorted_batch = sort_batch_by_keys(batch, [pid.astype(jnp.uint32)])
+    spid = jnp.sort(pid)
+    bounds = jnp.searchsorted(spid, jnp.arange(P + 1, dtype=jnp.int32))
+    counts = bounds[1:] - bounds[:-1]
+    return sorted_batch, counts
+
+
+class ShuffleWriterExec(Operator):
+    """Writes the Spark shuffle map output for this task's partition.
+
+    Ref: shuffle_writer_exec.rs — consumes the child stream, produces an
+    empty output stream; side effect is the committed .data/.index pair
+    (parsed by BlazeShuffleWriterBase.scala:84-96 into partitionLengths).
+    """
+
+    def __init__(self, child: Operator, partitioning: Partitioning,
+                 data_path: str, index_path: str) -> None:
+        super().__init__([child])
+        self.partitioning = partitioning
+        self.data_path = data_path
+        self.index_path = index_path
+        if partitioning.kind == "hash":
+            self._key_fns = [compile_expr(e, child.schema)
+                             for e in partitioning.key_exprs]
+        else:
+            self._key_fns = []
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def plan_key(self) -> tuple:
+        return ("shuffle_write", self.partitioning.key(),
+                self.children[0].plan_key())
+
+    def execute(self, ctx: ExecContext) -> BatchStream:
+        P = self.partitioning.num_partitions
+        buffers: List[List[bytes]] = [[] for _ in range(P)]
+
+        key = ("shuffle_part", self.plan_key())
+
+        for batch in self.children[0].execute(ctx):
+            ctx.check_running()
+            if int(batch.num_rows) == 0:
+                continue
+            with self.metrics.timer():
+                fn = jit_cache.get_or_compile(
+                    key + batch.shape_key(),
+                    lambda: (lambda b: partition_and_sort(
+                        b, self.partitioning, self._key_fns)))
+                sb, counts = fn(batch)
+                hb = serde.to_host(sb)
+                counts = np.asarray(counts)
+                offs = np.concatenate([[0], np.cumsum(counts)])
+                for p in range(P):
+                    if counts[p]:
+                        buffers[p].append(
+                            hb.serialize(int(offs[p]), int(offs[p + 1])))
+                self.metrics.add("data_size", sum(
+                    len(x) for b in buffers for x in b))
+
+        with self.metrics.timer():
+            lengths = self._commit(buffers)
+        self.metrics.add("shuffle_bytes_written", int(sum(lengths)))
+        return iter(())
+
+    def _commit(self, buffers: List[List[bytes]]) -> List[int]:
+        lengths = []
+        os.makedirs(os.path.dirname(self.data_path) or ".", exist_ok=True)
+        with open(self.data_path, "wb") as f:
+            for p_bufs in buffers:
+                start = f.tell()
+                for b in p_bufs:
+                    f.write(b)
+                lengths.append(f.tell() - start)
+        offsets = np.concatenate([[0], np.cumsum(lengths)]).astype("<u8")
+        with open(self.index_path, "wb") as f:
+            f.write(offsets.tobytes())
+        return lengths
+
+
+class RssPartitionWriterBase:
+    """Ref: Shims.scala:204-208 RssPartitionWriterBase — push interface for
+    remote shuffle services."""
+
+    def write(self, partition_id: int, payload: bytes) -> None:
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        pass
+
+
+class RssShuffleWriterExec(ShuffleWriterExec):
+    """Ref: rss_shuffle_writer_exec.rs — same repartitioning, pushes frames
+    to an RSS writer resource instead of committing local files."""
+
+    def __init__(self, child: Operator, partitioning: Partitioning,
+                 rss_resource_id: str) -> None:
+        super().__init__(child, partitioning, data_path="", index_path="")
+        self.rss_resource_id = rss_resource_id
+
+    def plan_key(self) -> tuple:
+        return ("rss_shuffle_write", self.partitioning.key(),
+                self.children[0].plan_key())
+
+    def execute(self, ctx: ExecContext) -> BatchStream:
+        P = self.partitioning.num_partitions
+        writer: RssPartitionWriterBase = resources.get(self.rss_resource_id)
+        key = ("shuffle_part", self.plan_key())
+        for batch in self.children[0].execute(ctx):
+            ctx.check_running()
+            if int(batch.num_rows) == 0:
+                continue
+            with self.metrics.timer():
+                fn = jit_cache.get_or_compile(
+                    key + batch.shape_key(),
+                    lambda: (lambda b: partition_and_sort(
+                        b, self.partitioning, self._key_fns)))
+                sb, counts = fn(batch)
+                hb = serde.to_host(sb)
+                counts = np.asarray(counts)
+                offs = np.concatenate([[0], np.cumsum(counts)])
+                for p in range(P):
+                    if counts[p]:
+                        writer.write(p, hb.serialize(int(offs[p]),
+                                                     int(offs[p + 1])))
+        writer.flush()
+        return iter(())
+
+
+def read_shuffle_partition(data_path: str, index_path: str, partition: int,
+                           schema: Schema) -> Iterator[ColumnBatch]:
+    """Reduce-side local read of one partition's frames (the FileSegment
+    zero-copy path of BlazeBlockStoreShuffleReaderBase, SURVEY.md §2.6)."""
+    offsets = np.frombuffer(open(index_path, "rb").read(), "<u8")
+    start, end = int(offsets[partition]), int(offsets[partition + 1])
+    with open(data_path, "rb") as f:
+        f.seek(start)
+        while f.tell() < end:
+            b = serde.read_batch(f, schema)
+            if b is None:
+                break
+            yield b
+
+
+class IpcReaderExec(Operator):
+    """Ref: ipc_reader_exec.rs — pulls serialized segments from a registered
+    provider (shuffle reader / broadcast) and decodes them to batches."""
+
+    def __init__(self, schema: Schema, resource_id: str,
+                 num_partitions: int = 1) -> None:
+        super().__init__([])
+        self._schema = schema
+        self.resource_id = resource_id
+        self.num_partitions = num_partitions
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def plan_key(self) -> tuple:
+        return ("ipc_reader", tuple(self._schema.names()))
+
+    def execute(self, ctx: ExecContext) -> BatchStream:
+        def gen():
+            provider = resources.get(self.resource_id)
+            source = provider() if callable(provider) else provider
+            for seg in source:
+                ctx.check_running()
+                if isinstance(seg, ColumnBatch):
+                    yield seg
+                elif isinstance(seg, (bytes, bytearray, memoryview)):
+                    yield serde.deserialize_batch(bytes(seg), self._schema)
+                else:  # file-like
+                    for b in serde.read_batches(seg, self._schema):
+                        yield b
+
+        return count_stream(self, gen())
+
+
+class IpcWriterExec(Operator):
+    """Ref: ipc_writer_exec.rs — serializes the child stream into
+    length-prefixed frames pushed to a registered consumer (broadcast
+    collect path, NativeBroadcastExchangeBase.scala:175-184)."""
+
+    def __init__(self, child: Operator, consumer_resource_id: str) -> None:
+        super().__init__([child])
+        self.consumer_resource_id = consumer_resource_id
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def plan_key(self) -> tuple:
+        return ("ipc_writer", self.children[0].plan_key())
+
+    def execute(self, ctx: ExecContext) -> BatchStream:
+        consumer: Callable[[bytes], None] = resources.get(
+            self.consumer_resource_id)
+        total = 0
+        for batch in self.children[0].execute(ctx):
+            ctx.check_running()
+            if int(batch.num_rows) == 0:
+                continue
+            with self.metrics.timer():
+                buf = serde.serialize_batch(batch)
+            consumer(buf)
+            total += len(buf)
+        self.metrics.add("ipc_bytes_written", total)
+        return iter(())

@@ -24,10 +24,11 @@ from blaze_tpu.runtime import jit_cache
 
 
 def sorted_batch_jit(batch: ColumnBatch, specs: Sequence[SortSpec],
-                     plan_key: tuple) -> ColumnBatch:
-    """Jit-cached whole-batch sort."""
-    key = ("sort_kernel", plan_key, tuple(s.key() for s in specs),
-           batch.shape_key())
+                     plan_key: tuple = ()) -> ColumnBatch:
+    """Jit-cached whole-batch sort. The cache key deliberately omits the
+    plan: the kernel depends only on specs + batch layout, so identical
+    sorts across different plans share one compilation."""
+    key = ("sort_kernel", tuple(s.key() for s in specs), batch.shape_key())
     fn = jit_cache.get_or_compile(
         key, lambda: (lambda b: sort_batch(b, specs)))
     return fn(batch)

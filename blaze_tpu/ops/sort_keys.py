@@ -63,12 +63,18 @@ def _float_total_order(x: Array) -> List[Array]:
     return bits64.f64_total_order_keys(x)
 
 
-def string_words(s: StringData, max_words: Optional[int] = None) -> List[Array]:
-    """Big-endian uint64 word columns of the padded byte matrix."""
+def string_words(s: StringData, max_words: Optional[int] = None,
+                 exact_words: Optional[int] = None) -> List[Array]:
+    """Big-endian uint64 word columns of the padded byte matrix.
+
+    `exact_words` pads/truncates to a fixed word count so two sides of a
+    join emit identical key layouts regardless of width buckets."""
     cap, w = s.bytes.shape
     nwords = (w + 7) // 8
     if max_words is not None:
         nwords = min(nwords, max_words)
+    if exact_words is not None:
+        nwords = exact_words
     padded_w = nwords * 8
     b = s.bytes[:, :padded_w] if padded_w <= w else jnp.pad(
         s.bytes, ((0, 0), (0, padded_w - w)))
@@ -81,6 +87,7 @@ def string_words(s: StringData, max_words: Optional[int] = None) -> List[Array]:
 def encode_column(col: Column, asc: bool, nulls_first: bool,
                   row_mask: Array,
                   max_string_words: int = DEFAULT_MAX_STRING_WORDS,
+                  exact_string_words: Optional[int] = None,
                   ) -> List[Array]:
     """Key arrays for one column; earlier arrays are more significant."""
     keys: List[Array] = []
@@ -93,7 +100,7 @@ def encode_column(col: Column, asc: bool, nulls_first: bool,
 
     k = col.dtype.kind
     if col.is_string:
-        vals = string_words(col.data, max_string_words)
+        vals = string_words(col.data, max_string_words, exact_string_words)
         vals.append(col.data.lengths.astype(jnp.uint32))
     elif k == TypeKind.BOOLEAN:
         vals = [col.data.astype(jnp.uint8)]

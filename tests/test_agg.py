@@ -1,0 +1,199 @@
+"""AggExec vs pandas oracle — partial/merge/final pipelines, nulls, strings.
+
+Mirrors the reference's agg_exec.rs:528 e2e tests over MemoryExec plus the
+partial/final pairing contract (NativeAggBase, SURVEY.md §2.2)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from blaze_tpu.columnar import types as T
+from blaze_tpu.columnar.batch import ColumnBatch
+from blaze_tpu.exprs import ir
+from blaze_tpu.ops.agg import AggCall, AggExec, AggMode
+from blaze_tpu.ops.basic import MemorySourceExec
+from blaze_tpu.runtime.executor import collect
+
+SCHEMA = T.Schema([
+    T.Field("k", T.INT64),
+    T.Field("v", T.FLOAT64),
+    T.Field("n", T.INT32),
+    T.Field("s", T.STRING),
+])
+
+
+def _batches(rng, sizes, null_frac=0.0, nkeys=9):
+    out = []
+    for i, n in enumerate(sizes):
+        data = {
+            "k": rng.integers(0, nkeys, n).astype(np.int64),
+            "v": rng.random(n) * 10 - 5,
+            "n": rng.integers(-100, 100, n).astype(np.int32),
+            "s": [f"s{j}" for j in rng.integers(0, 30, n)],
+        }
+        validity = None
+        if null_frac:
+            validity = {c: rng.random(n) > null_frac for c in ("v", "n", "s")}
+        out.append(ColumnBatch.from_numpy(data, SCHEMA, validity=validity))
+    return out
+
+
+def _to_df(batches):
+    frames = []
+    for b in batches:
+        d = b.to_numpy()
+        frames.append(pd.DataFrame({
+            "k": np.asarray(d["k"]),
+            "v": [x for x in d["v"]],
+            "n": [x for x in d["n"]],
+            "s": [x.decode() if x is not None else None for x in d["s"]],
+        }))
+    return pd.concat(frames, ignore_index=True)
+
+
+def _agg_plan(src, mode_pairs, aggs):
+    """Build partial -> (partial_merge ->) final chain."""
+    node = src
+    for mode in mode_pairs:
+        node = AggExec(node, [ir.col("k")] if mode_groups else [], ["k"],
+                       aggs, mode)
+    return node
+
+
+CALLS = [
+    AggCall("sum", (ir.col("v"),), T.FLOAT64, "sum_v"),
+    AggCall("count", (ir.col("v"),), T.INT64, "cnt_v"),
+    AggCall("avg", (ir.col("v"),), T.FLOAT64, "avg_v"),
+    AggCall("min", (ir.col("n"),), T.INT32, "min_n"),
+    AggCall("max", (ir.col("n"),), T.INT32, "max_n"),
+    AggCall("min", (ir.col("s"),), T.STRING, "min_s"),
+    AggCall("max", (ir.col("s"),), T.STRING, "max_s"),
+    AggCall("first", (ir.col("v"),), T.FLOAT64, "first_v"),
+    AggCall("first_ignores_null", (ir.col("v"),), T.FLOAT64, "firstnn_v"),
+]
+
+mode_groups = True
+
+
+@pytest.mark.parametrize("null_frac", [0.0, 0.35])
+@pytest.mark.parametrize("chain", [
+    [AggMode.PARTIAL, AggMode.FINAL],
+    [AggMode.PARTIAL, AggMode.PARTIAL_MERGE, AggMode.FINAL],
+])
+def test_grouped_agg_vs_pandas(rng, null_frac, chain):
+    batches = _batches(rng, [200, 57, 130], null_frac=null_frac)
+    node = MemorySourceExec(batches, SCHEMA)
+    for mode in chain:
+        node = AggExec(node, [ir.col("k")], ["k"], CALLS, mode)
+    out = collect(node)
+    d = out.to_numpy()
+    got = pd.DataFrame({
+        "k": np.asarray(d["k"]),
+        "sum_v": [x for x in d["sum_v"]],
+        "cnt_v": np.asarray(d["cnt_v"]),
+        "avg_v": [x for x in d["avg_v"]],
+        "min_n": [x for x in d["min_n"]],
+        "max_n": [x for x in d["max_n"]],
+        "min_s": [x.decode() if x is not None else None for x in d["min_s"]],
+        "max_s": [x.decode() if x is not None else None for x in d["max_s"]],
+    }).sort_values("k").reset_index(drop=True)
+
+    df = _to_df(batches)
+    want = df.groupby("k").agg(
+        sum_v=("v", lambda x: x.dropna().sum() if x.notna().any() else None),
+        cnt_v=("v", lambda x: x.notna().sum()),
+        avg_v=("v", lambda x: x.dropna().mean() if x.notna().any() else None),
+        min_n=("n", lambda x: x.dropna().min() if x.notna().any() else None),
+        max_n=("n", lambda x: x.dropna().max() if x.notna().any() else None),
+        min_s=("s", lambda x: x.dropna().min() if x.notna().any() else None),
+        max_s=("s", lambda x: x.dropna().max() if x.notna().any() else None),
+    ).reset_index().sort_values("k").reset_index(drop=True)
+
+    assert got["k"].tolist() == want["k"].tolist()
+    for c in ("sum_v", "avg_v"):
+        for g, w in zip(got[c], want[c]):
+            if w is None or (isinstance(w, float) and np.isnan(w)):
+                assert g is None
+            else:
+                np.testing.assert_allclose(float(g), float(w), rtol=1e-9)
+    assert got["cnt_v"].tolist() == want["cnt_v"].tolist()
+    for c in ("min_n", "max_n", "min_s", "max_s"):
+        got_l = [None if x is None else x for x in got[c]]
+        want_l = [None if (w is None or (isinstance(w, float) and np.isnan(w)))
+                  else w for w in want[c]]
+        assert got_l == want_l, c
+
+
+def test_first_semantics(rng):
+    # first = first value in stream order (validity preserved)
+    data = {"k": np.array([1, 1, 2, 2], np.int64),
+            "v": np.array([9.0, 1.0, 3.0, 4.0]),
+            "n": np.zeros(4, np.int32), "s": ["a", "b", "c", "d"]}
+    validity = {"v": np.array([False, True, True, True])}
+    b = ColumnBatch.from_numpy(data, SCHEMA, validity=validity)
+    node = MemorySourceExec([b], SCHEMA)
+    calls = [AggCall("first", (ir.col("v"),), T.FLOAT64, "f"),
+             AggCall("first_ignores_null", (ir.col("v"),), T.FLOAT64, "fnn")]
+    for mode in (AggMode.PARTIAL, AggMode.FINAL):
+        node = AggExec(node, [ir.col("k")], ["k"], calls, mode)
+    d = collect(node).to_numpy()
+    by_k = {int(k): (f, fnn) for k, f, fnn in zip(d["k"], d["f"], d["fnn"])}
+    assert by_k[1][0] is None          # first v of k=1 is null
+    assert float(by_k[1][1]) == 1.0    # first non-null is 1.0
+    assert float(by_k[2][0]) == 3.0
+    assert float(by_k[2][1]) == 3.0
+
+
+def test_global_agg(rng):
+    batches = _batches(rng, [100, 50])
+    node = MemorySourceExec(batches, SCHEMA)
+    calls = [AggCall("sum", (ir.col("v"),), T.FLOAT64, "s"),
+             AggCall("count", (ir.lit(1),), T.INT64, "c")]
+    for mode in (AggMode.PARTIAL, AggMode.FINAL):
+        node = AggExec(node, [], [], calls, mode)
+    out = collect(node)
+    assert int(out.num_rows) == 1
+    d = out.to_numpy()
+    df = _to_df(batches)
+    np.testing.assert_allclose(float(d["s"][0]), df["v"].sum(), rtol=1e-9)
+    assert int(np.asarray(d["c"])[0]) == len(df)
+
+
+def test_global_agg_empty_input():
+    node = MemorySourceExec([], SCHEMA)
+    calls = [AggCall("sum", (ir.col("v"),), T.FLOAT64, "s"),
+             AggCall("count", (ir.lit(1),), T.INT64, "c")]
+    for mode in (AggMode.PARTIAL, AggMode.FINAL):
+        node = AggExec(node, [], [], calls, mode)
+    out = collect(node)
+    assert int(out.num_rows) == 1
+    d = out.to_numpy()
+    assert d["s"][0] is None
+    assert int(np.asarray(d["c"])[0]) == 0
+
+
+def test_grouped_agg_empty_input():
+    node = MemorySourceExec([], SCHEMA)
+    calls = [AggCall("sum", (ir.col("v"),), T.FLOAT64, "s")]
+    for mode in (AggMode.PARTIAL, AggMode.FINAL):
+        node = AggExec(node, [ir.col("k")], ["k"], calls, mode)
+    out = collect(node)
+    assert int(out.num_rows) == 0
+
+
+def test_streaming_collapse(rng):
+    # small collapse threshold forces the hierarchical fold path
+    batches = _batches(rng, [64] * 10)
+    node = MemorySourceExec(batches, SCHEMA)
+    calls = [AggCall("sum", (ir.col("v"),), T.FLOAT64, "s"),
+             AggCall("count", (ir.col("v"),), T.INT64, "c")]
+    p = AggExec(node, [ir.col("k")], ["k"], calls, AggMode.PARTIAL,
+                collapse_threshold=100)
+    f = AggExec(p, [ir.col("k")], ["k"], calls, AggMode.FINAL)
+    d = collect(f).to_numpy()
+    df = _to_df(batches)
+    want = df.groupby("k")["v"].sum()
+    got = {int(k): float(s) for k, s in zip(d["k"], d["s"])}
+    for k, w in want.items():
+        np.testing.assert_allclose(got[int(k)], w, rtol=1e-9)
+    assert p.metrics["collapses"] >= 1

@@ -1,0 +1,258 @@
+"""Join engine vs pandas oracle — all join types, nulls, duplicates, strings.
+
+Mirrors the reference's SMJ test battery (sort_merge_join_exec.rs:1024+,
+~15 cases incl. inner/left/right/full/semi/anti with nulls and small batch
+chunking) plus BHJ build-side reversal (BlazeConverters.scala:420-434)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from blaze_tpu.columnar import types as T
+from blaze_tpu.columnar.batch import ColumnBatch
+from blaze_tpu.exprs import ir
+from blaze_tpu.ops.basic import MemorySourceExec
+from blaze_tpu.ops.join import (
+    BroadcastNestedLoopJoinExec, JoinKey, JoinType, SortMergeJoinExec,
+)
+from blaze_tpu.runtime.executor import collect
+
+LS = T.Schema([T.Field("lk", T.INT64), T.Field("lv", T.FLOAT64)])
+RS = T.Schema([T.Field("rk", T.INT64), T.Field("rv", T.FLOAT64)])
+
+
+def _mk(schema, k, v, validity=None, cap=None):
+    names = schema.names()
+    return ColumnBatch.from_numpy(
+        {names[0]: np.asarray(k, np.int64), names[1]: np.asarray(v)},
+        schema, validity=validity, capacity=cap)
+
+
+def _df(batch):
+    d = batch.to_numpy()
+    return pd.DataFrame({k: [x for x in v] if not isinstance(v, np.ndarray)
+                         else v for k, v in d.items()})
+
+
+def _rows(df):
+    out = []
+    for t in df.itertuples(index=False):
+        out.append(tuple(None if (isinstance(x, float) and np.isnan(x))
+                         else x for x in t))
+    return sorted(out, key=repr)
+
+
+def _oracle(ldf, rdf, how):
+    m = ldf.merge(rdf, left_on="lk", right_on="rk", how=how)
+    return m
+
+
+@pytest.mark.parametrize("jt,how", [
+    (JoinType.INNER, "inner"),
+    (JoinType.LEFT, "left"),
+    (JoinType.RIGHT, "right"),
+    (JoinType.FULL, "outer"),
+])
+def test_join_types_with_dups(rng, jt, how):
+    lk = rng.integers(0, 20, 150)
+    rk = rng.integers(0, 20, 80)
+    left = _mk(LS, lk, rng.random(150))
+    right = _mk(RS, rk, rng.random(80))
+    j = SortMergeJoinExec(MemorySourceExec([left], LS),
+                          MemorySourceExec([right], RS),
+                          [JoinKey(0, 0)], jt)
+    out = collect(j)
+    got = _rows(_df(out))
+    want = _rows(_oracle(_df(left), _df(right), how))
+    assert got == want
+
+
+@pytest.mark.parametrize("jt", [JoinType.INNER, JoinType.LEFT, JoinType.FULL])
+def test_join_with_null_keys(rng, jt):
+    n = 60
+    lk = rng.integers(0, 8, n)
+    lnull = rng.random(n) > 0.7
+    rk = rng.integers(0, 8, 40)
+    rnull = rng.random(40) > 0.7
+    left = _mk(LS, lk, rng.random(n), validity={"lk": ~lnull})
+    right = _mk(RS, rk, rng.random(40), validity={"rk": ~rnull})
+    j = SortMergeJoinExec(MemorySourceExec([left], LS),
+                          MemorySourceExec([right], RS),
+                          [JoinKey(0, 0)], jt)
+    got = _rows(_df(collect(j)))
+    how = {"inner": "inner", "left": "left", "full": "outer"}[jt.value]
+    # pandas merge matches NaN keys to each other; Spark does not — build a
+    # null-correct oracle by joining non-null keys and appending unmatched
+    ldf, rdf = _df(left), _df(right)
+    lm, rm = ldf.dropna(subset=["lk"]), rdf.dropna(subset=["rk"])
+    inner = lm.merge(rm, left_on="lk", right_on="rk", how="inner")
+    parts = [inner]
+    rkeys, lkeys = set(rm["rk"]), set(lm["lk"])
+    if how in ("left", "outer"):
+        un = ldf[[pd.isna(k) or k not in rkeys for k in ldf["lk"]]].copy()
+        un["rk"] = np.nan
+        un["rv"] = np.nan
+        parts.append(un)
+    if how == "outer":
+        un = rdf[[pd.isna(k) or k not in lkeys for k in rdf["rk"]]].copy()
+        un.insert(0, "lk", np.nan)
+        un.insert(1, "lv", np.nan)
+        parts.append(un)
+    want = _rows(pd.concat(parts, ignore_index=True))
+    assert got == want
+
+
+def test_semi_anti_existence(rng):
+    lk = rng.integers(0, 30, 100)
+    rk = rng.integers(0, 15, 50)
+    left = _mk(LS, lk, rng.random(100))
+    right = _mk(RS, rk, rng.random(50))
+    rset = set(rk.tolist())
+
+    semi = collect(SortMergeJoinExec(
+        MemorySourceExec([left], LS), MemorySourceExec([right], RS),
+        [JoinKey(0, 0)], JoinType.LEFT_SEMI))
+    want_semi = sorted(k for k in lk if k in rset)
+    assert sorted(np.asarray(semi.to_numpy()["lk"]).tolist()) == want_semi
+
+    anti = collect(SortMergeJoinExec(
+        MemorySourceExec([left], LS), MemorySourceExec([right], RS),
+        [JoinKey(0, 0)], JoinType.LEFT_ANTI))
+    want_anti = sorted(k for k in lk if k not in rset)
+    assert sorted(np.asarray(anti.to_numpy()["lk"]).tolist()) == want_anti
+
+    ex = collect(SortMergeJoinExec(
+        MemorySourceExec([left], LS), MemorySourceExec([right], RS),
+        [JoinKey(0, 0)], JoinType.EXISTENCE))
+    d = ex.to_numpy()
+    for k, e in zip(np.asarray(d["lk"]), np.asarray(d["exists"])):
+        assert bool(e) == (int(k) in rset)
+
+
+def test_build_side_left(rng):
+    # BHJ with build side = left: same results, left++right column order
+    lk = rng.integers(0, 10, 70)
+    rk = rng.integers(0, 10, 90)
+    left = _mk(LS, lk, rng.random(70))
+    right = _mk(RS, rk, rng.random(90))
+    for jt, how in [(JoinType.INNER, "inner"), (JoinType.LEFT, "left"),
+                    (JoinType.RIGHT, "right")]:
+        j = SortMergeJoinExec(MemorySourceExec([left], LS),
+                              MemorySourceExec([right], RS),
+                              [JoinKey(0, 0)], jt, build_is_left=True)
+        got = _rows(_df(collect(j)))
+        want = _rows(_oracle(_df(left), _df(right), how))
+        assert got == want, jt
+
+
+def test_multi_key_and_string_key(rng):
+    ls = T.Schema([T.Field("k1", T.INT64), T.Field("ks", T.STRING),
+                   T.Field("lv", T.FLOAT64)])
+    rs = T.Schema([T.Field("k1", T.INT64), T.Field("ks", T.STRING),
+                   T.Field("rv", T.FLOAT64)])
+    n, m = 80, 60
+    l1 = rng.integers(0, 5, n)
+    lsx = [f"g{i}" for i in rng.integers(0, 4, n)]
+    r1 = rng.integers(0, 5, m)
+    rsx = [f"g{i}" for i in rng.integers(0, 4, m)]
+    left = ColumnBatch.from_numpy(
+        {"k1": l1.astype(np.int64), "ks": lsx, "lv": rng.random(n)}, ls)
+    right = ColumnBatch.from_numpy(
+        {"k1": r1.astype(np.int64), "ks": rsx, "rv": rng.random(m)}, rs)
+    j = SortMergeJoinExec(MemorySourceExec([left], ls),
+                          MemorySourceExec([right], rs),
+                          [JoinKey(0, 0), JoinKey(1, 1)], JoinType.INNER)
+    out = _df(collect(j))
+    ldf = pd.DataFrame({"k1": l1, "ks": lsx, "lv": left.to_numpy()["lv"]})
+    rdf = pd.DataFrame({"k1": r1, "ks": rsx, "rv": right.to_numpy()["rv"]})
+    want = ldf.merge(rdf, on=["k1", "ks"], how="inner")
+    assert len(out) == len(want)
+    out2 = out.copy()
+    out2["ks"] = [s.decode() for s in out["ks"]]
+    got = sorted(map(tuple, out2[["k1", "ks", "lv", "rv"]].itertuples(
+        index=False)))
+    wn = want.rename(columns={"k1_x": "k1"}) if "k1_x" in want else want
+    wanted = sorted(map(tuple, wn[["k1", "ks", "lv", "rv"]].itertuples(
+        index=False)))
+    for g, w in zip(got, wanted):
+        assert g[0] == w[0] and g[1] == w[1]
+        np.testing.assert_allclose(g[2:], w[2:], rtol=1e-9)
+
+
+def test_null_safe_equal(rng):
+    left = _mk(LS, [1, 2, 3], [1.0, 2.0, 3.0],
+               validity={"lk": np.array([True, False, True])})
+    right = _mk(RS, [1, 9, 9], [10.0, 20.0, 30.0],
+                validity={"rk": np.array([True, False, False])})
+    j = SortMergeJoinExec(MemorySourceExec([left], LS),
+                          MemorySourceExec([right], RS),
+                          [JoinKey(0, 0, null_safe=True)], JoinType.INNER)
+    d = collect(j).to_numpy()
+    pairs = sorted(zip([x for x in d["lv"]], [x for x in d["rv"]]))
+    # null key matches both null right keys; 1 matches 1
+    assert pairs == [(1.0, 10.0), (2.0, 20.0), (2.0, 30.0)]
+
+
+def test_streamed_probe_batches(rng):
+    batches = [
+        _mk(LS, rng.integers(0, 12, 40), rng.random(40)) for _ in range(4)]
+    right = _mk(RS, rng.integers(0, 12, 30), rng.random(30))
+    j = SortMergeJoinExec(MemorySourceExec(batches, LS),
+                          MemorySourceExec([right], RS),
+                          [JoinKey(0, 0)], JoinType.FULL)
+    got = _rows(_df(collect(j)))
+    ldf = pd.concat([_df(b) for b in batches], ignore_index=True)
+    want = _rows(_oracle(ldf, _df(right), "outer"))
+    assert got == want
+
+
+def test_empty_sides(rng):
+    left = _mk(LS, rng.integers(0, 5, 20), rng.random(20))
+    empty_r = MemorySourceExec([], RS)
+    # inner with empty build -> no rows
+    out = collect(SortMergeJoinExec(MemorySourceExec([left], LS), empty_r,
+                                    [JoinKey(0, 0)], JoinType.INNER))
+    assert int(out.num_rows) == 0
+    # left outer with empty build -> all left rows, right nulls
+    out = collect(SortMergeJoinExec(
+        MemorySourceExec([left], LS), MemorySourceExec([], RS),
+        [JoinKey(0, 0)], JoinType.LEFT))
+    assert int(out.num_rows) == 20
+    assert all(v is None for v in out.to_numpy()["rv"])
+
+
+def test_inner_join_filter(rng):
+    left = _mk(LS, [1, 1, 2], [1.0, 5.0, 2.0])
+    right = _mk(RS, [1, 1, 2], [3.0, 9.0, 1.0])
+    j = SortMergeJoinExec(
+        MemorySourceExec([left], LS), MemorySourceExec([right], RS),
+        [JoinKey(0, 0)], JoinType.INNER,
+        join_filter=ir.Binary(ir.BinOp.LT, ir.col("lv"), ir.col("rv")))
+    d = collect(j).to_numpy()
+    pairs = sorted(zip([x for x in d["lv"]], [x for x in d["rv"]]))
+    assert pairs == [(1.0, 3.0), (1.0, 9.0), (2.0, 2.0)][:2] + [(5.0, 9.0)]
+
+
+def test_bnlj_cross_and_condition(rng):
+    left = _mk(LS, [1, 2], [1.0, 2.0])
+    right = _mk(RS, [7, 8, 9], [0.5, 1.5, 2.5])
+    cross = collect(BroadcastNestedLoopJoinExec(
+        MemorySourceExec([left], LS), MemorySourceExec([right], RS),
+        JoinType.INNER))
+    assert int(cross.num_rows) == 6
+    cond = collect(BroadcastNestedLoopJoinExec(
+        MemorySourceExec([left], LS), MemorySourceExec([right], RS),
+        JoinType.INNER,
+        condition=ir.Binary(ir.BinOp.GT, ir.col("lv"), ir.col("rv"))))
+    d = cond.to_numpy()
+    pairs = sorted(zip([x for x in d["lv"]], [x for x in d["rv"]]))
+    assert pairs == [(1.0, 0.5), (2.0, 0.5), (2.0, 1.5)]
+    louter = collect(BroadcastNestedLoopJoinExec(
+        MemorySourceExec([left], LS), MemorySourceExec([right], RS),
+        JoinType.LEFT,
+        condition=ir.Binary(ir.BinOp.GT, ir.col("lv"),
+                            ir.Binary(ir.BinOp.MUL, ir.col("rv"),
+                                      ir.lit(100.0)))))
+    d = louter.to_numpy()
+    assert int(louter.num_rows) == 2
+    assert all(v is None for v in d["rv"])

@@ -102,3 +102,27 @@ def test_string_group_boundaries(rng):
     b = sort_batch(b, [SortSpec(0)])
     layout = seg.group_layout(b, [0])
     assert int(layout.num_groups) == 4  # "", aa, ab, b
+
+
+def test_seg_minmax_nan_inf_semantics(rng):
+    # Spark: NaN is the greatest value; nulls skipped; inf preserved
+    k = np.array([0, 0, 1, 1, 2, 2, 3, 3], np.int64)
+    v = np.array([1.0, np.nan, np.nan, np.nan, np.inf, 5.0, -np.inf, 2.0])
+    validity = {"v": np.array([True, True, True, False, False, True,
+                               True, True])}
+    b = ColumnBatch.from_numpy({"k": k, "v": v}, SCHEMA, validity=validity)
+    b = sort_batch(b, [SortSpec(0)])
+    layout = seg.group_layout(b, [0])
+    vcol = b.columns[1]
+    mins, mok = seg.seg_min(vcol.data, layout, vcol.valid_mask())
+    maxs, xok = seg.seg_max(vcol.data, layout, vcol.valid_mask())
+    mins, maxs = np.asarray(mins), np.asarray(maxs)
+    # group 0: {1.0, NaN} -> min 1.0, max NaN
+    assert mins[0] == 1.0 and np.isnan(maxs[0])
+    # group 1: {NaN, NULL} -> min NaN, max NaN
+    assert np.isnan(mins[1]) and np.isnan(maxs[1])
+    # group 2: {NULL, 5.0} -> 5.0 / 5.0
+    assert mins[2] == 5.0 and maxs[2] == 5.0
+    # group 3: {-inf, 2.0} -> -inf / 2.0
+    assert mins[3] == -np.inf and maxs[3] == 2.0
+    assert all(np.asarray(mok)[:4]) and all(np.asarray(xok)[:4])
