@@ -132,6 +132,22 @@ def column_from_arrow(arr, dtype: T.DataType, cap: int) -> Column:
         arr = arr.cast(arr.type.value_type)
     n = len(arr)
     validity = _validity_np(arr)
+    if dtype.kind == T.TypeKind.LIST:
+        from blaze_tpu.columnar.batch import ListData
+
+        la = arr.cast(pa.large_list(dtype_to_arrow(dtype.element)))
+        offs_raw = np.frombuffer(la.buffers()[1], np.int64,
+                                 count=n + 1, offset=la.offset * 8)
+        base = offs_raw[0]
+        lens = (offs_raw[1:] - offs_raw[:-1]).astype(np.int32)
+        offsets = np.zeros((cap + 1,), np.int32)
+        offsets[1:n + 1] = np.cumsum(lens)
+        offsets[n + 1:] = offsets[n]
+        flat = la.values.slice(base, offs_raw[-1] - base)
+        ecap = bucket_capacity(len(flat))
+        elems = column_from_arrow(flat, dtype.element, ecap)
+        return Column(dtype, ListData(jnp.asarray(offsets), elems),
+                      _pad_validity(validity, n, cap))
     if dtype.is_string_like:
         mat, lens = _varbin_to_fixed(arr, cap)
         col = Column(dtype, StringData(jnp.asarray(mat), jnp.asarray(lens)),
@@ -174,6 +190,12 @@ def batch_to_arrow(batch: ColumnBatch) -> pa.RecordBatch:
     arrays: List[pa.Array] = []
     for f, c in zip(batch.schema, batch.columns):
         valid = np.asarray(c.valid_mask())[:n]
+        if c.is_list:
+            sub = ColumnBatch(T.Schema([T.Field(f.name, f.dtype)]), [c],
+                              batch.num_rows, batch.capacity)
+            vals = sub.to_numpy()[f.name]
+            arrays.append(pa.array(vals, dtype_to_arrow(f.dtype)))
+            continue
         if c.is_string:
             b = np.asarray(c.data.bytes)[:n]
             l = np.asarray(c.data.lengths)[:n]

@@ -86,18 +86,50 @@ class StringData:
 
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
+class ListData:
+    """list<T> storage: per-row [offset, offset+length) into a flat element
+    column. Element storage has its own (bucketed) capacity; rows beyond
+    num_rows have length 0. The layout mirrors Arrow's offsets+child but
+    with static capacities so explode/collect stay jit-compilable."""
+
+    offsets: Array        # int32 (capacity + 1,), monotone
+    elements: "Column"    # flat element column
+
+    @property
+    def capacity(self) -> int:
+        return self.offsets.shape[0] - 1
+
+    def lengths(self) -> Array:
+        return self.offsets[1:] - self.offsets[:-1]
+
+    def tree_flatten(self):
+        return (self.offsets, self.elements), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
 class Column:
     dtype: DataType
-    data: Union[Array, StringData]
+    data: Union[Array, StringData, ListData]
     validity: Optional[Array] = None  # bool (capacity,); None = all valid
 
     @property
     def capacity(self) -> int:
-        return self.data.capacity if isinstance(self.data, StringData) else self.data.shape[0]
+        if isinstance(self.data, (StringData, ListData)):
+            return self.data.capacity
+        return self.data.shape[0]
 
     @property
     def is_string(self) -> bool:
         return isinstance(self.data, StringData)
+
+    @property
+    def is_list(self) -> bool:
+        return isinstance(self.data, ListData)
 
     def valid_mask(self) -> Array:
         if self.validity is None:
@@ -106,7 +138,7 @@ class Column:
 
     def normalized(self) -> "Column":
         """Zero out data in invalid slots (canonical form for hash/sort/serde)."""
-        if self.validity is None:
+        if self.validity is None or self.is_list:
             return self
         if self.is_string:
             v = self.validity
@@ -117,10 +149,17 @@ class Column:
         return Column(self.dtype, jnp.where(self.validity, self.data, zero), self.validity)
 
     def take(self, indices: Array, *, index_valid: Optional[Array] = None) -> "Column":
-        """Gather rows by index. `index_valid=False` slots become null."""
+        """Gather rows by index. `index_valid=False` slots become null.
+
+        List columns: element storage capacity is preserved — valid for
+        permutations/subsets (sort, filter, limit), NOT for fan-out takes
+        (join expansion over list columns would overflow it).
+        """
         idx = jnp.clip(indices, 0, self.capacity - 1)
         v = self.validity
-        if self.is_string:
+        if self.is_list:
+            data = _list_take(self.data, idx)
+        elif self.is_string:
             data = StringData(self.data.bytes[idx], self.data.lengths[idx])
         else:
             data = self.data[idx]
@@ -186,10 +225,7 @@ class ColumnBatch:
         """Jit-cache shape-bucket signature (capacity, per-column layout)."""
         parts: list = [self.capacity]
         for c in self.columns:
-            if c.is_string:
-                parts.append(("s", c.data.width, c.validity is not None))
-            else:
-                parts.append((str(c.data.dtype), c.validity is not None))
+            parts.append(_col_shape_key(c))
         return tuple(parts)
 
     def live_valid(self, i: int) -> Array:
@@ -232,12 +268,25 @@ class ColumnBatch:
 
     # ---- host export (tests / serde) ----
     def to_numpy(self) -> Dict[str, object]:
-        """Pull live rows to host. Strings -> list[bytes|None]; numerics ->
-        numpy masked to live rows with None for nulls (object arrays)."""
+        """Pull live rows to host. Strings -> list[bytes|None]; lists ->
+        list[list|None]; numerics -> numpy masked to live rows with None
+        for nulls (object arrays)."""
         n = int(self.num_rows)
         out: Dict[str, object] = {}
         for f, c in zip(self.schema, self.columns):
             valid = np.asarray(c.valid_mask())[:n]
+            if c.is_list:
+                offs = np.asarray(c.data.offsets)
+                esub = ColumnBatch(
+                    Schema([Field("e", c.data.elements.dtype)]),
+                    [c.data.elements],
+                    jnp.asarray(int(offs[n]), jnp.int32),
+                    c.data.elements.capacity)
+                elems = esub.to_numpy()["e"]
+                vals = [list(elems[offs[i]:offs[i + 1]]) if valid[i] else None
+                        for i in range(n)]
+                out[f.name] = vals
+                continue
             if c.is_string:
                 b = np.asarray(c.data.bytes)[:n]
                 l = np.asarray(c.data.lengths)[:n]
@@ -263,17 +312,66 @@ class ColumnBatch:
         return cls(schema, list(columns), num_rows, capacity)
 
 
+def _col_shape_key(c: Column) -> tuple:
+    if c.is_list:
+        return ("l", c.data.elements.capacity,
+                _col_shape_key(c.data.elements), c.validity is not None)
+    if c.is_string:
+        return ("s", c.data.width, c.validity is not None)
+    return (str(c.data.dtype), c.validity is not None)
+
+
+def _list_take(ld: ListData, idx: Array) -> ListData:
+    """Gather list rows: rebuild offsets from gathered lengths and compact
+    the referenced element ranges to the front of the element storage."""
+    lens = ld.lengths()[idx]
+    new_off = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                               jnp.cumsum(lens, dtype=jnp.int32)])
+    ecap = ld.elements.capacity
+    out_rows = idx.shape[0]
+    # element slot j of output: which output row + which position within it
+    slot = jnp.arange(ecap, dtype=jnp.int32)
+    row = jnp.searchsorted(new_off[1:out_rows + 1], slot, side="right")
+    row = jnp.clip(row, 0, out_rows - 1)
+    within = slot - new_off[row]
+    src = ld.offsets[idx[row]] + within
+    live = slot < new_off[out_rows]
+    elems = ld.elements.take(jnp.where(live, src, 0))
+    return ListData(new_off, elems)
+
+
 def _zero_column(dtype: DataType, cap: int) -> Column:
     if dtype.is_string_like:
         w = bucket_width(1)
         return Column(dtype, StringData(jnp.zeros((cap, w), jnp.uint8),
                                         jnp.zeros((cap,), jnp.int32)), None)
+    if dtype.kind == TypeKind.LIST:
+        return Column(dtype, ListData(jnp.zeros((cap + 1,), jnp.int32),
+                                      _zero_column(dtype.element,
+                                                   bucket_capacity(0))),
+                      None)
     if dtype.kind == TypeKind.NULL:
         return Column(dtype, jnp.zeros((cap,), jnp.int8), jnp.zeros((cap,), jnp.bool_))
     return Column(dtype, jnp.zeros((cap,), dtype.jnp_dtype()), None)
 
 
 def _host_to_column(dtype: DataType, raw, cap: int, validity_np: Optional[np.ndarray]) -> Column:
+    if dtype.kind == TypeKind.LIST:
+        vals = list(raw)
+        if validity_np is None and any(v is None for v in vals):
+            validity_np = np.array([v is not None for v in vals], bool)
+        vals = [v if v is not None else [] for v in vals]
+        n = len(vals)
+        lens = np.zeros((cap,), np.int32)
+        lens[:n] = [len(v) for v in vals]
+        offsets = np.zeros((cap + 1,), np.int32)
+        offsets[1:] = np.cumsum(lens)
+        flat = [x for v in vals for x in v]
+        ecap = bucket_capacity(len(flat))
+        elems = _host_to_column(dtype.element, flat, ecap, None)
+        return Column(dtype,
+                      ListData(jnp.asarray(offsets), elems),
+                      _pad_validity(validity_np, n, cap))
     if dtype.is_string_like:
         vals = [v if v is not None else b"" for v in raw]
         vals = [v.encode() if isinstance(v, str) else bytes(v) for v in vals]

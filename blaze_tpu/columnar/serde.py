@@ -41,10 +41,12 @@ MAGIC = b"BTB1"
 
 @dataclasses.dataclass
 class _HostCol:
-    kind: str                      # "num" | "str" | "null"
+    kind: str                      # "num" | "str" | "list" | "null"
     data: Optional[np.ndarray]     # (n,) values | (n, W) bytes | None
-    lengths: Optional[np.ndarray]  # strings only
+    lengths: Optional[np.ndarray]  # strings/lists: per-row lengths
     validity: Optional[np.ndarray]
+    child: Optional["_HostCol"] = None        # lists: element column
+    child_offsets: Optional[np.ndarray] = None  # lists: (n+1,) elem offsets
 
 
 @dataclasses.dataclass
@@ -61,24 +63,7 @@ class HostBatch:
         out = io.BytesIO()
         out.write(struct.pack("<IH", n, len(self.cols)))
         for c in self.cols:
-            has_v = c.validity is not None
-            out.write(struct.pack("<B", 1 if has_v else 0))
-            if has_v:
-                out.write(np.packbits(
-                    c.validity[lo:hi].astype(np.uint8),
-                    bitorder="little").tobytes())
-            if c.kind == "null":
-                continue
-            if c.kind == "str":
-                lens = c.lengths[lo:hi].astype(np.uint32)
-                total = int(lens.sum())
-                out.write(struct.pack("<I", total) + lens.tobytes())
-                if total:
-                    b = c.data[lo:hi]
-                    pos = np.arange(b.shape[1])[None, :] < lens[:, None]
-                    out.write(b[pos].tobytes())
-            else:
-                out.write(np.ascontiguousarray(c.data[lo:hi]).tobytes())
+            _write_col(out, c, lo, hi)
         raw = out.getvalue()
         comp = zstandard.ZstdCompressor(
             level=level if level is not None else conf.zstd_level,
@@ -86,24 +71,56 @@ class HostBatch:
         return MAGIC + struct.pack("<II", len(raw), len(comp)) + comp
 
 
+def _write_col(out, c: _HostCol, lo: int, hi: int) -> None:
+    has_v = c.validity is not None
+    out.write(struct.pack("<B", 1 if has_v else 0))
+    if has_v:
+        out.write(np.packbits(c.validity[lo:hi].astype(np.uint8),
+                              bitorder="little").tobytes())
+    if c.kind == "null":
+        return
+    if c.kind == "str":
+        lens = c.lengths[lo:hi].astype(np.uint32)
+        total = int(lens.sum())
+        out.write(struct.pack("<I", total) + lens.tobytes())
+        if total:
+            b = c.data[lo:hi]
+            pos = np.arange(b.shape[1])[None, :] < lens[:, None]
+            out.write(b[pos].tobytes())
+        return
+    if c.kind == "list":
+        lens = c.lengths[lo:hi].astype(np.uint32)
+        elo, ehi = int(c.child_offsets[lo]), int(c.child_offsets[hi])
+        out.write(struct.pack("<I", ehi - elo) + lens.tobytes())
+        _write_col(out, c.child, elo, ehi)
+        return
+    out.write(np.ascontiguousarray(c.data[lo:hi]).tobytes())
+
+
+def _host_col(col, n: int) -> _HostCol:
+    validity = (np.asarray(col.validity)[:n].astype(bool)
+                if col.validity is not None else None)
+    if col.dtype.kind == TypeKind.NULL:
+        return _HostCol("null", None, None, validity)
+    if col.is_list:
+        offs = np.asarray(col.data.offsets)[:n + 1].astype(np.int64)
+        n_elems = int(offs[n]) if n else 0
+        child = _host_col(col.data.elements, n_elems)
+        lens = (offs[1:] - offs[:-1]).astype(np.int32)
+        return _HostCol("list", None, lens, validity, child, offs)
+    if col.is_string:
+        return _HostCol("str", np.asarray(col.data.bytes)[:n],
+                        np.asarray(col.data.lengths)[:n], validity)
+    d = np.asarray(col.data)[:n]
+    if d.dtype == np.bool_:
+        d = d.astype(np.uint8)
+    return _HostCol("num", d, None, validity)
+
+
 def to_host(batch: ColumnBatch) -> HostBatch:
     n = int(batch.num_rows)
-    cols: List[_HostCol] = []
-    for col in batch.columns:
-        validity = (np.asarray(col.validity)[:n].astype(bool)
-                    if col.validity is not None else None)
-        if col.dtype.kind == TypeKind.NULL:
-            cols.append(_HostCol("null", None, None, validity))
-        elif col.is_string:
-            cols.append(_HostCol(
-                "str", np.asarray(col.data.bytes)[:n],
-                np.asarray(col.data.lengths)[:n], validity))
-        else:
-            d = np.asarray(col.data)[:n]
-            if d.dtype == np.bool_:
-                d = d.astype(np.uint8)
-            cols.append(_HostCol("num", d, None, validity))
-    return HostBatch(batch.schema, cols, n)
+    return HostBatch(batch.schema, [_host_col(c, n) for c in batch.columns],
+                     n)
 
 
 def serialize_batch(batch: ColumnBatch, level: Optional[int] = None) -> bytes:
@@ -156,55 +173,66 @@ def read_batches(fp: BinaryIO, schema: Schema) -> Iterator[ColumnBatch]:
         yield b
 
 
+def _decode_col(fp: BinaryIO, dtype, n: int, cap: int):
+    import jax.numpy as jnp
+
+    from blaze_tpu.columnar.batch import (
+        Column, ListData, StringData, bucket_width, _pad_validity,
+    )
+
+    (hasv,) = struct.unpack("<B", _read_exact(fp, 1))
+    validity_np = None
+    if hasv:
+        vb = _read_exact(fp, (n + 7) // 8)
+        validity_np = np.unpackbits(
+            np.frombuffer(vb, np.uint8), count=n,
+            bitorder="little").astype(bool)
+    if dtype.kind == TypeKind.NULL:
+        return Column(dtype, jnp.zeros((cap,), jnp.int8),
+                      jnp.zeros((cap,), jnp.bool_))
+    if dtype.kind == TypeKind.LIST:
+        (total,) = struct.unpack("<I", _read_exact(fp, 4))
+        lens = np.frombuffer(_read_exact(fp, 4 * n), np.uint32)
+        ecap = bucket_capacity(total)
+        elems = _decode_col(fp, dtype.element, total, ecap)
+        offsets = np.zeros((cap + 1,), np.int32)
+        offsets[1:n + 1] = np.cumsum(lens.astype(np.int32))
+        offsets[n + 1:] = offsets[n]
+        return Column(dtype, ListData(jnp.asarray(offsets), elems),
+                      _pad_validity(validity_np, n, cap))
+    if dtype.is_string_like:
+        (total,) = struct.unpack("<I", _read_exact(fp, 4))
+        lens = np.frombuffer(_read_exact(fp, 4 * n), np.uint32)
+        payload = np.frombuffer(_read_exact(fp, total), np.uint8)
+        w = bucket_width(int(lens.max()) if n else 1)
+        mat = np.zeros((cap, w), np.uint8)
+        if n:
+            pos = np.arange(w)[None, :] < lens[:, None]
+            mat[:n][pos] = payload
+        col = Column(dtype,
+                     StringData(jnp.asarray(mat),
+                                jnp.asarray(np.pad(lens.astype(np.int32),
+                                                   (0, cap - n)))),
+                     _pad_validity(validity_np, n, cap))
+        return col.normalized() if validity_np is not None else col
+    if dtype.kind == TypeKind.BOOLEAN:
+        raw = np.frombuffer(_read_exact(fp, n), np.uint8)
+    else:
+        npdt = np.dtype(dtype.np_dtype())
+        raw = np.frombuffer(_read_exact(fp, npdt.itemsize * n), npdt)
+    npdt = dtype.np_dtype()
+    full = np.zeros((cap,), npdt)
+    full[:n] = raw.astype(npdt)
+    col = Column(dtype, jnp.asarray(full), _pad_validity(validity_np, n, cap))
+    return col.normalized() if validity_np is not None else col
+
+
 def _decode(fp: BinaryIO, schema: Schema,
             capacity: Optional[int]) -> ColumnBatch:
     import jax.numpy as jnp
 
-    from blaze_tpu.columnar.batch import (
-        Column, StringData, bucket_width, _pad_validity,
-    )
-
     n, ncols = struct.unpack("<IH", _read_exact(fp, 6))
     assert ncols == len(schema.fields), (ncols, len(schema.fields))
     cap = capacity or bucket_capacity(n)
-    cols: List[Column] = []
-    for f in schema:
-        (hasv,) = struct.unpack("<B", _read_exact(fp, 1))
-        validity_np = None
-        if hasv:
-            vb = _read_exact(fp, (n + 7) // 8)
-            validity_np = np.unpackbits(
-                np.frombuffer(vb, np.uint8), count=n,
-                bitorder="little").astype(bool)
-        if f.dtype.kind == TypeKind.NULL:
-            cols.append(Column(f.dtype, jnp.zeros((cap,), jnp.int8),
-                               jnp.zeros((cap,), jnp.bool_)))
-            continue
-        if f.dtype.is_string_like:
-            (total,) = struct.unpack("<I", _read_exact(fp, 4))
-            lens = np.frombuffer(_read_exact(fp, 4 * n), np.uint32)
-            payload = np.frombuffer(_read_exact(fp, total), np.uint8)
-            w = bucket_width(int(lens.max()) if n else 1)
-            mat = np.zeros((cap, w), np.uint8)
-            if n:
-                pos = np.arange(w)[None, :] < lens[:, None]
-                mat[:n][pos] = payload
-            col = Column(f.dtype,
-                         StringData(jnp.asarray(mat),
-                                    jnp.asarray(np.pad(
-                                        lens.astype(np.int32),
-                                        (0, cap - n)))),
-                         _pad_validity(validity_np, n, cap))
-        else:
-            if f.dtype.kind == TypeKind.BOOLEAN:
-                raw = np.frombuffer(_read_exact(fp, n), np.uint8)
-            else:
-                npdt = np.dtype(f.dtype.np_dtype())
-                raw = np.frombuffer(_read_exact(fp, npdt.itemsize * n), npdt)
-            npdt = f.dtype.np_dtype()
-            full = np.zeros((cap,), npdt)
-            full[:n] = raw.astype(npdt)
-            col = Column(f.dtype, jnp.asarray(full),
-                         _pad_validity(validity_np, n, cap))
-        cols.append(col.normalized() if validity_np is not None else col)
+    cols = [_decode_col(fp, f.dtype, n, cap) for f in schema]
     return ColumnBatch(schema, cols, jnp.asarray(n, jnp.int32), cap)

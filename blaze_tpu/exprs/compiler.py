@@ -99,7 +99,78 @@ def compile_expr(expr: ir.Expr, schema) -> CompiledExpr:
         c = compile_expr(expr.child, schema)
         p, s = expr.precision, expr.scale
         return lambda b: check_overflow(c(b), p, s)
+    if isinstance(expr, ir.UdfWrapper):
+        return _compile_udf_wrapper(expr, schema)
+    if isinstance(expr, ir.ScalarSubquery):
+        return _compile_scalar_subquery(expr)
     raise NotImplementedError(f"cannot compile {type(expr).__name__}")
+
+
+def _compile_udf_wrapper(expr: ir.UdfWrapper, schema) -> CompiledExpr:
+    """Host-callback evaluation of an engine-external expression.
+
+    Ref: SparkUDFWrapperExpr (spark_udf_wrapper.rs) — natively-computed
+    param columns cross to the embedding layer, which evaluates the
+    serialized expression row-by-row and returns the result array
+    (SparkUDFWrapperContext.scala:63-111). The crossing here is
+    jax.pure_callback, so the surrounding pipeline stays one jit program.
+    The registered resource is `fn(*param_numpy_arrays, num_rows) ->
+    (values ndarray, validity ndarray|None)`.
+    """
+    import jax
+
+    from blaze_tpu.runtime import resources as _res
+
+    param_fns = [compile_expr(p, schema) for p in expr.params]
+    rt = expr.return_type
+    if rt.is_string_like or rt.kind in (TypeKind.LIST, TypeKind.MAP,
+                                        TypeKind.STRUCT):
+        raise NotImplementedError(
+            f"udf wrapper return type {rt} not yet supported")
+    rid = expr.resource_id
+
+    def run(b: ColumnBatch) -> Column:
+        params = [fn(b) for fn in param_fns]
+        host_args = []
+        for p in params:
+            if p.is_string:
+                host_args += [p.data.bytes, p.data.lengths]
+            else:
+                host_args.append(p.data)
+            host_args.append(p.valid_mask())
+        host_args.append(b.num_rows)
+
+        def callback(*arrs):
+            fn = _res.get(rid)
+            vals, validity = fn(*[np.asarray(a) for a in arrs])
+            out_v = np.zeros((b.capacity,), rt.np_dtype())
+            out_ok = np.zeros((b.capacity,), bool)
+            n = min(len(vals), b.capacity)
+            out_v[:n] = np.asarray(vals)[:n]
+            out_ok[:n] = (np.ones(n, bool) if validity is None
+                          else np.asarray(validity)[:n])
+            return out_v, out_ok
+
+        out_shape = (jax.ShapeDtypeStruct((b.capacity,), rt.np_dtype()),
+                     jax.ShapeDtypeStruct((b.capacity,), np.bool_))
+        vals, ok = jax.pure_callback(callback, out_shape,
+                                     *host_args, vmap_method="sequential")
+        validity = ok & b.row_mask() if expr.nullable else None
+        return Column(rt, vals, validity)
+
+    return run
+
+
+def _compile_scalar_subquery(expr: ir.ScalarSubquery) -> CompiledExpr:
+    """Ref: SparkScalarSubqueryWrapperExpr — the provider resource returns
+    the (python) scalar on first evaluation; it becomes a literal column."""
+    from blaze_tpu.runtime import resources as _res
+
+    def run(b: ColumnBatch) -> Column:
+        value = _res.get(expr.resource_id)()
+        return _compile_literal(ir.Literal(expr.return_type, value))(b)
+
+    return run
 
 
 def _compile_literal(expr: ir.Literal) -> CompiledExpr:

@@ -276,6 +276,38 @@ class CheckOverflow(Expr):
         return ("check_overflow", self.precision, self.scale, self.child.key())
 
 
+@dataclasses.dataclass(frozen=True)
+class UdfWrapper(Expr):
+    """Serialized engine-external expression evaluated through a registered
+    callback (ref SparkUDFWrapperExpr, datafusion-ext-exprs
+    spark_udf_wrapper.rs: params computed natively, row batch shipped to the
+    JVM over FFI, result array shipped back). Here the callback crosses
+    jit via jax.pure_callback."""
+    resource_id: str
+    return_type: DataType
+    nullable: bool
+    params: Tuple[Expr, ...]
+
+    def children(self):
+        return self.params
+
+    def key(self):
+        return ("udf", self.resource_id, repr(self.return_type),
+                tuple(p.key() for p in self.params))
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalarSubquery(Expr):
+    """Lazily-evaluated scalar subquery result fetched from a registered
+    provider (ref SparkScalarSubqueryWrapperExpr)."""
+    resource_id: str
+    return_type: DataType
+    nullable: bool = True
+
+    def key(self):
+        return ("scalar_subquery", self.resource_id, repr(self.return_type))
+
+
 # -- convenience builders --
 
 def lit(value: Any, dtype: Optional[DataType] = None) -> Literal:

@@ -124,6 +124,104 @@ def _first_by_index(values_cols: Sequence[Column], layout, has) -> Tuple[list, j
     return out, ok
 
 
+class _AggState:
+    """Spillable aggregation state (ref AggTables + its MemConsumer impl,
+    agg_tables.rs:57-278: in-mem tables spill to bucket-sorted runs merged
+    on output). Memory relief here is (1) collapse raw rows into aggregated
+    state (the sort-based analog of table insertion), then (2) spill state
+    batches to host files; finish merges disk + memory hierarchically."""
+
+    name = "agg"
+
+    def __init__(self, op: "AggExec", manager) -> None:
+        from blaze_tpu.runtime import memory as M
+
+        self.op = op
+        self.manager = manager
+        self._M = M
+        self.raw: List[ColumnBatch] = []
+        self.raw_rows = 0
+        self.raw_bytes = 0
+        self.states: List[ColumnBatch] = []
+        self.state_bytes = 0
+        self.spills: List = []
+        self.collapses = 0
+        self.spill_files_used = 0
+        manager.register(self)
+
+    def mem_used(self) -> int:
+        return self.raw_bytes + self.state_bytes
+
+    def spill(self) -> int:
+        freed = self._collapse_all()
+        if freed:
+            return freed
+        # already collapsed: push state batches to a host spill file
+        if not self.states:
+            return 0
+        freed = self.state_bytes
+        sf = self._M.SpillFile(self.op._state_schema)
+        for s in self.states:
+            sf.write(truncate(s, max(int(s.num_rows), 1)))
+        self.spills.append(sf)
+        self.spill_files_used += 1
+        self.states, self.state_bytes = [], 0
+        return freed
+
+    def _collapse_all(self) -> int:
+        freed = 0
+        if self.raw:
+            before = self.raw_bytes
+            s = self.op._collapse(self.raw, raw_input=True)
+            self.raw, self.raw_rows, self.raw_bytes = [], 0, 0
+            self._push_state(s)
+            freed += max(before - self._M.batch_nbytes(s), 0)
+            self.collapses += 1
+        if len(self.states) > 1:
+            before = self.state_bytes
+            s = self.op._collapse(self.states, raw_input=False)
+            self.states, self.state_bytes = [], 0
+            self._push_state(s)
+            freed += max(before - self.state_bytes, 0)
+            self.collapses += 1
+        return freed
+
+    def _push_state(self, s: ColumnBatch) -> None:
+        self.states.append(s)
+        self.state_bytes += self._M.batch_nbytes(s)
+
+    def add_raw(self, work: ColumnBatch) -> None:
+        self.raw.append(work)
+        self.raw_rows += int(work.num_rows)
+        self.raw_bytes += self._M.batch_nbytes(work)
+        if self.raw_rows >= self.op.collapse_threshold:
+            self._collapse_all()
+        self.manager.update_mem_used(self)
+
+    def add_state(self, batch: ColumnBatch) -> None:
+        self._push_state(batch)
+        if len(self.states) >= 16:
+            self._collapse_all()
+        self.manager.update_mem_used(self)
+
+    def merged(self) -> ColumnBatch:
+        self._collapse_all()
+        acc = self.states[0] if self.states else None
+        for sf in self.spills:
+            for chunk in sf.read():
+                if acc is None:
+                    acc = chunk
+                else:
+                    acc = self.op._collapse([acc, chunk], raw_input=False)
+        assert acc is not None
+        return acc
+
+    def close(self) -> None:
+        self.manager.unregister(self)
+        for sf in self.spills:
+            sf.close()
+
+
 class AggExec(Operator):
     def __init__(self, child: Operator, group_exprs: Sequence[ir.Expr],
                  group_names: Sequence[str], aggs: Sequence[AggCall],
@@ -179,42 +277,38 @@ class AggExec(Operator):
     # ---- execution ----
     def execute(self, ctx: ExecContext) -> BatchStream:
         def gen():
-            raw: List[ColumnBatch] = []     # PARTIAL input rows (work layout)
-            states: List[ColumnBatch] = []  # aggregated state batches
-            raw_rows = 0
+            from blaze_tpu.runtime import memory as M
+
+            manager = M.get_manager(ctx)
+            state = _AggState(self, manager)
             seen = False
-            for batch in self.children[0].execute(ctx):
-                ctx.check_running()
-                if int(batch.num_rows) == 0:
-                    continue
-                seen = True
-                if self._is_state_input():
-                    states.append(batch)
-                else:
-                    raw.append(self._to_work(batch))
-                    raw_rows += int(batch.num_rows)
-                if raw_rows >= self.collapse_threshold:
+            try:
+                for batch in self.children[0].execute(ctx):
+                    ctx.check_running()
+                    if int(batch.num_rows) == 0:
+                        continue
+                    seen = True
                     with self.metrics.timer():
-                        states.append(self._collapse(raw, raw_input=True))
-                        raw, raw_rows = [], 0
-                        if len(states) > 1:
-                            states = [self._collapse(states, raw_input=False)]
-                    self.metrics.add("collapses", 1)
-            if not seen:
-                if not self.group_exprs:
-                    yield self._empty_global_result()
-                return
-            with self.metrics.timer():
-                if raw:
-                    states.append(self._collapse(raw, raw_input=True))
-                state = (states[0] if len(states) == 1 else
-                         self._collapse(states, raw_input=False))
-                if self.mode == AggMode.FINAL:
-                    out = self._finalize_jit(state)
-                else:
-                    out = state
-            out = truncate(out, max(int(out.num_rows), 1))
-            yield out
+                        if self._is_state_input():
+                            state.add_state(batch)
+                        else:
+                            state.add_raw(self._to_work(batch))
+                if not seen:
+                    if not self.group_exprs:
+                        yield self._empty_global_result()
+                    return
+                with self.metrics.timer():
+                    merged = state.merged()
+                    if self.mode == AggMode.FINAL:
+                        out = self._finalize_jit(merged)
+                    else:
+                        out = merged
+                self.metrics.add("collapses", state.collapses)
+                self.metrics.add("spill_count", state.spill_files_used)
+                out = truncate(out, max(int(out.num_rows), 1))
+                yield out
+            finally:
+                state.close()
 
         return count_stream(self, gen())
 

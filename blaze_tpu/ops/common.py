@@ -45,6 +45,9 @@ def concat_batches(batches: List[ColumnBatch], schema: Optional[Schema] = None,
     out_cols = []
     for ci, field in enumerate(schema):
         parts = [b.columns[ci] for b in batches]
+        if parts[0].is_list:
+            out_cols.append(_concat_list_columns(parts, idx, field, cap))
+            continue
         if parts[0].is_string:
             w = max(p.data.width for p in parts)
             datas = [S.ensure_width(p.data, w) for p in parts]
@@ -64,6 +67,55 @@ def concat_batches(batches: List[ColumnBatch], schema: Optional[Schema] = None,
             validity = None
         out_cols.append(Column(field.dtype, data, validity))
     return ColumnBatch(schema, out_cols, jnp.asarray(total, jnp.int32), cap)
+
+
+def _concat_list_columns(parts, idx, field, cap):
+    """Concatenate list columns: element storages concatenate with bases,
+    then rows gather through a _list_take-style compaction."""
+    from blaze_tpu.columnar.batch import ListData, _list_take
+    from blaze_tpu.columnar.types import Field, Schema
+
+    bases = []
+    total_elems = 0
+    elem_parts = []
+    for p in parts:
+        bases.append(total_elems)
+        total_elems += p.data.elements.capacity
+        elem_parts.append(p.data.elements)
+    elem_schema = Schema([Field("e", field.dtype.element)])
+    elem_batches = [
+        ColumnBatch(elem_schema, [e],
+                    jnp.asarray(e.capacity, jnp.int32), e.capacity)
+        for e in elem_parts]
+    big_elems = concat_batches(elem_batches, elem_schema,
+                               capacity=total_elems).columns[0]
+
+    starts = jnp.concatenate([p.data.offsets[:-1] + b
+                              for p, b in zip(parts, bases)])
+    lens = jnp.concatenate([p.data.lengths() for p in parts])
+    vs = [p.valid_mask() if p.validity is not None else None for p in parts]
+    validity = None
+    if any(v is not None for v in vs):
+        validity = jnp.concatenate(
+            [v if v is not None else jnp.ones((p.capacity,), jnp.bool_)
+             for v, p in zip(vs, parts)])[idx]
+    # gather rows: emulate _list_take over the concatenated layout
+    glens = lens[idx]
+    new_off = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                               jnp.cumsum(glens, dtype=jnp.int32)])
+    # direct expansion (starts are not contiguous, so inline the gather)
+    ecap = big_elems.capacity
+    slot = jnp.arange(ecap, dtype=jnp.int32)
+    out_rows = idx.shape[0]
+    row = jnp.searchsorted(new_off[1:out_rows + 1], slot, side="right")
+    row = jnp.clip(row, 0, out_rows - 1)
+    within = slot - new_off[row]
+    src = starts[idx[row]] + within
+    live = slot < new_off[out_rows]
+    elems = big_elems.take(jnp.where(live, src, 0))
+    from blaze_tpu.columnar.batch import Column
+
+    return Column(field.dtype, ListData(new_off, elems), validity)
 
 
 def slice_batch(batch: ColumnBatch, start: int, count: int) -> ColumnBatch:

@@ -117,49 +117,6 @@ def _join_sort_keys(batch: ColumnBatch, cols: Sequence[int],
                                             string_words_n)
 
 
-def sort_batch_by_keys(batch: ColumnBatch, keys: List[Array]) -> ColumnBatch:
-    """sort_batch with caller-provided key arrays (same payload riding)."""
-    iota = jnp.arange(batch.capacity, dtype=jnp.int32)
-    payload: List[Array] = [iota]
-    slots = []
-    for ci, c in enumerate(batch.columns):
-        if c.is_string:
-            payload.append(c.data.lengths)
-            slots.append((ci, "len"))
-        else:
-            data = c.data
-            if data.dtype == jnp.bool_:
-                payload.append(data.astype(jnp.uint8))
-                slots.append((ci, "bool"))
-            else:
-                payload.append(data)
-                slots.append((ci, "data"))
-        if c.validity is not None:
-            payload.append(c.validity.astype(jnp.uint8))
-            slots.append((ci, "validity"))
-    out = jax.lax.sort(tuple(keys) + tuple(payload), num_keys=len(keys),
-                       is_stable=True)
-    perm = out[len(keys)]
-    sorted_payload = out[len(keys) + 1:]
-    parts: dict = {}
-    for (ci, kind), arr in zip(slots, sorted_payload):
-        parts.setdefault(ci, {})[kind] = arr
-    new_cols = []
-    for ci, c in enumerate(batch.columns):
-        p = parts.get(ci, {})
-        validity = (p["validity"].astype(jnp.bool_)
-                    if c.validity is not None else None)
-        if c.is_string:
-            data = StringData(c.data.bytes[perm], p["len"])
-        elif "bool" in p:
-            data = p["bool"].astype(jnp.bool_)
-        else:
-            data = p["data"]
-        new_cols.append(Column(c.dtype, data, validity))
-    return ColumnBatch(batch.schema, new_cols, batch.num_rows,
-                       batch.capacity)
-
-
 def _null_disable(batch: ColumnBatch, cols: Sequence[int],
                   null_safe: Sequence[bool], side_tag: int) -> Array:
     """uint8 key that prevents cross-side runs for rows with null keys."""
@@ -171,6 +128,13 @@ def _null_disable(batch: ColumnBatch, cols: Sequence[int],
         if v is not None:
             bad = bad | (~v)
     return jnp.where(bad, jnp.uint8(2 + side_tag), jnp.uint8(0))
+
+
+def sort_batch_by_keys(batch: ColumnBatch, keys: List[Array]) -> ColumnBatch:
+    """sort_batch with caller-provided key arrays (shared payload riding)."""
+    from blaze_tpu.ops.sort_keys import permute_by_keys
+
+    return permute_by_keys(batch, keys)
 
 
 # ---------------------------------------------------------------------------
@@ -397,22 +361,14 @@ class HashJoinLikeExec(Operator):
             build = ColumnBatch.empty(build_op.schema)
 
         null_safe = [k.null_safe for k in self.keys]
-        # a null flag key is emitted iff either side carries validity — read
-        # from the actual batches (jit cache keys include validity layout)
-        probe_first = None
-        probe_stream = probe_op.execute(ctx)
-        for b in probe_stream:
-            probe_first = b
-            break
-        force_flags = []
-        for pc, bc in zip(probe_cols, build_cols):
-            pv = (probe_first is not None and
-                  probe_first.columns[pc].validity is not None)
-            bv = build.columns[bc].validity is not None
-            force_flags.append(pv or bv)
-
+        # Build-side sort uses its natural flag layout; per-probe-batch
+        # match sorts may add null-flag keys when a probe batch carries
+        # validity — an all-ones flag over an all-valid build column is
+        # constant, so the composite order stays aligned either way.
+        build_flags = [build.columns[bc].validity is not None
+                       for bc in build_cols]
         build_sorted = self._sort_build(build, build_cols, null_safe,
-                                        force_flags)
+                                        build_flags)
 
         build_matched = jnp.zeros((build_sorted.capacity,), jnp.bool_)
         need_build_matched = build_side_semi or (
@@ -420,15 +376,14 @@ class HashJoinLikeExec(Operator):
             (jt == JoinType.RIGHT and probe_is_left) or
             (jt == JoinType.LEFT and not probe_is_left))
 
-        def probes():
-            if probe_first is not None:
-                yield probe_first
-                yield from probe_stream
-
-        for probe in probes():
+        for probe in probe_op.execute(ctx):
             ctx.check_running()
             if int(probe.num_rows) == 0:
                 continue
+            # per-batch flag layout: either side nullable -> flag key
+            force_flags = [
+                bf or probe.columns[pc].validity is not None
+                for bf, pc in zip(build_flags, probe_cols)]
             with self.metrics.timer("join_time_ns"):
                 out, matched = self._join_batch(
                     probe, build_sorted, probe_cols, build_cols, null_safe,

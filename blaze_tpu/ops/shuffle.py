@@ -104,49 +104,115 @@ class ShuffleWriterExec(Operator):
                 self.children[0].plan_key())
 
     def execute(self, ctx: ExecContext) -> BatchStream:
-        P = self.partitioning.num_partitions
-        buffers: List[List[bytes]] = [[] for _ in range(P)]
+        from blaze_tpu.runtime import memory as M
 
+        state = _WriterBuffers(self.partitioning.num_partitions,
+                               M.get_manager(ctx))
         key = ("shuffle_part", self.plan_key())
-
-        for batch in self.children[0].execute(ctx):
-            ctx.check_running()
-            if int(batch.num_rows) == 0:
-                continue
+        try:
+            for batch in self.children[0].execute(ctx):
+                ctx.check_running()
+                if int(batch.num_rows) == 0:
+                    continue
+                with self.metrics.timer():
+                    fn = jit_cache.get_or_compile(
+                        key + batch.shape_key(),
+                        lambda: (lambda b: partition_and_sort(
+                            b, self.partitioning, self._key_fns)))
+                    sb, counts = fn(batch)
+                    hb = serde.to_host(sb)
+                    counts = np.asarray(counts)
+                    offs = np.concatenate([[0], np.cumsum(counts)])
+                    for p in range(self.partitioning.num_partitions):
+                        if counts[p]:
+                            state.push(p, hb.serialize(int(offs[p]),
+                                                       int(offs[p + 1])))
             with self.metrics.timer():
-                fn = jit_cache.get_or_compile(
-                    key + batch.shape_key(),
-                    lambda: (lambda b: partition_and_sort(
-                        b, self.partitioning, self._key_fns)))
-                sb, counts = fn(batch)
-                hb = serde.to_host(sb)
-                counts = np.asarray(counts)
-                offs = np.concatenate([[0], np.cumsum(counts)])
-                for p in range(P):
-                    if counts[p]:
-                        buffers[p].append(
-                            hb.serialize(int(offs[p]), int(offs[p + 1])))
-                self.metrics.add("data_size", sum(
-                    len(x) for b in buffers for x in b))
-
-        with self.metrics.timer():
-            lengths = self._commit(buffers)
-        self.metrics.add("shuffle_bytes_written", int(sum(lengths)))
+                lengths = self._commit(state)
+            self.metrics.add("shuffle_bytes_written", int(sum(lengths)))
+            self.metrics.add("spill_count", state.spill_chunks)
+        finally:
+            state.close()
         return iter(())
 
-    def _commit(self, buffers: List[List[bytes]]) -> List[int]:
+    def _commit(self, state: "_WriterBuffers") -> List[int]:
         lengths = []
         os.makedirs(os.path.dirname(self.data_path) or ".", exist_ok=True)
         with open(self.data_path, "wb") as f:
-            for p_bufs in buffers:
+            for p in range(self.partitioning.num_partitions):
                 start = f.tell()
-                for b in p_bufs:
-                    f.write(b)
+                for chunk in state.drain(p):
+                    f.write(chunk)
                 lengths.append(f.tell() - start)
         offsets = np.concatenate([[0], np.cumsum(lengths)]).astype("<u8")
         with open(self.index_path, "wb") as f:
             f.write(offsets.tobytes())
         return lengths
+
+
+class _WriterBuffers:
+    """Per-partition frame buffers with host-file spill (ref the
+    repartitioners' MemConsumer spill of sort_repartitioner.rs:199-213 —
+    here frames are already serialized host bytes, so spilling appends them
+    to a tempfile and commit replays them in partition order)."""
+
+    name = "shuffle_writer"
+
+    def __init__(self, num_partitions: int, manager) -> None:
+        import tempfile
+
+        from blaze_tpu.config import conf as _conf
+
+        self.P = num_partitions
+        self.buffers: List[List[bytes]] = [[] for _ in range(num_partitions)]
+        self.bytes = 0
+        self.manager = manager
+        os.makedirs(_conf.spill_dir, exist_ok=True)
+        self._spill_fp = None
+        self._spill_segs: List[List[tuple]] = [[] for _ in
+                                               range(num_partitions)]
+        self.spill_chunks = 0
+        manager.register(self)
+
+    def mem_used(self) -> int:
+        return self.bytes
+
+    def spill(self) -> int:
+        if self.bytes == 0:
+            return 0
+        import tempfile
+
+        from blaze_tpu.config import conf as _conf
+
+        if self._spill_fp is None:
+            self._spill_fp = tempfile.TemporaryFile(dir=_conf.spill_dir)
+        freed = self.bytes
+        for p in range(self.P):
+            for chunk in self.buffers[p]:
+                off = self._spill_fp.tell()
+                self._spill_fp.write(chunk)
+                self._spill_segs[p].append((off, len(chunk)))
+                self.spill_chunks += 1
+            self.buffers[p] = []
+        self.bytes = 0
+        return freed
+
+    def push(self, p: int, frame: bytes) -> None:
+        self.buffers[p].append(frame)
+        self.bytes += len(frame)
+        self.manager.update_mem_used(self)
+
+    def drain(self, p: int):
+        for off, ln in self._spill_segs[p]:
+            self._spill_fp.seek(off)
+            yield self._spill_fp.read(ln)
+        for chunk in self.buffers[p]:
+            yield chunk
+
+    def close(self) -> None:
+        self.manager.unregister(self)
+        if self._spill_fp is not None:
+            self._spill_fp.close()
 
 
 class RssPartitionWriterBase:
@@ -244,6 +310,41 @@ class IpcReaderExec(Operator):
                 else:  # file-like
                     for b in serde.read_batches(seg, self._schema):
                         yield b
+
+        return count_stream(self, gen())
+
+
+class FfiReaderExec(Operator):
+    """Ref: ffi_reader_exec.rs — pulls Arrow arrays from a registered
+    export iterator (the ConvertToNative row->columnar ingestion path,
+    ConvertToNativeBase.scala:59-98). The provider yields pyarrow
+    RecordBatches (the C-data crossing is pyarrow's) or ready ColumnBatches.
+    """
+
+    def __init__(self, schema: Schema, export_resource_id: str) -> None:
+        super().__init__([])
+        self._schema = schema
+        self.export_resource_id = export_resource_id
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def plan_key(self) -> tuple:
+        return ("ffi_reader", tuple(self._schema.names()))
+
+    def execute(self, ctx: ExecContext) -> BatchStream:
+        def gen():
+            from blaze_tpu.columnar.arrow_io import batch_from_arrow
+
+            provider = resources.get(self.export_resource_id)
+            source = provider() if callable(provider) else provider
+            for item in source:
+                ctx.check_running()
+                if isinstance(item, ColumnBatch):
+                    yield item
+                else:
+                    yield batch_from_arrow(item, schema=self._schema)
 
         return count_stream(self, gen())
 

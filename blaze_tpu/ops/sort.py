@@ -53,8 +53,137 @@ def truncate(batch: ColumnBatch, limit: int) -> ColumnBatch:
     return ColumnBatch(batch.schema, cols, n, cap)
 
 
+class ExternalSorter:
+    """Budgeted sort state: in-memory batches spill as sorted runs; the
+    finish phase k-way merges runs with a bounded pool.
+
+    Ref: sort_exec.rs — in-mem SortedBatches merged into levels, spills
+    merged by a LoserTree over cursors (:307-475). TPU shape: a run is a
+    sequence of sorted zstd frames in a SpillFile; the merge pools the
+    front batch of the run with the smallest head key, emits every pooled
+    row that is <= the smallest head key among the other runs (lexicographic
+    compare on the encoded sort keys, device-side), and carries the rest.
+    """
+
+    def __init__(self, schema: Schema, specs: Sequence[SortSpec],
+                 manager=None, name: str = "sort") -> None:
+        from blaze_tpu.runtime import memory as M
+
+        self.schema = schema
+        self.specs = list(specs)
+        self.manager = manager or M.get_manager()
+        self.name = name
+        self.pending: List[ColumnBatch] = []
+        self.pending_bytes = 0
+        self.runs: List = []
+        self._M = M
+        self.manager.register(self)
+
+    # MemConsumer protocol
+    def mem_used(self) -> int:
+        return self.pending_bytes
+
+    def spill(self) -> int:
+        if not self.pending:
+            return 0
+        freed = self.pending_bytes
+        run = self._M.SpillFile(self.schema)
+        big = concat_batches(self.pending, self.schema)
+        sb = sorted_batch_jit(big, self.specs)
+        for lo in range(0, max(int(sb.num_rows), 1), 8192):
+            from blaze_tpu.ops.common import slice_batch
+
+            chunk = slice_batch(sb, lo, 8192)
+            if int(chunk.num_rows) == 0:
+                break
+            run.write(chunk)
+        self.runs.append(run)
+        self.pending, self.pending_bytes = [], 0
+        return freed
+
+    def add(self, batch: ColumnBatch) -> None:
+        self.pending.append(batch)
+        self.pending_bytes += self._M.batch_nbytes(batch)
+        self.manager.update_mem_used(self)
+
+    def finish(self):
+        try:
+            if not self.runs:
+                if not self.pending:
+                    return
+                big = concat_batches(self.pending, self.schema)
+                yield sorted_batch_jit(big, self.specs)
+                return
+            if self.pending:
+                self.spill()
+            yield from self._merge_runs()
+        finally:
+            self.manager.unregister(self)
+            for r in self.runs:
+                r.close()
+
+    # -- k-way merge of sorted runs --
+    def _head_key(self, batch: ColumnBatch, row: int) -> tuple:
+        import numpy as np
+
+        from blaze_tpu.ops.sort_keys import batch_sort_keys
+
+        keys = batch_sort_keys(batch, self.specs)
+        return tuple(int(np.asarray(k[row])) for k in keys)
+
+    def _split_leq(self, pool: ColumnBatch, bound: tuple):
+        import jax.numpy as jnp
+
+        from blaze_tpu.ops.sort_keys import batch_sort_keys
+
+        keys = batch_sort_keys(pool, self.specs)
+        le = jnp.zeros((pool.capacity,), jnp.bool_)
+        eq = jnp.ones((pool.capacity,), jnp.bool_)
+        for karr, bval in zip(keys, bound):
+            b = jnp.asarray(bval, karr.dtype)
+            le = le | (eq & (karr < b))
+            eq = eq & (karr == b)
+        mask = (le | eq) & pool.row_mask()
+        return pool.compact(mask), pool.compact(~mask)
+
+    def _merge_runs(self):
+        streams = [iter(r.read()) for r in self.runs]
+        current: List[Optional[ColumnBatch]] = [next(s, None)
+                                                for s in streams]
+        carry: Optional[ColumnBatch] = None
+        while True:
+            active = [i for i, c in enumerate(current) if c is not None]
+            if not active:
+                if carry is not None and int(carry.num_rows) > 0:
+                    yield carry
+                return
+            heads = {i: self._head_key(current[i], 0) for i in active}
+            i_min = min(active, key=lambda i: heads[i])
+            parts = ([carry] if carry is not None and
+                     int(carry.num_rows) > 0 else [])
+            parts.append(current[i_min])
+            pool = (parts[0] if len(parts) == 1 else
+                    concat_batches(parts, self.schema))
+            pool = sorted_batch_jit(pool, self.specs)
+            current[i_min] = next(streams[i_min], None)
+            others = [i for i in active if i != i_min]
+            if not others and current[i_min] is None:
+                if int(pool.num_rows) > 0:
+                    yield pool
+                carry = None
+                continue
+            bounds = [heads[i] for i in others]
+            if current[i_min] is not None:
+                bounds.append(self._head_key(current[i_min], 0))
+            bound = min(bounds)
+            emit, carry = self._split_leq(pool, bound)
+            if int(emit.num_rows) > 0:
+                yield emit
+
+
 class SortExec(Operator):
-    """Full sort (optionally fetch-limited top-k)."""
+    """Full sort (optionally fetch-limited top-k), external when the
+    memory budget forces spilling."""
 
     def __init__(self, child: Operator, specs: Sequence[SortSpec],
                  fetch: Optional[int] = None) -> None:
@@ -68,22 +197,31 @@ class SortExec(Operator):
 
     def plan_key(self) -> tuple:
         return ("sort", tuple(s.key() for s in self.specs), self.fetch,
-                self.children[0].plan_key())
+            self.children[0].plan_key())
 
     def execute(self, ctx: ExecContext) -> BatchStream:
         def gen():
             child = self.children[0]
             if self.fetch is not None:
                 out = self._topk(child.execute(ctx), ctx)
-            else:
-                batches = list(child.execute(ctx))
-                if not batches:
-                    return
-                with self.metrics.timer():
-                    big = concat_batches(batches, self.schema)
-                    out = sorted_batch_jit(big, self.specs, self.plan_key())
-            if out is not None:
-                yield out
+                if out is not None:
+                    yield out
+                return
+            from blaze_tpu.runtime import memory as M
+
+            sorter = ExternalSorter(self.schema, self.specs,
+                                    M.get_manager(ctx))
+            for batch in child.execute(ctx):
+                ctx.check_running()
+                if int(batch.num_rows):
+                    with self.metrics.timer():
+                        sorter.add(batch)
+            runs = sorter.runs  # finish() may add a final spill run
+            with self.metrics.timer():
+                yield from sorter.finish()
+            self.metrics.add("spill_count", len(runs))
+            self.metrics.add("spilled_bytes",
+                             sum(r.bytes_written for r in runs))
 
         return count_stream(self, gen())
 

@@ -146,9 +146,19 @@ def sort_batch(batch: ColumnBatch, specs: Sequence[SortSpec],
     keys = batch_sort_keys(batch, specs, max_string_words)
     iota = jnp.arange(batch.capacity, dtype=jnp.int32)
 
+    return permute_by_keys(batch, keys)
+
+
+def permute_by_keys(batch: ColumnBatch, keys: List[Array]) -> ColumnBatch:
+    """Variadic-sort payload riding shared by sort_batch and the join's
+    composite-key sort: 1-D leaves ride the sort; 2-D string matrices and
+    list columns are gathered through the sorted iota afterwards."""
+    iota = jnp.arange(batch.capacity, dtype=jnp.int32)
     payload: List[Array] = [iota]
     slots = []  # (col_idx, kind) mirrors payload[1:]
     for ci, c in enumerate(batch.columns):
+        if c.is_list:
+            continue  # gathered whole via perm (take handles offsets)
         if c.is_string:
             payload.append(c.data.lengths)
             slots.append((ci, "len"))
@@ -175,6 +185,9 @@ def sort_batch(batch: ColumnBatch, specs: Sequence[SortSpec],
         parts.setdefault(ci, {})[kind] = arr
     new_cols = []
     for ci, c in enumerate(batch.columns):
+        if c.is_list:
+            new_cols.append(c.take(perm))
+            continue
         p = parts.get(ci, {})
         validity = None
         if c.validity is not None:

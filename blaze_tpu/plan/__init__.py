@@ -1,0 +1,15 @@
+"""Plan contract: protobuf wire format + decoder.
+
+Ref: blaze-serde — `plan.proto` is this engine's equivalent of
+blaze.proto (regenerate plan_pb2.py with
+`protoc --python_out=. blaze_tpu/plan/plan.proto`), and `from_proto.py` is
+the TryInto<ExecutionPlan> dispatch (from_proto.rs:121-793).
+"""
+
+from blaze_tpu.plan.from_proto import (
+    decode_expr,
+    decode_plan,
+    decode_task_definition,
+)
+
+__all__ = ["decode_expr", "decode_plan", "decode_task_definition"]

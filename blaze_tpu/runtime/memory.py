@@ -1,0 +1,186 @@
+"""Memory manager: budgeted consumers with fair-share spilling.
+
+Ref: datafusion-ext-plans common/memory_manager.rs — a global registry of
+MemConsumers (sort, agg tables, repartitioners); over-budget growing
+consumers either spill themselves (when holding > 1/8 of their fair share)
+or ask others to free memory (:194-323, 16MB min trigger :26) — and the
+spill sink of common/onheap_spill.rs (JVM-heap pages on executors, tempfiles
+on the driver / in tests :26-75).
+
+TPU translation (SURVEY.md §5.2): the budget models HBM for device-resident
+operator state; "spilling" moves batches to host files in the compact zstd
+frame format (columnar/serde.py — same format as the reference's spill
+serde). Execution here is single-threaded per task, so the condvar wait
+protocol degenerates: an over-budget update first asks the LARGEST other
+consumer to spill, then self-spills (mirroring the fair-share decision
+without the blocking path).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+from typing import BinaryIO, Iterator, List, Optional
+
+from blaze_tpu.columnar import serde
+from blaze_tpu.columnar.batch import ColumnBatch
+from blaze_tpu.columnar.types import Schema
+from blaze_tpu.config import conf
+
+class MemConsumer:
+    """Spillable operator state (ref MemConsumer trait)."""
+
+    name: str = "consumer"
+
+    def mem_used(self) -> int:
+        return 0
+
+    def spill(self) -> int:
+        """Release memory; returns bytes freed."""
+        return 0
+
+
+class MemManager:
+    def __init__(self, total: Optional[int] = None) -> None:
+        self.total = total or conf.memory_budget or (1 << 30)
+        self._consumers: List[MemConsumer] = []
+        self._lock = threading.Lock()
+        self.spill_count = 0
+        self.spilled_bytes = 0
+
+    # -- registry --
+    def register(self, consumer: MemConsumer) -> None:
+        with self._lock:
+            self._consumers.append(consumer)
+
+    def unregister(self, consumer: MemConsumer) -> None:
+        with self._lock:
+            if consumer in self._consumers:
+                self._consumers.remove(consumer)
+
+    # -- accounting --
+    def mem_used(self) -> int:
+        return sum(c.mem_used() for c in self._consumers)
+
+    def fair_share(self) -> int:
+        n = max(len(self._consumers), 1)
+        return self.total // n
+
+    def update_mem_used(self, updater: MemConsumer) -> None:
+        """Called by a consumer after growing; triggers spills if needed.
+
+        Decision mirrors memory_manager.rs:236-323: over budget, a grower
+        holding more than 1/8 of its fair share self-spills, otherwise the
+        largest other consumer is asked first (the reference's 16MB
+        min-trigger floor is intentionally not applied — tiny budgets must
+        force spills, which its own fuzztests also rely on).
+        """
+        used = self.mem_used()
+        if used <= self.total:
+            return
+        over = used - self.total
+        share = self.fair_share()
+        if updater.mem_used() > share // 8:
+            freed = updater.spill()
+            self._note_spill(freed)
+            over -= freed
+        while over > 0:
+            others = sorted((c for c in self._consumers
+                             if c is not updater and c.mem_used() > 0),
+                            key=lambda c: -c.mem_used())
+            if not others:
+                if updater.mem_used() > 0:
+                    freed = updater.spill()
+                    self._note_spill(freed)
+                    if freed <= 0:
+                        break
+                    over -= freed
+                    continue
+                break
+            freed = others[0].spill()
+            self._note_spill(freed)
+            if freed <= 0:
+                break
+            over -= freed
+
+    def _note_spill(self, freed: int) -> None:
+        if freed > 0:
+            self.spill_count += 1
+            self.spilled_bytes += freed
+
+
+_global = MemManager()
+
+
+def get_manager(ctx=None) -> MemManager:
+    if ctx is not None and getattr(ctx, "mem_manager", None) is not None:
+        return ctx.mem_manager
+    return _global
+
+
+def init(total: int) -> MemManager:
+    """Ref: MemManager::init(overhead x memoryFraction), exec.rs:68-71."""
+    global _global
+    _global = MemManager(total)
+    return _global
+
+
+class SpillFile:
+    """A sequence of serialized batches in a host tempfile (ref FileSpill,
+    onheap_spill.rs:26-75; format = the zstd batch frames)."""
+
+    def __init__(self, schema: Schema, dir: Optional[str] = None) -> None:
+        self.schema = schema
+        d = dir or conf.spill_dir
+        os.makedirs(d, exist_ok=True)
+        fd, self.path = tempfile.mkstemp(suffix=".spill", dir=d)
+        self._fp: Optional[BinaryIO] = os.fdopen(fd, "w+b")
+        self.bytes_written = 0
+        self.num_batches = 0
+
+    def write(self, batch: ColumnBatch) -> int:
+        n = serde.write_batch(self._fp, batch)
+        self.bytes_written += n
+        self.num_batches += 1
+        return n
+
+    def read(self) -> Iterator[ColumnBatch]:
+        self._fp.flush()
+        self._fp.seek(0)
+        return serde.read_batches(self._fp, self.schema)
+
+    def close(self) -> None:
+        if self._fp is not None:
+            self._fp.close()
+            self._fp = None
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+
+    def __del__(self):
+        self.close()
+
+
+def batch_nbytes(batch: ColumnBatch) -> int:
+    """Device-memory estimate of a batch (capacity-based, validity incl.)."""
+    total = 0
+    for c in batch.columns:
+        total += _col_nbytes(c)
+    return total
+
+
+def _col_nbytes(c) -> int:
+    from blaze_tpu.columnar.batch import ListData, StringData
+
+    n = 0
+    if isinstance(c.data, StringData):
+        n += c.data.bytes.size + 4 * c.data.lengths.shape[0]
+    elif isinstance(c.data, ListData):
+        n += 4 * c.data.offsets.shape[0] + _col_nbytes(c.data.elements)
+    else:
+        n += c.data.size * c.data.dtype.itemsize
+    if c.validity is not None:
+        n += c.validity.shape[0]
+    return n
