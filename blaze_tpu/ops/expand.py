@@ -96,6 +96,12 @@ class GenerateExec(Operator):
                 f"generate over {lcol.dtype} (only list explode supported)")
         self._elem_dtype = lcol.dtype.element
 
+        for i in self.required_cols:
+            if child.schema.fields[i].dtype.kind == T.TypeKind.LIST:
+                # repeating a list column through the fan-out gather would
+                # overflow its element storage (_list_take) — fall back
+                raise NotImplementedError(
+                    "generate with list-typed required columns")
         fields = [Field(child.schema.fields[i].name,
                         child.schema.fields[i].dtype,
                         child.schema.fields[i].nullable)

@@ -308,6 +308,12 @@ class HashJoinLikeExec(Operator):
     def _build_schema(self) -> None:
         lf = list(self.children[0].schema.fields)
         rf = list(self.children[1].schema.fields)
+        for f in lf + rf:
+            if f.dtype.kind == T.TypeKind.LIST:
+                # fan-out gathers would overflow the list element storage
+                # (_list_take preserves element capacity) — planner falls
+                # back for list-bearing joins
+                raise NotImplementedError("join over list columns")
         jt = self.join_type
         if jt in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI):
             fields = lf

@@ -131,19 +131,20 @@ class ParquetScanExec(Operator):
                         logger.warning("ignoring corrupt file %s", path)
                         continue
                     raise
-                groups = self._select_row_groups(pf)
-                self.metrics.add("row_groups_pruned",
-                                 pf.num_row_groups - len(groups))
-                if not groups:
-                    continue
-                for rb in pf.iter_batches(batch_size=self.batch_rows,
-                                          row_groups=groups,
-                                          columns=names):
-                    ctx.check_running()
-                    with self.metrics.timer("io_time_ns"):
-                        batch = self._to_device(rb, part_values)
-                    self.metrics.add("bytes_scanned", rb.nbytes)
-                    yield batch
+                with pf:  # closes the underlying (fs-provided) handle
+                    groups = self._select_row_groups(pf)
+                    self.metrics.add("row_groups_pruned",
+                                     pf.num_row_groups - len(groups))
+                    if not groups:
+                        continue
+                    for rb in pf.iter_batches(batch_size=self.batch_rows,
+                                              row_groups=groups,
+                                              columns=names):
+                        ctx.check_running()
+                        with self.metrics.timer("io_time_ns"):
+                            batch = self._to_device(rb, part_values)
+                        self.metrics.add("bytes_scanned", rb.nbytes)
+                        yield batch
 
         return count_stream(self, gen())
 
@@ -245,6 +246,8 @@ class ParquetSinkExec(Operator):
                     rows += int(batch.num_rows)
             finally:
                 writer.close()
+                if self.fs_resource_id and hasattr(sink, "close"):
+                    sink.close()
             import os
 
             nbytes = (os.path.getsize(self.path)

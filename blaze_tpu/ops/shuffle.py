@@ -125,8 +125,8 @@ class ShuffleWriterExec(Operator):
                     offs = np.concatenate([[0], np.cumsum(counts)])
                     for p in range(self.partitioning.num_partitions):
                         if counts[p]:
-                            state.push(p, hb.serialize(int(offs[p]),
-                                                       int(offs[p + 1])))
+                            state.push(p, serde.serialize_slice(
+                                hb, int(offs[p]), int(offs[p + 1])))
             with self.metrics.timer():
                 lengths = self._commit(state)
             self.metrics.add("shuffle_bytes_written", int(sum(lengths)))
@@ -258,8 +258,8 @@ class RssShuffleWriterExec(ShuffleWriterExec):
                 offs = np.concatenate([[0], np.cumsum(counts)])
                 for p in range(P):
                     if counts[p]:
-                        writer.write(p, hb.serialize(int(offs[p]),
-                                                     int(offs[p + 1])))
+                        writer.write(p, serde.serialize_slice(
+                            hb, int(offs[p]), int(offs[p + 1])))
         writer.flush()
         return iter(())
 

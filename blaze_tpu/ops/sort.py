@@ -118,9 +118,16 @@ class ExternalSorter:
                 self.spill()
             yield from self._merge_runs()
         finally:
-            self.manager.unregister(self)
-            for r in self.runs:
-                r.close()
+            self.abort()
+
+    def abort(self) -> None:
+        """Idempotent cleanup (also the error path: SortExec wraps its
+        stream in try/finally so a cancelled query never leaks the
+        MemManager registration or spill files)."""
+        self.manager.unregister(self)
+        self.pending, self.pending_bytes = [], 0
+        for r in self.runs:
+            r.close()
 
     # -- k-way merge of sorted runs --
     def _head_key(self, batch: ColumnBatch, row: int) -> tuple:
@@ -148,8 +155,15 @@ class ExternalSorter:
 
     def _merge_runs(self):
         streams = [iter(r.read()) for r in self.runs]
-        current: List[Optional[ColumnBatch]] = [next(s, None)
-                                                for s in streams]
+
+        def pull(i):
+            """Next batch of run i with its head key computed ONCE (the
+            encoded keys of a loaded batch never change across merge
+            iterations, so recomputing per loop would be pure waste)."""
+            b = next(streams[i], None)
+            return None if b is None else (b, self._head_key(b, 0))
+
+        current = [pull(i) for i in range(len(streams))]
         carry: Optional[ColumnBatch] = None
         while True:
             active = [i for i, c in enumerate(current) if c is not None]
@@ -157,24 +171,24 @@ class ExternalSorter:
                 if carry is not None and int(carry.num_rows) > 0:
                     yield carry
                 return
-            heads = {i: self._head_key(current[i], 0) for i in active}
-            i_min = min(active, key=lambda i: heads[i])
+            i_min = min(active, key=lambda i: current[i][1])
+            head_batch = current[i_min][0]
             parts = ([carry] if carry is not None and
                      int(carry.num_rows) > 0 else [])
-            parts.append(current[i_min])
+            parts.append(head_batch)
             pool = (parts[0] if len(parts) == 1 else
                     concat_batches(parts, self.schema))
             pool = sorted_batch_jit(pool, self.specs)
-            current[i_min] = next(streams[i_min], None)
+            current[i_min] = pull(i_min)
             others = [i for i in active if i != i_min]
             if not others and current[i_min] is None:
                 if int(pool.num_rows) > 0:
                     yield pool
                 carry = None
                 continue
-            bounds = [heads[i] for i in others]
+            bounds = [current[i][1] for i in others]
             if current[i_min] is not None:
-                bounds.append(self._head_key(current[i_min], 0))
+                bounds.append(current[i_min][1])
             bound = min(bounds)
             emit, carry = self._split_leq(pool, bound)
             if int(emit.num_rows) > 0:
@@ -211,17 +225,20 @@ class SortExec(Operator):
 
             sorter = ExternalSorter(self.schema, self.specs,
                                     M.get_manager(ctx))
-            for batch in child.execute(ctx):
-                ctx.check_running()
-                if int(batch.num_rows):
-                    with self.metrics.timer():
-                        sorter.add(batch)
-            runs = sorter.runs  # finish() may add a final spill run
-            with self.metrics.timer():
-                yield from sorter.finish()
-            self.metrics.add("spill_count", len(runs))
-            self.metrics.add("spilled_bytes",
-                             sum(r.bytes_written for r in runs))
+            try:
+                for batch in child.execute(ctx):
+                    ctx.check_running()
+                    if int(batch.num_rows):
+                        with self.metrics.timer():
+                            sorter.add(batch)
+                runs = sorter.runs  # finish() may add a final spill run
+                with self.metrics.timer():
+                    yield from sorter.finish()
+                self.metrics.add("spill_count", len(runs))
+                self.metrics.add("spilled_bytes",
+                                 sum(r.bytes_written for r in runs))
+            finally:
+                sorter.abort()
 
         return count_stream(self, gen())
 

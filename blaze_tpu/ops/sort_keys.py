@@ -137,15 +137,8 @@ def batch_sort_keys(batch: ColumnBatch, specs: Sequence[SortSpec],
 def sort_batch(batch: ColumnBatch, specs: Sequence[SortSpec],
                max_string_words: int = DEFAULT_MAX_STRING_WORDS,
                ) -> ColumnBatch:
-    """Reorder all rows by the sort specs (jit-safe, shape-preserving).
-
-    1-D column leaves ride the variadic sort as payload operands; 2-D string
-    byte matrices are gathered afterwards through the sorted iota (the only
-    gather, unavoidable for matrix payloads).
-    """
+    """Reorder all rows by the sort specs (jit-safe, shape-preserving)."""
     keys = batch_sort_keys(batch, specs, max_string_words)
-    iota = jnp.arange(batch.capacity, dtype=jnp.int32)
-
     return permute_by_keys(batch, keys)
 
 

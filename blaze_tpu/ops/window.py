@@ -55,6 +55,33 @@ class WindowCall:
         return self.fn in ("row_number", "rank", "dense_rank")
 
 
+class _WindowBuffer:
+    name = "window"
+
+    def __init__(self, manager) -> None:
+        from blaze_tpu.runtime import memory as M
+
+        self.batches: List[ColumnBatch] = []
+        self.bytes = 0
+        self.manager = manager
+        self._M = M
+        manager.register(self)
+
+    def mem_used(self) -> int:
+        return self.bytes
+
+    def spill(self) -> int:
+        return 0  # windows cannot shed state yet; usage stays visible
+
+    def add(self, b: ColumnBatch) -> None:
+        self.batches.append(b)
+        self.bytes += self._M.batch_nbytes(b)
+        self.manager.update_mem_used(self)
+
+    def close(self) -> None:
+        self.manager.unregister(self)
+
+
 class WindowExec(Operator):
     def __init__(self, child: Operator, calls: Sequence[WindowCall],
                  partition_exprs: Sequence[ir.Expr],
@@ -94,14 +121,28 @@ class WindowExec(Operator):
 
     def execute(self, ctx: ExecContext) -> BatchStream:
         def gen():
-            batches = list(self.children[0].execute(ctx))
-            if not batches:
-                return
-            big = concat_batches(batches, self.children[0].schema)
-            key = ("window_kernel", self.plan_key(), big.shape_key())
-            with self.metrics.timer():
-                out = jit_cache.get_or_compile(key, lambda: self._kernel)(big)
-            yield out
+            from blaze_tpu.runtime import memory as M
+
+            # Whole-input materialization (window semantics need complete
+            # partitions). Registered with the MemManager so the buffered
+            # bytes are visible to the budget; it cannot spill itself yet —
+            # partition-bounded streaming windows are a follow-up.
+            buf = _WindowBuffer(M.get_manager(ctx))
+            try:
+                for b in self.children[0].execute(ctx):
+                    ctx.check_running()
+                    if int(b.num_rows):
+                        buf.add(b)
+                if not buf.batches:
+                    return
+                big = concat_batches(buf.batches, self.children[0].schema)
+                key = ("window_kernel", self.plan_key(), big.shape_key())
+                with self.metrics.timer():
+                    out = jit_cache.get_or_compile(
+                        key, lambda: self._kernel)(big)
+                yield out
+            finally:
+                buf.close()
 
         return count_stream(self, gen())
 

@@ -1,0 +1,37 @@
+"""Python side of the native callNative contract.
+
+Ref: the reference's callNative decodes a TaskDefinition, builds the plan
+and streams Arrow batches back over FFI (blaze/src/exec.rs:86-131,
+rt.rs:38-205). Here the C++ layer (native/src/task_runtime.cpp) calls
+`run_task_serialized(bytes) -> bytes`: decode the TaskDefinition, execute
+the plan on this process's jax engine, and return the concatenated BTB1
+result frames (the embedding layer streams them back to the JVM).
+"""
+
+from __future__ import annotations
+
+import struct
+
+from blaze_tpu.columnar import serde
+from blaze_tpu.runtime.executor import execute_plan
+from blaze_tpu.ops.base import ExecContext
+
+
+def init(mem_budget_bytes: bytes) -> None:
+    """bn_init hook: set the engine memory budget (little-endian i64)."""
+    from blaze_tpu.runtime import memory
+
+    (budget,) = struct.unpack("<q", mem_budget_bytes)
+    if budget > 0:
+        memory.init(budget)
+
+
+def run_task_serialized(task_def: bytes) -> bytes:
+    from blaze_tpu.plan import decode_task_definition
+
+    plan, td = decode_task_definition(task_def)
+    ctx = ExecContext(partition=td.partition_id)
+    out = bytearray()
+    for batch in execute_plan(plan, ctx):
+        out += serde.serialize_batch(batch)
+    return bytes(out)

@@ -1,0 +1,100 @@
+/* blaze-tpu native runtime — C ABI.
+ *
+ * Role parity with the reference's native crates (SURVEY.md §2.4): where
+ * Blaze has Rust for the engine runtime, this C++ layer owns the host-side
+ * hot paths around the jax/XLA compute engine:
+ *   - Spark-compatible murmur3 column hashing + pmod partition ids
+ *     (ref datafusion-ext-commons spark_hash.rs)
+ *   - the BTB1 compact batch frame format (encode), byte-compatible with
+ *     columnar/serde.py (ref datafusion-ext-commons io/batch_serde.rs)
+ *   - the shuffle map-output writer: per-partition frame buffers with
+ *     tempfile spill and .data/.index commit (ref shuffle/
+ *     sort_repartitioner.rs write path + IndexShuffleBlockResolver format)
+ *   - the task runtime entry (init/call/finalize), which drives the Python
+ *     engine through the embedded interpreter — the JNI shim in
+ *     jni_bridge.cpp exposes these as Java_..._initNative etc. when built
+ *     against a JDK (ref blaze/src/exec.rs:54-135).
+ *
+ * All functions return 0 on success, negative on error unless noted.
+ */
+
+#ifndef BLAZE_NATIVE_H
+#define BLAZE_NATIVE_H
+
+#include <stdint.h>
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ---- murmur3 (bit-exact Spark Murmur3_x86_32) ---- */
+
+/* hash int32 values into h (seeds updated in place; null rows skipped) */
+void bn_hash_i32(const int32_t* v, const uint8_t* validity, int64_t n,
+                 uint32_t* h);
+void bn_hash_i64(const int64_t* v, const uint8_t* validity, int64_t n,
+                 uint32_t* h);
+/* fixed-width string matrix (n x width), lengths per row */
+void bn_hash_bytes(const uint8_t* mat, const int32_t* lengths, int64_t n,
+                   int32_t width, const uint8_t* validity, uint32_t* h);
+/* partition ids: pmod(hash, P) with Spark seed 42 applied by caller
+   convention (h arrays must be initialized to the seed) */
+void bn_pmod(const uint32_t* h, int64_t n, int32_t num_partitions,
+             int32_t* pid);
+
+/* ---- batch frame serialization (format: columnar/serde.py BTB1) ---- */
+
+typedef struct {
+  uint8_t kind;            /* 0=num, 1=str, 2=null */
+  uint8_t item_size;       /* numeric: bytes per value (bool=1) */
+  const uint8_t* data;     /* numeric: n*item_size; str: n*width matrix */
+  int32_t width;           /* str matrix width */
+  const int32_t* lengths;  /* str: n lengths */
+  const uint8_t* validity; /* n bool bytes or NULL */
+} bn_col;
+
+/* upper bound for the output buffer of bn_serialize */
+int64_t bn_serialize_bound(const bn_col* cols, int32_t ncols, int64_t lo,
+                           int64_t hi);
+/* serialize rows [lo, hi) into out; returns frame length or <0 */
+int64_t bn_serialize(const bn_col* cols, int32_t ncols, int64_t lo,
+                     int64_t hi, int32_t level, uint8_t* out,
+                     int64_t out_cap);
+
+/* ---- shuffle map-output writer ---- */
+
+typedef struct bn_shuffle_writer bn_shuffle_writer;
+
+bn_shuffle_writer* bn_shuffle_new(int32_t num_partitions,
+                                  const char* spill_dir,
+                                  int64_t mem_budget);
+int bn_shuffle_push(bn_shuffle_writer* w, int32_t partition,
+                    const uint8_t* frame, int64_t len);
+int64_t bn_shuffle_mem_used(const bn_shuffle_writer* w);
+int bn_shuffle_spill(bn_shuffle_writer* w);
+/* commit: writes .data + little-endian u64 offsets .index; fills
+   lengths[num_partitions] */
+int bn_shuffle_commit(bn_shuffle_writer* w, const char* data_path,
+                      const char* index_path, int64_t* lengths);
+void bn_shuffle_free(bn_shuffle_writer* w);
+
+/* ---- task runtime (ref exec.rs initNative/callNative/finalizeNative) ---- */
+
+/* initialize the engine (idempotent): memory budget in bytes */
+int bn_init(int64_t mem_budget);
+/* run a serialized TaskDefinition through the Python engine; on success
+ * *out/*out_len hold a malloc'd concatenation of BTB1 result frames the
+ * caller frees with bn_free_buffer. Returns 0 or negative error. */
+int bn_call(const uint8_t* task_def, int64_t len, uint8_t** out,
+            int64_t* out_len);
+/* last error message (thread-local), empty string if none */
+const char* bn_last_error(void);
+int bn_finalize(void);
+void bn_free_buffer(uint8_t* buf);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* BLAZE_NATIVE_H */

@@ -1,0 +1,98 @@
+// BTB1 batch frame encoder — byte-compatible with columnar/serde.py
+// (ref role: datafusion-ext-commons io/batch_serde.rs, the zstd level-1
+// column-wise shuffle/spill/broadcast wire format with bit-packed validity).
+
+#include <cstring>
+#include <vector>
+
+#include <zstd.h>
+
+#include "blaze_native.h"
+
+namespace {
+
+void put_u32(std::vector<uint8_t>& out, uint32_t v) {
+  out.push_back(v & 0xFF);
+  out.push_back((v >> 8) & 0xFF);
+  out.push_back((v >> 16) & 0xFF);
+  out.push_back((v >> 24) & 0xFF);
+}
+
+void put_u16(std::vector<uint8_t>& out, uint16_t v) {
+  out.push_back(v & 0xFF);
+  out.push_back((v >> 8) & 0xFF);
+}
+
+void pack_validity(std::vector<uint8_t>& out, const uint8_t* validity,
+                   int64_t lo, int64_t hi) {
+  int64_t n = hi - lo;
+  int64_t nbytes = (n + 7) / 8;
+  size_t base = out.size();
+  out.resize(base + nbytes, 0);
+  for (int64_t i = 0; i < n; ++i) {
+    if (validity[lo + i]) out[base + (i >> 3)] |= (1u << (i & 7));
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t bn_serialize_bound(const bn_col* cols, int32_t ncols, int64_t lo,
+                           int64_t hi) {
+  int64_t n = hi - lo;
+  int64_t raw = 6;
+  for (int32_t c = 0; c < ncols; ++c) {
+    raw += 1 + (n + 7) / 8;
+    if (cols[c].kind == 1) {
+      raw += 4 + 4 * n;
+      for (int64_t i = lo; i < hi; ++i) raw += cols[c].lengths[i];
+    } else if (cols[c].kind == 0) {
+      raw += n * cols[c].item_size;
+    }
+  }
+  return 12 + static_cast<int64_t>(ZSTD_compressBound(raw));
+}
+
+int64_t bn_serialize(const bn_col* cols, int32_t ncols, int64_t lo,
+                     int64_t hi, int32_t level, uint8_t* out,
+                     int64_t out_cap) {
+  int64_t n = hi - lo;
+  if (n < 0) return -1;
+  std::vector<uint8_t> raw;
+  put_u32(raw, static_cast<uint32_t>(n));
+  put_u16(raw, static_cast<uint16_t>(ncols));
+  for (int32_t c = 0; c < ncols; ++c) {
+    const bn_col& col = cols[c];
+    raw.push_back(col.validity ? 1 : 0);
+    if (col.validity) pack_validity(raw, col.validity, lo, hi);
+    if (col.kind == 2) continue;  // null column: no payload
+    if (col.kind == 1) {
+      uint64_t total = 0;
+      for (int64_t i = lo; i < hi; ++i) total += col.lengths[i];
+      put_u32(raw, static_cast<uint32_t>(total));
+      for (int64_t i = lo; i < hi; ++i)
+        put_u32(raw, static_cast<uint32_t>(col.lengths[i]));
+      for (int64_t i = lo; i < hi; ++i) {
+        const uint8_t* row = col.data + i * col.width;
+        raw.insert(raw.end(), row, row + col.lengths[i]);
+      }
+    } else {
+      const uint8_t* base = col.data + lo * col.item_size;
+      raw.insert(raw.end(), base, base + n * col.item_size);
+    }
+  }
+  size_t bound = ZSTD_compressBound(raw.size());
+  if (out_cap < static_cast<int64_t>(12 + bound)) return -2;
+  size_t csize = ZSTD_compress(out + 12, bound, raw.data(), raw.size(),
+                               level);
+  if (ZSTD_isError(csize)) return -3;
+  std::memcpy(out, "BTB1", 4);
+  uint32_t raw_len = static_cast<uint32_t>(raw.size());
+  uint32_t comp_len = static_cast<uint32_t>(csize);
+  std::memcpy(out + 4, &raw_len, 4);
+  std::memcpy(out + 8, &comp_len, 4);
+  return 12 + static_cast<int64_t>(csize);
+}
+
+}  // extern "C"

@@ -1,0 +1,72 @@
+// JNI shim over the C ABI (ref blaze-jni-bridge + the JNI exports of
+// blaze/src/exec.rs: Java_org_apache_spark_sql_blaze_JniBridge_initNative /
+// callNative / finalizeNative). Compiled only when a JDK's jni.h is on the
+// include path (this image has none); the C ABI in blaze_native.h is the
+// stable boundary either way, so the Spark-side JniBridge maps 1:1:
+//
+//   initNative(J)      -> bn_init(mem_budget)
+//   callNative([B)     -> bn_call(task_def) -> result frames as byte[]
+//   finalizeNative()   -> bn_finalize()
+//
+// Error relay: bn_last_error() -> thrown as java.lang.RuntimeException
+// (ref lib.rs:73-84 error conversion into JVM exceptions).
+
+#if defined(__has_include)
+#if __has_include(<jni.h>)
+#define BLAZE_HAS_JNI 1
+#endif
+#endif
+
+#ifdef BLAZE_HAS_JNI
+
+#include <jni.h>
+
+#include "blaze_native.h"
+
+namespace {
+
+void throw_runtime(JNIEnv* env, const char* msg) {
+  jclass cls = env->FindClass("java/lang/RuntimeException");
+  if (cls) env->ThrowNew(cls, msg);
+}
+
+}  // namespace
+
+extern "C" {
+
+JNIEXPORT void JNICALL
+Java_org_blaze_1tpu_JniBridge_initNative(JNIEnv* env, jclass,
+                                         jlong mem_budget) {
+  if (bn_init(static_cast<int64_t>(mem_budget)) != 0)
+    throw_runtime(env, bn_last_error());
+}
+
+JNIEXPORT jbyteArray JNICALL
+Java_org_blaze_1tpu_JniBridge_callNative(JNIEnv* env, jclass,
+                                         jbyteArray task_def) {
+  jsize len = env->GetArrayLength(task_def);
+  jbyte* buf = env->GetByteArrayElements(task_def, nullptr);
+  uint8_t* out = nullptr;
+  int64_t out_len = 0;
+  int rc = bn_call(reinterpret_cast<const uint8_t*>(buf), len, &out,
+                   &out_len);
+  env->ReleaseByteArrayElements(task_def, buf, JNI_ABORT);
+  if (rc != 0) {
+    throw_runtime(env, bn_last_error());
+    return nullptr;
+  }
+  jbyteArray result = env->NewByteArray(static_cast<jsize>(out_len));
+  env->SetByteArrayRegion(result, 0, static_cast<jsize>(out_len),
+                          reinterpret_cast<const jbyte*>(out));
+  bn_free_buffer(out);
+  return result;
+}
+
+JNIEXPORT void JNICALL
+Java_org_blaze_1tpu_JniBridge_finalizeNative(JNIEnv*, jclass) {
+  bn_finalize();
+}
+
+}  // extern "C"
+
+#endif  // BLAZE_HAS_JNI

@@ -1,0 +1,98 @@
+// Spark Murmur3_x86_32 column kernels (ref datafusion-ext-commons
+// spark_hash.rs:27-90 semantics; cited for parity, implemented fresh).
+// Null rows leave the running hash untouched so multi-column hashing
+// chains seeds exactly like the device kernels in exprs/hash.py.
+
+#include "blaze_native.h"
+
+namespace {
+
+inline uint32_t rotl32(uint32_t x, int r) { return (x << r) | (x >> (32 - r)); }
+
+inline uint32_t mix_k1(uint32_t k1) {
+  k1 *= 0xCC9E2D51u;
+  k1 = rotl32(k1, 15);
+  return k1 * 0x1B873593u;
+}
+
+inline uint32_t mix_h1(uint32_t h1, uint32_t k1) {
+  h1 ^= k1;
+  h1 = rotl32(h1, 13);
+  return h1 * 5u + 0xE6546B64u;
+}
+
+inline uint32_t fmix(uint32_t h1, uint32_t len) {
+  h1 ^= len;
+  h1 ^= h1 >> 16;
+  h1 *= 0x85EBCA6Bu;
+  h1 ^= h1 >> 13;
+  h1 *= 0xC2B2AE35u;
+  return h1 ^ (h1 >> 16);
+}
+
+inline uint32_t hash_int(uint32_t v, uint32_t seed) {
+  return fmix(mix_h1(seed, mix_k1(v)), 4);
+}
+
+inline uint32_t hash_long(uint64_t v, uint32_t seed) {
+  uint32_t low = static_cast<uint32_t>(v);
+  uint32_t high = static_cast<uint32_t>(v >> 32);
+  uint32_t h1 = mix_h1(seed, mix_k1(low));
+  h1 = mix_h1(h1, mix_k1(high));
+  return fmix(h1, 8);
+}
+
+}  // namespace
+
+extern "C" {
+
+void bn_hash_i32(const int32_t* v, const uint8_t* validity, int64_t n,
+                 uint32_t* h) {
+  for (int64_t i = 0; i < n; ++i) {
+    if (validity && !validity[i]) continue;
+    h[i] = hash_int(static_cast<uint32_t>(v[i]), h[i]);
+  }
+}
+
+void bn_hash_i64(const int64_t* v, const uint8_t* validity, int64_t n,
+                 uint32_t* h) {
+  for (int64_t i = 0; i < n; ++i) {
+    if (validity && !validity[i]) continue;
+    h[i] = hash_long(static_cast<uint64_t>(v[i]), h[i]);
+  }
+}
+
+void bn_hash_bytes(const uint8_t* mat, const int32_t* lengths, int64_t n,
+                   int32_t width, const uint8_t* validity, uint32_t* h) {
+  for (int64_t i = 0; i < n; ++i) {
+    if (validity && !validity[i]) continue;
+    const uint8_t* row = mat + i * width;
+    int32_t len = lengths[i];
+    uint32_t h1 = h[i];
+    int32_t nfull = len / 4;
+    for (int32_t w = 0; w < nfull; ++w) {
+      uint32_t word = static_cast<uint32_t>(row[4 * w]) |
+                      (static_cast<uint32_t>(row[4 * w + 1]) << 8) |
+                      (static_cast<uint32_t>(row[4 * w + 2]) << 16) |
+                      (static_cast<uint32_t>(row[4 * w + 3]) << 24);
+      h1 = mix_h1(h1, mix_k1(word));
+    }
+    for (int32_t p = nfull * 4; p < len; ++p) {
+      // tail bytes mixed individually as SIGNED bytes
+      uint32_t sbyte = static_cast<uint32_t>(
+          static_cast<int32_t>(static_cast<int8_t>(row[p])));
+      h1 = mix_h1(h1, mix_k1(sbyte));
+    }
+    h[i] = fmix(h1, static_cast<uint32_t>(len));
+  }
+}
+
+void bn_pmod(const uint32_t* h, int64_t n, int32_t num_partitions,
+             int32_t* pid) {
+  for (int64_t i = 0; i < n; ++i) {
+    int32_t r = static_cast<int32_t>(h[i]) % num_partitions;
+    pid[i] = r < 0 ? r + num_partitions : r;
+  }
+}
+
+}  // extern "C"

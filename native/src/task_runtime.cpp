@@ -1,0 +1,200 @@
+// Task runtime entry: init / call / finalize (ref blaze/src/exec.rs:54-135
+// initNative/callNative/finalizeNative and the per-task runtime of rt.rs).
+//
+// Architecture note: the reference's native engine IS the compute engine;
+// here the compute engine is jax/XLA driven from Python, so callNative's job
+// is to hand the serialized TaskDefinition to the in-process Python engine
+// (blaze_tpu.runtime.native_entry.run_task) and hand the serialized result
+// frames back. The Python C-API symbols are resolved lazily with dlsym so
+// this library loads cleanly both inside a Python process (ctypes) and
+// inside a JVM that has embedded/loaded libpython (the deployment mode a
+// Spark executor uses).
+
+#include <dlfcn.h>
+
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#include "blaze_native.h"
+
+namespace {
+
+thread_local std::string g_last_error;
+
+// minimal Python C-API surface, resolved at runtime
+struct PyApi {
+  void* (*GILState_Ensure)();
+  void (*GILState_Release)(void*);
+  void* (*Import_ImportModule)(const char*);
+  void* (*Object_GetAttrString)(void*, const char*);
+  void* (*Bytes_FromStringAndSize)(const char*, ssize_t);
+  void* (*Object_CallFunctionObjArgs)(void*, ...);
+  char* (*Bytes_AsString)(void*);
+  ssize_t (*Bytes_Size)(void*);
+  void (*Dec)(void*);
+  void* (*Err_Occurred)();
+  void (*Err_Fetch)(void**, void**, void**);
+  void* (*Object_Str)(void*);
+  const char* (*Unicode_AsUTF8)(void*);
+  bool ok = false;
+};
+
+PyApi g_py;
+
+bool load_py_api() {
+  if (g_py.ok) return true;
+  void* h = RTLD_DEFAULT;
+  auto sym = [&](const char* name) -> void* {
+    void* s = dlsym(h, name);
+    if (!s) {
+      // try an explicitly loaded libpython (JVM embedding path)
+      static void* lib = dlopen("libpython3.12.so.1.0",
+                                RTLD_NOW | RTLD_GLOBAL);
+      if (lib) s = dlsym(lib, name);
+    }
+    return s;
+  };
+  g_py.GILState_Ensure =
+      reinterpret_cast<void* (*)()>(sym("PyGILState_Ensure"));
+  g_py.GILState_Release =
+      reinterpret_cast<void (*)(void*)>(sym("PyGILState_Release"));
+  g_py.Import_ImportModule =
+      reinterpret_cast<void* (*)(const char*)>(sym("PyImport_ImportModule"));
+  g_py.Object_GetAttrString = reinterpret_cast<void* (*)(void*, const char*)>(
+      sym("PyObject_GetAttrString"));
+  g_py.Bytes_FromStringAndSize =
+      reinterpret_cast<void* (*)(const char*, ssize_t)>(
+          sym("PyBytes_FromStringAndSize"));
+  g_py.Object_CallFunctionObjArgs = reinterpret_cast<void* (*)(void*, ...)>(
+      sym("PyObject_CallFunctionObjArgs"));
+  g_py.Bytes_AsString =
+      reinterpret_cast<char* (*)(void*)>(sym("PyBytes_AsString"));
+  g_py.Bytes_Size = reinterpret_cast<ssize_t (*)(void*)>(sym("PyBytes_Size"));
+  g_py.Dec = reinterpret_cast<void (*)(void*)>(sym("Py_DecRef"));
+  g_py.Err_Occurred = reinterpret_cast<void* (*)()>(sym("PyErr_Occurred"));
+  g_py.Err_Fetch = reinterpret_cast<void (*)(void**, void**, void**)>(
+      sym("PyErr_Fetch"));
+  g_py.Object_Str = reinterpret_cast<void* (*)(void*)>(sym("PyObject_Str"));
+  g_py.Unicode_AsUTF8 =
+      reinterpret_cast<const char* (*)(void*)>(sym("PyUnicode_AsUTF8"));
+  g_py.ok = g_py.GILState_Ensure && g_py.Import_ImportModule &&
+            g_py.Object_CallFunctionObjArgs && g_py.Bytes_AsString;
+  return g_py.ok;
+}
+
+void capture_py_error() {
+  if (!g_py.Err_Occurred || !g_py.Err_Occurred()) {
+    g_last_error = "python call failed (no exception info)";
+    return;
+  }
+  void *type = nullptr, *value = nullptr, *tb = nullptr;
+  g_py.Err_Fetch(&type, &value, &tb);
+  if (value && g_py.Object_Str && g_py.Unicode_AsUTF8) {
+    void* s = g_py.Object_Str(value);
+    const char* msg = s ? g_py.Unicode_AsUTF8(s) : nullptr;
+    g_last_error = msg ? msg : "python exception";
+    if (s) g_py.Dec(s);
+  } else {
+    g_last_error = "python exception";
+  }
+  if (type) g_py.Dec(type);
+  if (value) g_py.Dec(value);
+  if (tb) g_py.Dec(tb);
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* bn_last_error(void) { return g_last_error.c_str(); }
+
+int bn_init(int64_t mem_budget) {
+  if (!load_py_api()) {
+    g_last_error = "python runtime not available";
+    return -1;
+  }
+  void* gil = g_py.GILState_Ensure();
+  int rc = 0;
+  void* mod = g_py.Import_ImportModule("blaze_tpu.runtime.native_entry");
+  if (!mod) {
+    capture_py_error();
+    rc = -2;
+  } else {
+    void* fn = g_py.Object_GetAttrString(mod, "init");
+    if (fn) {
+      void* arg = g_py.Bytes_FromStringAndSize(
+          reinterpret_cast<const char*>(&mem_budget), sizeof(mem_budget));
+      void* res = g_py.Object_CallFunctionObjArgs(fn, arg, nullptr);
+      if (!res) {
+        capture_py_error();
+        rc = -3;
+      } else {
+        g_py.Dec(res);
+      }
+      if (arg) g_py.Dec(arg);
+      g_py.Dec(fn);
+    }
+    g_py.Dec(mod);
+  }
+  g_py.GILState_Release(gil);
+  return rc;
+}
+
+int bn_call(const uint8_t* task_def, int64_t len, uint8_t** out,
+            int64_t* out_len) {
+  if (!load_py_api()) {
+    g_last_error = "python runtime not available";
+    return -1;
+  }
+  void* gil = g_py.GILState_Ensure();
+  int rc = 0;
+  *out = nullptr;
+  *out_len = 0;
+  void* mod = g_py.Import_ImportModule("blaze_tpu.runtime.native_entry");
+  if (!mod) {
+    capture_py_error();
+    g_py.GILState_Release(gil);
+    return -2;
+  }
+  void* fn = g_py.Object_GetAttrString(mod, "run_task_serialized");
+  if (!fn) {
+    capture_py_error();
+    g_py.Dec(mod);
+    g_py.GILState_Release(gil);
+    return -3;
+  }
+  void* arg = g_py.Bytes_FromStringAndSize(
+      reinterpret_cast<const char*>(task_def), len);
+  void* res = g_py.Object_CallFunctionObjArgs(fn, arg, nullptr);
+  if (!res) {
+    capture_py_error();
+    rc = -4;
+  } else {
+    ssize_t sz = g_py.Bytes_Size(res);
+    char* data = g_py.Bytes_AsString(res);
+    if (sz < 0 || !data) {
+      g_last_error = "run_task_serialized must return bytes";
+      rc = -5;
+    } else {
+      *out = static_cast<uint8_t*>(std::malloc(sz));
+      std::memcpy(*out, data, sz);
+      *out_len = sz;
+    }
+    g_py.Dec(res);
+  }
+  g_py.Dec(arg);
+  g_py.Dec(fn);
+  g_py.Dec(mod);
+  g_py.GILState_Release(gil);
+  return rc;
+}
+
+int bn_finalize(void) {
+  g_last_error.clear();
+  return 0;
+}
+
+void bn_free_buffer(uint8_t* buf) { std::free(buf); }
+
+}  // extern "C"
