@@ -300,7 +300,13 @@ class IpcReaderExec(Operator):
     def execute(self, ctx: ExecContext) -> BatchStream:
         def gen():
             provider = resources.get(self.resource_id)
-            source = provider() if callable(provider) else provider
+            if callable(provider):
+                try:
+                    source = provider(ctx.partition)
+                except TypeError:
+                    source = provider()
+            else:
+                source = provider
             for seg in source:
                 ctx.check_running()
                 if isinstance(seg, ColumnBatch):

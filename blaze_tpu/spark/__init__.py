@@ -1,0 +1,19 @@
+"""Driver-side planner: Spark physical plan -> native plan protobufs.
+
+Ref: the spark-extension JVM layer (SURVEY.md §2.1-2.3) —
+BlazeSparkSessionExtension/BlazeConvertStrategy/BlazeConverters and the
+per-operator NativeXxxExec plan-node bases. The reference implements this in
+Scala against Spark's Catalyst classes; this package implements the same
+planner logic (two-pass convertibility tagging, inefficiency fixpoint,
+per-operator tryConvert with fallback-by-construction, join key
+normalization, partial/final agg pairing) over a serializable SparkPlan
+model (`plan_model`), so a thin JVM shim only has to mirror plan trees into
+that model and register task resources.
+"""
+
+from blaze_tpu.spark.plan_model import SparkPlan
+from blaze_tpu.spark.convert_strategy import apply_strategy, ConvertStrategy
+from blaze_tpu.spark.converters import convert_spark_plan
+
+__all__ = ["SparkPlan", "apply_strategy", "ConvertStrategy",
+           "convert_spark_plan"]

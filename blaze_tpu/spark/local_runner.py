@@ -1,0 +1,131 @@
+"""Local multi-stage execution: the test/standalone stand-in for Spark.
+
+Ref topology: SURVEY.md §3.3 — in deployment, Spark schedules stages and
+moves shuffle blocks; this runner executes the same per-task native plans
+(stages.plan_stages output) in dependency order in one process, wiring the
+resource registry exactly the way the JVM shim would:
+
+  map stage    : one task per upstream partition; each commits
+                 <dir>/stage<S>_map<M>.data/.index
+  reduce reads : "shuffle:<S>" resolves to a per-partition iterator over
+                 all map outputs' partition-p segments (the MapStatus fetch)
+  broadcast    : one collect task; "broadcast:<S>" replays its frames
+
+This is also the local-mode execution path (the reference's CI runs Spark
+local-mode for the same reason, .github/workflows/tpcds.yml).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Dict, List, Optional
+
+from blaze_tpu.columnar.batch import ColumnBatch
+from blaze_tpu.ops.base import ExecContext
+from blaze_tpu.ops.common import concat_batches
+from blaze_tpu.plan import decode_plan
+from blaze_tpu.plan import plan_pb2 as pb
+from blaze_tpu.runtime import resources
+from blaze_tpu.runtime.executor import execute_plan
+from blaze_tpu.spark.convert_strategy import apply_strategy
+from blaze_tpu.spark.plan_model import SparkPlan
+from blaze_tpu.spark.stages import Stage, plan_stages
+from blaze_tpu.ops.shuffle import read_shuffle_partition
+
+
+def run_plan(root: SparkPlan, num_partitions: int = 4,
+             work_dir: Optional[str] = None) -> ColumnBatch:
+    """Convert + execute a Spark plan tree locally; returns the collected
+    result batch."""
+    apply_strategy(root)
+    stages = plan_stages(root, default_partitions=num_partitions)
+    work_dir = work_dir or tempfile.mkdtemp(prefix="blaze_tpu_stages_")
+    os.makedirs(work_dir, exist_ok=True)
+
+    # stage -> map outputs [(data, index)] for shuffle; frames for broadcast
+    shuffle_outputs: Dict[int, List[tuple]] = {}
+
+    for stage in stages:
+        if stage.kind == "shuffle_map":
+            _run_shuffle_stage(stage, stages, work_dir, shuffle_outputs)
+        elif stage.kind == "broadcast":
+            _run_broadcast_stage(stage)
+        else:
+            return _run_result_stage(stage, num_partitions)
+    raise AssertionError("no result stage produced")
+
+
+def _input_tasks(stage: Stage, stages: List[Stage]) -> int:
+    """Map task count = upstream shuffle partition count (1 for scans)."""
+    if not stage.depends_on:
+        return 1
+    return max(stages[d].num_partitions for d in stage.depends_on
+               if stages[d].kind == "shuffle_map") if any(
+        stages[d].kind == "shuffle_map" for d in stage.depends_on) else 1
+
+
+def _schema_of_reader(node: pb.PlanNode):
+    from blaze_tpu.plan.from_proto import decode_schema
+
+    return decode_schema(node.ipc_reader.schema)
+
+
+def _register_shuffle_reader(sid: int, outputs: List[tuple], schema) -> None:
+    def provider(partition: int):
+        def gen():
+            for data_path, index_path in outputs:
+                yield from read_shuffle_partition(data_path, index_path,
+                                                  partition, schema)
+        return gen()
+
+    resources.put(f"shuffle:{sid}", provider)
+
+
+def _run_shuffle_stage(stage: Stage, stages: List[Stage], work_dir: str,
+                       shuffle_outputs: Dict[int, List[tuple]]) -> None:
+    ntasks = _input_tasks(stage, stages)
+    outputs = []
+    for task in range(ntasks):
+        node = pb.PlanNode()
+        node.CopyFrom(stage.plan)
+        data = os.path.join(work_dir,
+                            f"stage{stage.stage_id}_map{task}.data")
+        index = os.path.join(work_dir,
+                             f"stage{stage.stage_id}_map{task}.index")
+        node.shuffle_writer.data_file = data
+        node.shuffle_writer.index_file = index
+        op = decode_plan(node)
+        list(execute_plan(op, ExecContext(partition=task,
+                                          num_partitions=ntasks)))
+        outputs.append((data, index))
+    shuffle_outputs[stage.stage_id] = outputs
+
+    # expose to downstream readers
+    from blaze_tpu.plan.from_proto import decode_schema
+
+    # the reader schema is the writer's input schema
+    reader_schema = decode_plan(stage.plan.shuffle_writer.input).schema
+    _register_shuffle_reader(stage.stage_id, outputs, reader_schema)
+
+
+def _run_broadcast_stage(stage: Stage) -> None:
+    frames: List[bytes] = []
+    resources.put(f"broadcast_sink:{stage.stage_id}", frames.append)
+    op = decode_plan(stage.plan)
+    list(execute_plan(op, ExecContext(partition=0, num_partitions=1)))
+    resources.put(f"broadcast:{stage.stage_id}",
+                  lambda partition=0: iter(list(frames)))
+
+
+def _run_result_stage(stage: Stage, num_partitions: int) -> ColumnBatch:
+    op = decode_plan(stage.plan)
+    parts = num_partitions if stage.depends_on else 1
+    batches: List[ColumnBatch] = []
+    for p in range(parts):
+        op_p = decode_plan(stage.plan)  # fresh operator state per task
+        batches.extend(execute_plan(
+            op_p, ExecContext(partition=p, num_partitions=parts)))
+    if not batches:
+        return ColumnBatch.empty(op.schema)
+    return concat_batches(batches, op.schema)

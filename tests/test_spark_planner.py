@@ -1,0 +1,164 @@
+"""Driver-side planner: convert strategy, stage splitting, multi-stage
+execution vs pandas (the local-mode analog of the reference's TPC-DS CI,
+SURVEY.md §4.2).
+"""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from blaze_tpu.columnar import types as T
+from blaze_tpu.exprs import ir
+from blaze_tpu.spark import plan_model as P
+from blaze_tpu.spark.convert_strategy import apply_strategy
+from blaze_tpu.spark.local_runner import run_plan
+
+SS_SCHEMA = T.Schema([
+    T.Field("ss_sold_date_sk", T.INT64),
+    T.Field("ss_item_sk", T.INT64),
+    T.Field("ss_ext_sales_price", T.FLOAT64),
+])
+DD_SCHEMA = T.Schema([
+    T.Field("d_date_sk", T.INT64),
+    T.Field("d_year", T.INT32),
+    T.Field("d_moy", T.INT32),
+])
+
+
+@pytest.fixture
+def tables(tmp_path, rng):
+    n_ss, n_dd = 5000, 365
+    ss = pa.table({
+        "ss_sold_date_sk": pa.array(rng.integers(0, n_dd, n_ss), pa.int64()),
+        "ss_item_sk": pa.array(rng.integers(0, 40, n_ss), pa.int64()),
+        "ss_ext_sales_price": pa.array(rng.random(n_ss) * 100),
+    })
+    dd = pa.table({
+        "d_date_sk": pa.array(np.arange(n_dd), pa.int64()),
+        "d_year": pa.array(np.full(n_dd, 1999, np.int32)),
+        "d_moy": pa.array((np.arange(n_dd) // 30) % 12 + 1, pa.int32()),
+    })
+    ss_path = str(tmp_path / "ss.parquet")
+    dd_path = str(tmp_path / "dd.parquet")
+    pq.write_table(ss, ss_path, row_group_size=1000)
+    pq.write_table(dd, dd_path)
+    return ss, dd, ss_path, dd_path
+
+
+def _f64(p, s=None):
+    return T.FLOAT64
+
+
+def test_q3_shaped_multistage(tables):
+    """scan(ss) |> SMJ with filtered scan(dd) over a shuffle |> two-phase
+    agg over a shuffle |> sort — BASELINE config 3/5 shape."""
+    ss, dd, ss_path, dd_path = tables
+
+    ss_scan = P.scan(SS_SCHEMA, [(ss_path, [])])
+    dd_scan = P.scan(DD_SCHEMA, [(dd_path, [])])
+    dd_flt = P.filter_(dd_scan, ir.Binary(ir.BinOp.EQ, ir.col("d_moy"),
+                                          ir.lit(11)))
+    ss_x = P.shuffle_exchange(ss_scan, [ir.col("ss_sold_date_sk")], 4)
+    dd_x = P.shuffle_exchange(dd_flt, [ir.col("d_date_sk")], 4)
+    join_schema = T.Schema(list(SS_SCHEMA.fields) + list(DD_SCHEMA.fields))
+    j = P.smj(ss_x, dd_x, [ir.col("ss_sold_date_sk")], [ir.col("d_date_sk")],
+              "inner", join_schema)
+    pagg_schema = T.Schema([T.Field("item", T.INT64)])  # informational
+    partial = P.hash_agg(j, "partial", [ir.col("ss_item_sk")], ["item"],
+                         [{"fn": "sum", "args": [ir.col("ss_ext_sales_price")],
+                           "dtype": T.FLOAT64, "name": "sumsales"}],
+                         pagg_schema)
+    agg_x = P.shuffle_exchange(partial, [ir.col("item")], 4)
+    final_schema = T.Schema([T.Field("item", T.INT64),
+                             T.Field("sumsales", T.FLOAT64)])
+    final = P.hash_agg(agg_x, "final", [ir.col("item")], ["item"],
+                       [{"fn": "sum", "args": [ir.col("ss_ext_sales_price")],
+                         "dtype": T.FLOAT64, "name": "sumsales"}],
+                       final_schema)
+    srt = P.sort(final, [(ir.col("sumsales"), False, True)])
+
+    out = run_plan(srt, num_partitions=4)
+    d = out.to_numpy()
+
+    ssd, ddd = ss.to_pandas(), dd.to_pandas()
+    m = ssd.merge(ddd[ddd.d_moy == 11], left_on="ss_sold_date_sk",
+                  right_on="d_date_sk")
+    want = m.groupby("ss_item_sk")["ss_ext_sales_price"].sum().sort_values(
+        ascending=False)
+    got = [float(x) for x in d["sumsales"]]
+    np.testing.assert_allclose(got, want.to_numpy(), rtol=1e-9)
+    got_items = set(int(x) for x in np.asarray(d["item"]))
+    assert got_items == set(int(k) for k in want.index)
+
+
+def test_broadcast_join_stage(tables):
+    ss, dd, ss_path, dd_path = tables
+    ss_scan = P.scan(SS_SCHEMA, [(ss_path, [])])
+    dd_scan = P.scan(DD_SCHEMA, [(dd_path, [])])
+    dd_b = P.broadcast_exchange(P.filter_(dd_scan, ir.Binary(
+        ir.BinOp.LE, ir.col("d_date_sk"), ir.lit(50))))
+    join_schema = T.Schema(list(SS_SCHEMA.fields) + list(DD_SCHEMA.fields))
+    j = P.bhj(ss_scan, dd_b, [ir.col("ss_sold_date_sk")],
+              [ir.col("d_date_sk")], "inner", "right", join_schema)
+    out = run_plan(j, num_partitions=1)
+    ssd, ddd = ss.to_pandas(), dd.to_pandas()
+    want = ssd.merge(ddd[ddd.d_date_sk <= 50], left_on="ss_sold_date_sk",
+                     right_on="d_date_sk")
+    assert int(out.num_rows) == len(want)
+
+
+def test_strategy_tags_and_fallback():
+    # an unconvertible expression makes the node NeverConvert
+    sc = P.scan(SS_SCHEMA, [("/nonexistent.parquet", [])])
+    bad = P.filter_(sc, ir.ScalarFn("some_unknown_udf",
+                                    (ir.col("ss_item_sk"),), None))
+    good_proj = P.project(bad, [ir.col("ss_item_sk")], ["i"],
+                          T.Schema([T.Field("i", T.INT64)]))
+    apply_strategy(good_proj)
+    assert bad.convertible is False
+    assert bad.strategy == "NeverConvert"
+    assert good_proj.convertible is True
+
+
+def test_inefficient_convert_removal():
+    # native Filter over a non-native child gets demoted (ref :142-203)
+    nonnative = P.SparkPlan("SomeRowBasedExec", SS_SCHEMA, [], {})
+    flt = P.filter_(nonnative, ir.Binary(ir.BinOp.GT, ir.col("ss_item_sk"),
+                                         ir.lit(5)))
+    apply_strategy(flt)
+    assert nonnative.strategy == "NeverConvert"
+    assert flt.strategy == "NeverConvert", "filter should be demoted"
+
+    # but a native filter over a native scan stays native
+    sc = P.scan(SS_SCHEMA, [("/x.parquet", [])])
+    flt2 = P.filter_(sc, ir.Binary(ir.BinOp.GT, ir.col("ss_item_sk"),
+                                   ir.lit(5)))
+    apply_strategy(flt2)
+    assert flt2.strategy == "Default"
+    assert sc.strategy == "AlwaysConvert"
+
+
+def test_sort_sandwich_demotion():
+    nonnative = P.SparkPlan("SomeRowBasedExec", SS_SCHEMA, [], {})
+    srt = P.sort(nonnative, [(ir.col("ss_item_sk"), True, True)])
+    apply_strategy(srt)
+    assert srt.strategy == "NeverConvert"
+
+
+def test_per_op_enable_flag(tables):
+    from blaze_tpu.config import conf
+
+    ss, dd, ss_path, _ = tables
+    sc = P.scan(SS_SCHEMA, [(ss_path, [])])
+    flt = P.filter_(sc, ir.Binary(ir.BinOp.GT, ir.col("ss_item_sk"),
+                                  ir.lit(5)))
+    conf.enable_ops["filter"] = False
+    try:
+        apply_strategy(flt)
+        assert flt.convertible is False
+    finally:
+        conf.enable_ops.pop("filter")
+    apply_strategy(flt)
+    assert flt.convertible is True
