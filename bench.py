@@ -118,8 +118,10 @@ def main():
     base_gbps = _input_bytes(data) / best / 1e9
 
     # correctness: grouped sums must match numpy (last group absorbs the
-    # filtered-out sentinel rows with amount 0, so it matches too)
-    np.testing.assert_allclose(out, ref, rtol=1e-9)
+    # filtered-out sentinel rows with amount 0, so it matches too).
+    # rtol must tolerate differing float accumulation order: the TPU path
+    # sums in sorted-key order, np.add.at in row order.
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
 
     print(json.dumps({
         "metric": "scan_filter_project_groupby_sum",

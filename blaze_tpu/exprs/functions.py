@@ -39,6 +39,11 @@ def register(name: str):
     return deco
 
 
+def is_supported(name: str) -> bool:
+    """Plan-time check used by the convert strategy's expression walk."""
+    return name.lower() in _REGISTRY
+
+
 def compile_function(expr: ir.ScalarFn, schema):
     from blaze_tpu.exprs.compiler import compile_expr
 

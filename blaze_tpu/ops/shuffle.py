@@ -19,6 +19,7 @@ all_to_all variant lives in parallel/shuffle.py.
 from __future__ import annotations
 
 import dataclasses
+import inspect
 import os
 from typing import BinaryIO, Callable, Iterable, Iterator, List, Optional, Sequence
 
@@ -37,6 +38,31 @@ from blaze_tpu.ops.join import sort_batch_by_keys
 from blaze_tpu.runtime import jit_cache, resources
 
 Array = jax.Array
+
+
+def _call_provider(provider, ctx: ExecContext):
+    """Invoke a registered resource provider with as much task context as
+    its signature accepts: (partition, num_partitions) | (partition) | ().
+    Arity is decided from the signature, not by retrying on TypeError —
+    retries would mask genuine TypeErrors raised inside the provider and
+    silently substitute partition-0 data."""
+    if not callable(provider):
+        return provider
+    try:
+        params = [p for p in inspect.signature(provider).parameters.values()
+                  if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD,
+                                p.VAR_POSITIONAL)]
+        if any(p.kind == p.VAR_POSITIONAL for p in params):
+            nargs = 2
+        else:
+            nargs = min(2, len(params))
+    except (TypeError, ValueError):  # builtins without signatures
+        nargs = 1
+    if nargs == 2:
+        return provider(ctx.partition, ctx.num_partitions)
+    if nargs == 1:
+        return provider(ctx.partition)
+    return provider()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -299,14 +325,7 @@ class IpcReaderExec(Operator):
 
     def execute(self, ctx: ExecContext) -> BatchStream:
         def gen():
-            provider = resources.get(self.resource_id)
-            if callable(provider):
-                try:
-                    source = provider(ctx.partition)
-                except TypeError:
-                    source = provider()
-            else:
-                source = provider
+            source = _call_provider(resources.get(self.resource_id), ctx)
             for seg in source:
                 ctx.check_running()
                 if isinstance(seg, ColumnBatch):
@@ -343,8 +362,8 @@ class FfiReaderExec(Operator):
         def gen():
             from blaze_tpu.columnar.arrow_io import batch_from_arrow
 
-            provider = resources.get(self.export_resource_id)
-            source = provider() if callable(provider) else provider
+            source = _call_provider(resources.get(self.export_resource_id),
+                                    ctx)
             for item in source:
                 ctx.check_running()
                 if isinstance(item, ColumnBatch):

@@ -16,7 +16,9 @@ ConvertToNativeBase.scala:59-98).
 from __future__ import annotations
 
 import logging
-from typing import Callable, Dict, List, Optional
+import threading
+import uuid
+from typing import Callable, Dict, Iterator, List, Optional
 
 from blaze_tpu.columnar.types import Schema
 from blaze_tpu.config import conf
@@ -51,13 +53,32 @@ class ConversionError(Exception):
     pass
 
 
+# rid -> the non-native SparkPlan subtree behind each emitted FFI bridge.
+# The embedding layer (local_runner here; the JVM shim in deployment)
+# drains this after conversion and registers a row-export iterator per rid,
+# the ConvertToNativeBase.scala:59-98 resourcesMap handshake.
+_pending_exports: Dict[str, SparkPlan] = {}
+_exports_lock = threading.Lock()
+
+
+def drain_exports() -> Dict[str, SparkPlan]:
+    with _exports_lock:
+        out = dict(_pending_exports)
+        _pending_exports.clear()
+    return out
+
+
 def ffi_bridge(plan: SparkPlan) -> pb.PlanNode:
     """Non-native subtree boundary (ConvertToNativeExec analog)."""
+    rid = plan.attrs.get("export_resource_id")
+    if not rid:
+        rid = f"__jvm_export__:{uuid.uuid4().hex[:12]}"
+        plan.attrs["export_resource_id"] = rid
+    with _exports_lock:
+        _pending_exports[rid] = plan
     node = pb.PlanNode()
     node.ffi_reader.schema.CopyFrom(encode_schema(plan.schema))
-    node.ffi_reader.export_iter_resource_id = (
-        plan.attrs.get("export_resource_id") or
-        f"__jvm_export__:{id(plan)}")
+    node.ffi_reader.export_iter_resource_id = rid
     return node
 
 
@@ -80,17 +101,56 @@ def try_convert(plan: SparkPlan) -> pb.PlanNode:
         return ffi_bridge(plan)
 
 
+# Exchanges are stage boundaries converted by stages.py, not _CONVERTERS
+# (ref convertShuffleExchangeExec:238 / convertBroadcastExchangeExec:539) —
+# tagging must treat them as native-capable, else every exchange cascades
+# NeverConvert demotions through _remove_inefficient.
+_EXCHANGE_KINDS = {"ShuffleExchangeExec", "BroadcastExchangeExec"}
+
+
 def check_convertible(plan: SparkPlan) -> bool:
     """Trial conversion of one node (children assumed native) — the
     bottom-up tagging pass of BlazeConvertStrategy.scala:56-69."""
+    if plan.kind in _EXCHANGE_KINDS:
+        return _exprs_convertible(plan)
     fn = _CONVERTERS.get(plan.kind)
     if fn is None or not conf.op_enabled(_flag_name(plan.kind)):
+        return False
+    if not _exprs_convertible(plan):
         return False
     try:
         fn(plan)
         return True
     except Exception:  # noqa: BLE001
         return False
+
+
+def _iter_attr_exprs(obj) -> Iterator[ir.Expr]:
+    if isinstance(obj, ir.Expr):
+        yield obj
+    elif isinstance(obj, dict):
+        for v in obj.values():
+            yield from _iter_attr_exprs(v)
+    elif isinstance(obj, (list, tuple)):
+        for v in obj:
+            yield from _iter_attr_exprs(v)
+
+
+def _exprs_convertible(plan: SparkPlan) -> bool:
+    """Walk every expression in the node's attrs and reject unknown scalar
+    functions at tag time — the reference walks expressions during
+    conversion (NativeConverters.convertExpr:290-372); serializing an
+    unknown fn by name would only explode at execution."""
+    from blaze_tpu.exprs.functions import is_supported
+
+    for root in _iter_attr_exprs(plan.attrs):
+        stack = [root]
+        while stack:
+            e = stack.pop()
+            if isinstance(e, ir.ScalarFn) and not is_supported(e.name):
+                return False
+            stack.extend(e.children())
+    return True
 
 
 def _flag_name(kind: str) -> str:

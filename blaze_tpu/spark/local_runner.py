@@ -39,21 +39,57 @@ def run_plan(root: SparkPlan, num_partitions: int = 4,
     """Convert + execute a Spark plan tree locally; returns the collected
     result batch."""
     apply_strategy(root)
+    from blaze_tpu.spark import converters, fallback
+
+    converters.drain_exports()  # discard leftovers from prior conversions
     stages = plan_stages(root, default_partitions=num_partitions)
+    # Register a row-export iterator for every FFI-bridged (NeverConvert)
+    # subtree — the ConvertToNativeBase.scala:59-98 handshake: the subtree
+    # runs on the row engine (fallback.py) and feeds native FfiReaderExec.
+    exports = converters.drain_exports()
+    for rid, subtree in exports.items():
+        def provider(partition, nparts, _p=subtree):
+            return fallback.export_iterator(_p, partition, nparts)
+        resources.put(rid, provider)
     work_dir = work_dir or tempfile.mkdtemp(prefix="blaze_tpu_stages_")
     os.makedirs(work_dir, exist_ok=True)
 
     # stage -> map outputs [(data, index)] for shuffle; frames for broadcast
     shuffle_outputs: Dict[int, List[tuple]] = {}
 
-    for stage in stages:
-        if stage.kind == "shuffle_map":
-            _run_shuffle_stage(stage, stages, work_dir, shuffle_outputs)
-        elif stage.kind == "broadcast":
-            _run_broadcast_stage(stage)
-        else:
-            return _run_result_stage(stage, num_partitions)
-    raise AssertionError("no result stage produced")
+    try:
+        for stage in stages:
+            if stage.kind == "shuffle_map":
+                _run_shuffle_stage(stage, stages, work_dir, shuffle_outputs)
+            elif stage.kind == "broadcast":
+                _run_broadcast_stage(stage)
+            else:
+                out = _run_result_stage(stage, num_partitions)
+                return _merge_fallback_root_sort(root, out, num_partitions)
+        raise AssertionError("no result stage produced")
+    finally:
+        for rid in exports:
+            resources.pop(rid)
+
+
+def _merge_fallback_root_sort(root: SparkPlan, out: ColumnBatch,
+                              parts: int) -> ColumnBatch:
+    """Ordered collect for a NeverConvert root sort: the native-root case
+    merges in _run_result_stage, but a fallback root sort produced
+    per-partition order only — merge on the row engine."""
+    if (root.kind != "SortExec" or parts <= 1
+            or root.strategy != "NeverConvert"):
+        return out
+    import pandas as pd
+
+    from blaze_tpu.columnar.arrow_io import batch_from_arrow
+    from blaze_tpu.spark import fallback
+
+    df = pd.DataFrame(out.to_numpy())
+    srt = SparkPlan("SortExec", root.schema, [], dict(root.attrs))
+    merged = fallback._op_sort_frame(srt, df)
+    return batch_from_arrow(fallback._to_arrow(merged, root.schema),
+                            schema=root.schema)
 
 
 def _input_tasks(stage: Stage, stages: List[Stage]) -> int:
@@ -128,4 +164,15 @@ def _run_result_stage(stage: Stage, num_partitions: int) -> ColumnBatch:
             op_p, ExecContext(partition=p, num_partitions=parts)))
     if not batches:
         return ColumnBatch.empty(op.schema)
-    return concat_batches(batches, op.schema)
+    out = concat_batches(batches, op.schema)
+    # Ordered collect: a root SortExec sorts each partition; merging the
+    # sorted partitions gives the total order the query asked for (the
+    # analog of Spark's range-partitioned global sort collect).
+    from blaze_tpu.ops.sort import SortExec, truncate
+    from blaze_tpu.ops.sort_keys import sort_batch
+
+    if isinstance(op, SortExec) and parts > 1:
+        out = sort_batch(out, op.specs)
+        if op.fetch:
+            out = truncate(out, op.fetch)
+    return out

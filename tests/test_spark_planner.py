@@ -122,6 +122,78 @@ def test_strategy_tags_and_fallback():
     assert good_proj.convertible is True
 
 
+def test_exchange_tagged_native():
+    # exchanges are native stage boundaries, never NeverConvert; the nodes
+    # around them must keep their tags (the round-1 cascade bug)
+    sc = P.scan(SS_SCHEMA, [("/x.parquet", [])])
+    x = P.shuffle_exchange(sc, [ir.col("ss_item_sk")], 4)
+    agg = P.hash_agg(x, "final", [ir.col("ss_item_sk")], ["item"],
+                     [{"fn": "sum", "args": [ir.col("ss_ext_sales_price")],
+                       "dtype": T.FLOAT64, "name": "s"}],
+                     T.Schema([T.Field("item", T.INT64),
+                               T.Field("s", T.FLOAT64)]))
+    srt = P.sort(agg, [(ir.col("s"), False, True)])
+    apply_strategy(srt)
+    assert x.convertible is True
+    assert x.strategy != "NeverConvert"
+    assert agg.strategy == "Default"
+    assert srt.strategy == "Default"
+
+
+def test_fallback_bridge_executes(tables):
+    """A plan with an unconvertible mid-node (unknown scalar fn) still
+    returns correct results: the NeverConvert subtree runs on the row
+    engine and feeds the native pipeline through the FFI bridge
+    (ref ConvertToNativeBase.scala:59-98)."""
+    from blaze_tpu.spark import fallback
+
+    ss, dd, ss_path, dd_path = tables
+    fallback.register_python_fn(
+        "test_only_plus_one", lambda a: a + 1)
+
+    sc = P.scan(SS_SCHEMA, [(ss_path, [])])
+    # unknown on device -> whole filter falls back to the row engine
+    flt = P.filter_(sc, ir.Binary(
+        ir.BinOp.LE,
+        ir.ScalarFn("test_only_plus_one", (ir.col("ss_item_sk"),), None),
+        ir.lit(20)))
+    # native project above the bridge keeps the agg chain native
+    proj = P.project(flt, [ir.col("ss_item_sk"),
+                           ir.col("ss_ext_sales_price")],
+                     ["ss_item_sk", "ss_ext_sales_price"],
+                     T.Schema([T.Field("ss_item_sk", T.INT64),
+                               T.Field("ss_ext_sales_price", T.FLOAT64)]))
+    partial = P.hash_agg(proj, "partial", [ir.col("ss_item_sk")], ["item"],
+                         [{"fn": "sum",
+                           "args": [ir.col("ss_ext_sales_price")],
+                           "dtype": T.FLOAT64, "name": "sumsales"}],
+                         T.Schema([T.Field("item", T.INT64)]))
+    x = P.shuffle_exchange(partial, [ir.col("item")], 4)
+    final_schema = T.Schema([T.Field("item", T.INT64),
+                             T.Field("sumsales", T.FLOAT64)])
+    agg = P.hash_agg(x, "final", [ir.col("item")], ["item"],
+                     [{"fn": "sum", "args": [ir.col("ss_ext_sales_price")],
+                       "dtype": T.FLOAT64, "name": "sumsales"}],
+                     final_schema)
+
+    apply_strategy(agg)
+    assert flt.strategy == "NeverConvert"
+    assert proj.strategy == "Default"
+    assert partial.strategy == "Default"
+    assert agg.strategy == "Default", "native agg above the bridge"
+
+    out = run_plan(agg, num_partitions=4)
+    d = out.to_numpy()
+    ssd = ss.to_pandas()
+    want = ssd[ssd.ss_item_sk + 1 <= 20].groupby("ss_item_sk")[
+        "ss_ext_sales_price"].sum()
+    got = dict(zip((int(x) for x in np.asarray(d["item"])),
+                   (float(x) for x in d["sumsales"])))
+    assert set(got) == set(int(k) for k in want.index)
+    for k, v in want.items():
+        np.testing.assert_allclose(got[int(k)], v, rtol=1e-9)
+
+
 def test_inefficient_convert_removal():
     # native Filter over a non-native child gets demoted (ref :142-203)
     nonnative = P.SparkPlan("SomeRowBasedExec", SS_SCHEMA, [], {})
