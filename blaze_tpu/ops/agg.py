@@ -144,6 +144,11 @@ class _AggState:
         self.raw_bytes = 0
         self.states: List[ColumnBatch] = []
         self.state_bytes = 0
+        # True while self.states holds externally-produced state batches
+        # (shuffle-read partial states): those may carry several rows per
+        # group even in a single batch, so they are never "already
+        # collapsed" — unlike batches produced by our own _collapse.
+        self.states_external = False
         self.spills: List = []
         self.collapses = 0
         self.spill_files_used = 0
@@ -177,11 +182,12 @@ class _AggState:
             self._push_state(s)
             freed += max(before - self._M.batch_nbytes(s), 0)
             self.collapses += 1
-        if len(self.states) > 1:
+        if len(self.states) > 1 or (self.states_external and self.states):
             before = self.state_bytes
             s = self.op._collapse(self.states, raw_input=False)
             self.states, self.state_bytes = [], 0
             self._push_state(s)
+            self.states_external = False
             freed += max(before - self.state_bytes, 0)
             self.collapses += 1
         return freed
@@ -200,6 +206,7 @@ class _AggState:
 
     def add_state(self, batch: ColumnBatch) -> None:
         self._push_state(batch)
+        self.states_external = True
         if len(self.states) >= 16:
             self._collapse_all()
         self.manager.update_mem_used(self)

@@ -68,6 +68,31 @@ def drain_exports() -> Dict[str, SparkPlan]:
     return out
 
 
+def bridge_schema(plan: SparkPlan) -> Schema:
+    """The schema actually crossing the FFI bridge for `plan`.
+
+    Usually plan.schema — except partial-mode aggregates, whose SparkPlan
+    schema lists only the grouping columns (Spark's partial-agg output is
+    opaque to the driver); the rows crossing the bridge carry the native
+    agg-state layout (ops/agg.py state_fields) so a native final agg can
+    consume them."""
+    from blaze_tpu.columnar.types import Schema as TSchema
+
+    if (plan.kind.endswith("AggregateExec")
+            and plan.attrs.get("mode") in ("partial", "partial_merge")):
+        from blaze_tpu.ops.agg import AggCall, state_fields
+
+        ngroups = len(plan.attrs["grouping_names"])
+        groups = list(plan.schema.fields)[:ngroups]
+        state = []
+        for i, call in enumerate(plan.attrs["aggs"]):
+            state.extend(state_fields(
+                AggCall(call["fn"], tuple(call["args"]), call["dtype"],
+                        call["name"]), i))
+        return TSchema(groups + state)
+    return plan.schema
+
+
 def ffi_bridge(plan: SparkPlan) -> pb.PlanNode:
     """Non-native subtree boundary (ConvertToNativeExec analog)."""
     rid = plan.attrs.get("export_resource_id")
@@ -77,7 +102,7 @@ def ffi_bridge(plan: SparkPlan) -> pb.PlanNode:
     with _exports_lock:
         _pending_exports[rid] = plan
     node = pb.PlanNode()
-    node.ffi_reader.schema.CopyFrom(encode_schema(plan.schema))
+    node.ffi_reader.schema.CopyFrom(encode_schema(bridge_schema(plan)))
     node.ffi_reader.export_iter_resource_id = rid
     return node
 

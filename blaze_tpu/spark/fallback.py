@@ -42,8 +42,10 @@ def export_iterator(plan: SparkPlan, partition: int,
                     num_partitions: int) -> Iterator[pa.RecordBatch]:
     """Execute the subtree for one task partition; yield Arrow batches
     (what the registered ArrowFFIExportIterator yields in the reference)."""
+    from blaze_tpu.spark.converters import bridge_schema
+
     df = _execute(plan, partition, num_partitions)
-    yield _to_arrow(df, plan.schema)
+    yield _to_arrow(df, bridge_schema(plan))
 
 
 _ARROW_TYPES = {
@@ -101,14 +103,24 @@ def _op_scan(plan: SparkPlan, part: int, nparts: int) -> pd.DataFrame:
 
 
 def _op_ipc_reader(plan: SparkPlan, part: int, nparts: int) -> pd.DataFrame:
-    provider = resources.get(plan.attrs["resource_id"])
-    source = provider(part) if callable(provider) else provider
+    from blaze_tpu.columnar import serde
+    from blaze_tpu.ops.base import ExecContext
+    from blaze_tpu.ops.shuffle import _call_provider
+
+    source = _call_provider(resources.get(plan.attrs["resource_id"]),
+                            ExecContext(partition=part, num_partitions=nparts))
     frames = []
     for item in source:
-        if hasattr(item, "to_numpy"):  # ColumnBatch
-            frames.append(pd.DataFrame(item.to_numpy()))
-        else:
-            frames.append(pa.RecordBatch.from_pandas(item).to_pandas())
+        if hasattr(item, "num_rows") and hasattr(item, "to_numpy"):
+            frames.append(pd.DataFrame(item.to_numpy()))  # ColumnBatch
+        elif isinstance(item, pa.RecordBatch):
+            frames.append(item.to_pandas())
+        elif isinstance(item, (bytes, bytearray, memoryview)):
+            cb = serde.deserialize_batch(bytes(item), plan.schema)
+            frames.append(pd.DataFrame(cb.to_numpy()))
+        else:  # file-like segment of serialized frames
+            for cb in serde.read_batches(item, plan.schema):
+                frames.append(pd.DataFrame(cb.to_numpy()))
     if not frames:
         return pd.DataFrame({n: [] for n in _names(plan)})
     return pd.concat(frames, ignore_index=True)
@@ -139,11 +151,14 @@ def _op_sort(plan: SparkPlan, part: int, nparts: int) -> pd.DataFrame:
 def _op_sort_frame(plan: SparkPlan, df: pd.DataFrame) -> pd.DataFrame:
     keys, ascending = [], []
     tmp = df.copy()
-    for i, (e, asc, _nf) in enumerate(plan.attrs["orders"]):
-        kn = f"__sortkey_{i}"
-        tmp[kn] = np.asarray(_eval(e, df))
-        keys.append(kn)
-        ascending.append(asc)
+    for i, (e, asc, nulls_first) in enumerate(plan.attrs["orders"]):
+        v = pd.Series(np.asarray(_eval(e, df)), index=df.index)
+        # per-key null placement: an explicit null-rank column sorted ahead
+        # of the key (pandas' na_position is global, not per-key)
+        tmp[f"__sortnull_{i}"] = v.isna().astype(int)
+        tmp[f"__sortkey_{i}"] = v
+        keys += [f"__sortnull_{i}", f"__sortkey_{i}"]
+        ascending += [not nulls_first, asc]
     tmp = tmp.sort_values(keys, ascending=ascending, kind="stable")
     out = tmp[df.columns].reset_index(drop=True)
     if plan.attrs.get("fetch"):

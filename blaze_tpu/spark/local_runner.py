@@ -35,9 +35,19 @@ from blaze_tpu.ops.shuffle import read_shuffle_partition
 
 
 def run_plan(root: SparkPlan, num_partitions: int = 4,
-             work_dir: Optional[str] = None) -> ColumnBatch:
+             work_dir: Optional[str] = None,
+             mesh_exchange: str = "auto",
+             mesh_quota: Optional[int] = None) -> ColumnBatch:
     """Convert + execute a Spark plan tree locally; returns the collected
-    result batch."""
+    result batch.
+
+    mesh_exchange: "auto" runs each shuffle stage's exchange in HBM over
+    the device mesh when the partition count fits (parallel/
+    stage_exchange.py), falling back to the file path on quota overflow or
+    unsupported shapes; "off" always uses .data/.index files. mesh_quota
+    caps the per-device-per-partition staging rows (None = safe default,
+    no overflow possible).
+    """
     apply_strategy(root)
     from blaze_tpu.spark import converters, fallback
 
@@ -60,6 +70,15 @@ def run_plan(root: SparkPlan, num_partitions: int = 4,
     try:
         for stage in stages:
             if stage.kind == "shuffle_map":
+                if mesh_exchange == "auto":
+                    from blaze_tpu.parallel.stage_exchange import (
+                        run_mesh_shuffle_stage,
+                    )
+
+                    if run_mesh_shuffle_stage(
+                            stage.plan, stage.stage_id,
+                            _input_tasks(stage, stages), quota=mesh_quota):
+                        continue
                 _run_shuffle_stage(stage, stages, work_dir, shuffle_outputs)
             elif stage.kind == "broadcast":
                 _run_broadcast_stage(stage)
@@ -68,8 +87,17 @@ def run_plan(root: SparkPlan, num_partitions: int = 4,
                 return _merge_fallback_root_sort(root, out, num_partitions)
         raise AssertionError("no result stage produced")
     finally:
+        # release per-query registry entries: FFI export subtrees and the
+        # shuffle/broadcast providers (the mesh path's providers pin full
+        # capacity-padded HBM batches — leaking them across queries would
+        # exhaust device memory)
         for rid in exports:
             resources.pop(rid)
+        for stage in stages:
+            for key in (f"shuffle:{stage.stage_id}",
+                        f"broadcast:{stage.stage_id}",
+                        f"broadcast_sink:{stage.stage_id}"):
+                resources.pop(key)
 
 
 def _merge_fallback_root_sort(root: SparkPlan, out: ColumnBatch,

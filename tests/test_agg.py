@@ -197,3 +197,41 @@ def test_streaming_collapse(rng):
     for k, w in want.items():
         np.testing.assert_allclose(got[int(k)], w, rtol=1e-9)
     assert p.metrics["collapses"] >= 1
+
+
+def test_final_agg_single_external_state_batch_merges():
+    """A single shuffle-read state batch can hold several partial states
+    for the same group (mesh exchange delivers all map outputs in one
+    batch) — FINAL mode must still merge them, not pass rows through."""
+    import jax.numpy as jnp
+
+    from blaze_tpu.columnar import types as T
+    from blaze_tpu.columnar.batch import ColumnBatch
+    from blaze_tpu.exprs import ir
+    from blaze_tpu.ops.agg import AGG_BUF_PREFIX, AggCall, AggExec, AggMode
+    from blaze_tpu.ops.base import ExecContext
+    from blaze_tpu.ops.basic import MemorySourceExec
+
+    S = T.Schema([T.Field("item", T.INT64),
+                  T.Field(f"{AGG_BUF_PREFIX}.0.sum", T.FLOAT64),
+                  T.Field(f"{AGG_BUF_PREFIX}.0.nonempty", T.BOOLEAN)])
+    items = np.array([2, 2, 4, 5, 2, 4, 5, 5, 2, 4, 4, 5], np.int64)
+    sums = np.arange(12, dtype=np.float64)
+    b = ColumnBatch.from_numpy(
+        {"item": items, f"{AGG_BUF_PREFIX}.0.sum": sums,
+         f"{AGG_BUF_PREFIX}.0.nonempty": np.ones(12, bool)}, S,
+        capacity=4096)
+    src = MemorySourceExec([b], schema=S)
+    agg = AggExec(src, [ir.col("item")], ["item"],
+                  [AggCall("sum", (ir.col("x"),), T.FLOAT64, "s")],
+                  AggMode.FINAL)
+    (out,) = list(agg.execute(ExecContext(partition=0, num_partitions=1)))
+    n = int(out.num_rows)
+    d = out.to_numpy()
+    got = dict(zip(np.asarray(d["item"])[:n].tolist(),
+                   np.asarray(d["s"])[:n].tolist()))
+    want = {2: float(sums[items == 2].sum()),
+            4: float(sums[items == 4].sum()),
+            5: float(sums[items == 5].sum())}
+    assert n == 3
+    assert got == want

@@ -103,3 +103,112 @@ def test_partition_ids_padding_sentinel(rng):
     pid = partition_ids(b, [0], NDEV)
     assert np.all(np.asarray(pid)[5:] == NDEV)
     assert np.all(np.asarray(pid)[:5] < NDEV)
+
+
+def test_stage_exchange_matches_file_path(rng, tmp_path):
+    """The q3-shaped multistage plan produces identical results whether the
+    exchanges ride the in-HBM mesh all_to_all or .data/.index files
+    (VERDICT r1 #3 acceptance)."""
+    import pandas as pd
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from blaze_tpu.columnar import types as T
+    from blaze_tpu.exprs import ir
+    from blaze_tpu.spark import plan_model as P
+    from blaze_tpu.spark.local_runner import run_plan
+
+    n_ss, n_dd = 4000, 200
+    ss = pa.table({
+        "ss_sold_date_sk": pa.array(rng.integers(0, n_dd, n_ss), pa.int64()),
+        "ss_item_sk": pa.array(rng.integers(0, 30, n_ss), pa.int64()),
+        "ss_ext_sales_price": pa.array(rng.random(n_ss) * 100),
+    })
+    dd = pa.table({
+        "d_date_sk": pa.array(np.arange(n_dd), pa.int64()),
+        "d_moy": pa.array((np.arange(n_dd) // 30) % 12 + 1, pa.int32()),
+    })
+    ss_path, dd_path = str(tmp_path / "ss.parquet"), str(tmp_path / "dd.parquet")
+    pq.write_table(ss, ss_path)
+    pq.write_table(dd, dd_path)
+    SS = T.Schema([T.Field("ss_sold_date_sk", T.INT64),
+                   T.Field("ss_item_sk", T.INT64),
+                   T.Field("ss_ext_sales_price", T.FLOAT64)])
+    DD = T.Schema([T.Field("d_date_sk", T.INT64), T.Field("d_moy", T.INT32)])
+
+    def build():
+        ss_scan = P.scan(SS, [(ss_path, [])])
+        dd_scan = P.scan(DD, [(dd_path, [])])
+        dd_flt = P.filter_(dd_scan, ir.Binary(ir.BinOp.EQ, ir.col("d_moy"),
+                                              ir.lit(3)))
+        ss_x = P.shuffle_exchange(ss_scan, [ir.col("ss_sold_date_sk")], 4)
+        dd_x = P.shuffle_exchange(dd_flt, [ir.col("d_date_sk")], 4)
+        join_schema = T.Schema(list(SS.fields) + list(DD.fields))
+        j = P.smj(ss_x, dd_x, [ir.col("ss_sold_date_sk")],
+                  [ir.col("d_date_sk")], "inner", join_schema)
+        partial = P.hash_agg(j, "partial", [ir.col("ss_item_sk")], ["item"],
+                             [{"fn": "sum",
+                               "args": [ir.col("ss_ext_sales_price")],
+                               "dtype": T.FLOAT64, "name": "s"}],
+                             T.Schema([T.Field("item", T.INT64)]))
+        agg_x = P.shuffle_exchange(partial, [ir.col("item")], 4)
+        final = P.hash_agg(agg_x, "final", [ir.col("item")], ["item"],
+                           [{"fn": "sum",
+                             "args": [ir.col("ss_ext_sales_price")],
+                             "dtype": T.FLOAT64, "name": "s"}],
+                           T.Schema([T.Field("item", T.INT64),
+                                     T.Field("s", T.FLOAT64)]))
+        return P.sort(final, [(ir.col("s"), False, True)])
+
+    out_mesh = run_plan(build(), num_partitions=4, mesh_exchange="auto")
+    out_file = run_plan(build(), num_partitions=4, mesh_exchange="off")
+
+    dm, df_ = out_mesh.to_numpy(), out_file.to_numpy()
+    np.testing.assert_array_equal(np.asarray(dm["item"]),
+                                  np.asarray(df_["item"]))
+    np.testing.assert_allclose(np.asarray(dm["s"]), np.asarray(df_["s"]),
+                               rtol=1e-12)
+
+    ssd, ddd = ss.to_pandas(), dd.to_pandas()
+    m = ssd.merge(ddd[ddd.d_moy == 3], left_on="ss_sold_date_sk",
+                  right_on="d_date_sk")
+    want = m.groupby("ss_item_sk")["ss_ext_sales_price"].sum().sort_values(
+        ascending=False)
+    np.testing.assert_allclose([float(x) for x in dm["s"]],
+                               want.to_numpy(), rtol=1e-9)
+
+
+def test_stage_exchange_overflow_falls_back(rng, tmp_path):
+    """A tiny staging quota with skewed keys overflows; the runner must
+    silently fall back to the file path and stay correct."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from blaze_tpu.columnar import types as T
+    from blaze_tpu.exprs import ir
+    from blaze_tpu.spark import plan_model as P
+    from blaze_tpu.spark.local_runner import run_plan
+
+    n = 1000
+    t = pa.table({
+        "k": pa.array(np.full(n, 7), pa.int64()),   # all rows -> one bucket
+        "v": pa.array(rng.random(n)),
+    })
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(t, path)
+    S = T.Schema([T.Field("k", T.INT64), T.Field("v", T.FLOAT64)])
+
+    sc = P.scan(S, [(path, [])])
+    x = P.shuffle_exchange(sc, [ir.col("k")], 4)
+    final = P.hash_agg(x, "partial", [ir.col("k")], ["k"],
+                       [{"fn": "sum", "args": [ir.col("v")],
+                         "dtype": T.FLOAT64, "name": "s"}],
+                       T.Schema([T.Field("k", T.INT64)]))
+    out = run_plan(final, num_partitions=4, mesh_exchange="auto",
+                   mesh_quota=8)
+    d = out.to_numpy()
+    from blaze_tpu.ops.agg import AGG_BUF_PREFIX
+    assert int(out.num_rows) == 1
+    np.testing.assert_allclose(float(np.asarray(d[f"{AGG_BUF_PREFIX}.0.sum"])[0]),
+                               float(np.sum(t.column("v").to_numpy())),
+                               rtol=1e-9)

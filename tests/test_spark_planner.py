@@ -234,3 +234,49 @@ def test_per_op_enable_flag(tables):
         conf.enable_ops.pop("filter")
     apply_strategy(flt)
     assert flt.convertible is True
+
+
+def test_fallback_partial_agg_bridges_state(tables):
+    """A NeverConvert partial agg (udf inside the agg argument) exports the
+    native agg-state layout across the bridge so the downstream native
+    final agg can merge it."""
+    from blaze_tpu.spark import fallback
+
+    ss, dd, ss_path, dd_path = tables
+    fallback.register_python_fn("test_only_double", lambda a: a * 2)
+
+    sc = P.scan(SS_SCHEMA, [(ss_path, [])])
+    partial = P.hash_agg(
+        sc, "partial", [ir.col("ss_item_sk")], ["item"],
+        [{"fn": "sum",
+          "args": [ir.ScalarFn("test_only_double",
+                               (ir.col("ss_ext_sales_price"),), None)],
+          "dtype": T.FLOAT64, "name": "sumsales"}],
+        T.Schema([T.Field("item", T.INT64)]))
+    x = P.shuffle_exchange(partial, [ir.col("item")], 4)
+    final = P.hash_agg(
+        x, "final", [ir.col("item")], ["item"],
+        [{"fn": "sum", "args": [ir.col("ss_ext_sales_price")],
+          "dtype": T.FLOAT64, "name": "sumsales"}],
+        T.Schema([T.Field("item", T.INT64),
+                  T.Field("sumsales", T.FLOAT64)]))
+
+    apply_strategy(final)
+    assert partial.strategy == "NeverConvert"
+    # ref removeInefficientConverts: a non-native agg demotes the exchange
+    # above it, which demotes the final agg — the whole two-phase agg runs
+    # on the row engine, but the *native shuffle writer* still moves the
+    # bridged state rows between them, so the state layout must cross the
+    # bridge intact either way.
+    assert final.strategy == "NeverConvert"
+
+    out = run_plan(final, num_partitions=4)
+    d = out.to_numpy()
+    ssd = ss.to_pandas()
+    want = (ssd.assign(x2=ssd.ss_ext_sales_price * 2)
+            .groupby("ss_item_sk")["x2"].sum())
+    got = dict(zip((int(v) for v in np.asarray(d["item"])),
+                   (float(v) for v in d["sumsales"])))
+    assert set(got) == set(int(k) for k in want.index)
+    for k, v in want.items():
+        np.testing.assert_allclose(got[int(k)], v, rtol=1e-9)
