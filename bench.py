@@ -1,37 +1,65 @@
-"""Benchmark: columnar scan->filter->project->group-by-sum on one chip.
+"""Benchmark: the ENGINE executing a decoded proto plan on one chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line on stdout: {"metric", "value", "unit", "vs_baseline"}.
+Diagnostics (per-rep times, sync floor, bandwidth-utilization estimate) go to
+stderr so the contract line stays parseable.
 
-The workload is the q06-style core slice of BASELINE.json config 2 — a
-store_sales-shaped scan with a selective filter, an arithmetic projection and
-a grouped SUM. Grouping is sort-based (sort + cumsum + boundary gather), the
-TPU-native design this engine uses instead of hash tables (SURVEY.md §7b).
+Workload — the q06-style core slice of BASELINE.json config 2:
 
-Timing notes: the remote-TPU tunnel has a large per-sync latency floor, and
-`block_until_ready` does not reliably block on the axon platform — so the
-pipeline is iterated *inside* one jit via `lax.scan` with a data-dependent
-carry, synced once by a device->host pull, and the per-iteration time is the
-difference between a long and a short scan (cancels compile + sync floor).
+    ffi_reader -> Filter(qty <= 50 AND price > 10)
+               -> Project(item_sk, amount = qty * price)
+               -> Agg[PARTIAL](group item_sk; sum(amount), count(1))
+               -> Agg[FINAL]
+
+built as a real `TaskDefinition` protobuf, decoded through
+`plan/from_proto.py` (ref: blaze-serde from_proto.rs decode contract) and
+driven by `runtime/executor.collect` — i.e. the timed region is the product:
+plan decode output, fused jit pipeline, sort-based grouping, agg state
+machinery, metrics. Not a hand-inlined jnp kernel.
+
+Input staging: batches are device-resident before timing (as they would be
+mid-query, after an upstream stage's mesh exchange left them in HBM —
+parallel/stage_exchange.py). Host->device transfer is NOT in the timed
+region: under the axon tunnel that edge measures network latency, not the
+engine; the reference's analogous number (BASELINE.md) charges scan from
+page cache, not NIC.
+
+Timing honesty (round-2 post-mortem: a loop-invariant `lax.scan` let XLA
+hoist the whole pipeline and the reported number was the 1e-9 clamp): each
+rep drives the full plan end-to-end and materializes the final aggregate on
+the HOST via np.asarray — there is no way for the compiler to elide work
+across reps because every rep's output leaves the device. A separately
+measured sync floor (host pull of a tiny device array) is subtracted, and
+the result is gated for physical plausibility: GB/s must be positive, below
+the HBM-bandwidth class of any current chip, and vs_baseline must be in a
+sane range — otherwise exit non-zero rather than emit garbage.
 
 `vs_baseline`: the reference publishes no per-chip GB/s (its headline is a
-1.72x TPC-DS cluster speedup, BASELINE.md), so vs_baseline is the speedup
-over a single-core numpy implementation of the same pipeline on this host —
-a proxy for the reference's per-core vectorized-CPU engine.
+1.72x TPC-DS cluster speedup), so vs_baseline is the speedup over a
+single-core numpy implementation of the same pipeline on this host — a
+proxy for the reference's per-core vectorized-CPU engine (BASELINE.md
+north star: >=3x over Blaze-CPU per equal-cost core).
 """
 
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import numpy as np
 
-ROWS = 1 << 21  # per batch
+ROWS = 1 << 21       # rows per batch
+N_BATCHES = 8        # 16.7M rows, ~400 MB input
 GROUPS = 1 << 16
-K_SHORT, K_LONG = 2, 12
+REPS = 5
+
+# plausibility ceilings for the gate
+HBM_GBPS_CEILING = 1500.0   # above any current single chip's HBM bandwidth
+VS_BASELINE_CEILING = 1000.0
 
 
-def _make_data(seed=0):
+def _make_data(seed):
     rng = np.random.default_rng(seed)
     return {
         "ss_item_sk": rng.integers(0, GROUPS, size=ROWS).astype(np.int32),
@@ -41,93 +69,203 @@ def _make_data(seed=0):
     }
 
 
-def _input_bytes(data):
-    return sum(a.nbytes for a in data.values())
-
-
-def _numpy_pipeline(data):
-    keep = (data["ss_quantity"] <= 50) & (data["ss_sales_price"] > 10.0)
-    k = data["ss_item_sk"][keep]
-    amount = data["ss_quantity"][keep].astype(np.float64) * \
-        data["ss_sales_price"][keep]
+def _numpy_pipeline(datas):
     out = np.zeros(GROUPS, np.float64)
-    np.add.at(out, k, amount)
-    return out
+    cnt = np.zeros(GROUPS, np.int64)
+    for data in datas:
+        keep = (data["ss_quantity"] <= 50) & (data["ss_sales_price"] > 10.0)
+        k = data["ss_item_sk"][keep]
+        amount = data["ss_quantity"][keep].astype(np.float64) * \
+            data["ss_sales_price"][keep]
+        np.add.at(out, k, amount)
+        np.add.at(cnt, k, 1)
+    return out, cnt
+
+
+def _build_task(schema_fields, resource_id):
+    """TaskDefinition proto for the workload (driver-side contract)."""
+    from blaze_tpu.plan import plan_pb2 as pb
+
+    def col(name):
+        e = pb.ExprNode()
+        e.column.name = name
+        return e
+
+    def lit(kind, field, v):
+        e = pb.ExprNode()
+        e.literal.dtype.kind = kind
+        setattr(e.literal, field, v)
+        return e
+
+    src = pb.PlanNode()
+    for name, kind in schema_fields:
+        f = src.ffi_reader.schema.fields.add()
+        f.name = name
+        f.dtype.kind = kind
+    src.ffi_reader.export_iter_resource_id = resource_id
+
+    flt = pb.PlanNode()
+    flt.filter.input.CopyFrom(src)
+    p1 = flt.filter.predicates.add()
+    p1.binary.op = pb.OP_LE
+    p1.binary.left.CopyFrom(col("ss_quantity"))
+    p1.binary.right.CopyFrom(lit(pb.TK_INT32, "int_value", 50))
+    p2 = flt.filter.predicates.add()
+    p2.binary.op = pb.OP_GT
+    p2.binary.left.CopyFrom(col("ss_sales_price"))
+    p2.binary.right.CopyFrom(lit(pb.TK_FLOAT64, "float_value", 10.0))
+
+    proj = pb.PlanNode()
+    proj.projection.input.CopyFrom(flt)
+    proj.projection.exprs.add().CopyFrom(col("ss_item_sk"))
+    amount = pb.ExprNode()
+    amount.binary.op = pb.OP_MUL
+    cast_q = pb.ExprNode()
+    cast_q.cast.child.CopyFrom(col("ss_quantity"))
+    cast_q.cast.dtype.kind = pb.TK_FLOAT64
+    amount.binary.left.CopyFrom(cast_q)
+    amount.binary.right.CopyFrom(col("ss_sales_price"))
+    proj.projection.exprs.add().CopyFrom(amount)
+    proj.projection.names.extend(["ss_item_sk", "amount"])
+
+    def agg_node(inp, mode):
+        n = pb.PlanNode()
+        n.agg.input.CopyFrom(inp)
+        n.agg.mode = mode
+        n.agg.grouping.add().CopyFrom(col("ss_item_sk"))
+        n.agg.grouping_names.append("ss_item_sk")
+        a = n.agg.aggs.add()
+        a.fn = pb.AGG_SUM
+        a.args.add().CopyFrom(col("amount"))
+        a.result_type.kind = pb.TK_FLOAT64
+        a.name = "sum_amount"
+        c = n.agg.aggs.add()
+        c.fn = pb.AGG_COUNT
+        c.args.add().CopyFrom(col("amount"))
+        c.result_type.kind = pb.TK_INT64
+        c.name = "cnt"
+        return n
+
+    partial = agg_node(proj, pb.AGG_PARTIAL)
+    final = agg_node(partial, pb.AGG_FINAL)
+
+    td = pb.TaskDefinition()
+    td.partition_id = 0
+    td.plan.CopyFrom(final)
+    return td.SerializeToString()
 
 
 def main():
     import jax
-    import jax.numpy as jnp
 
     from blaze_tpu.columnar import types as T
     from blaze_tpu.columnar.batch import ColumnBatch
+    from blaze_tpu.plan import plan_pb2 as pb
+    from blaze_tpu.plan.from_proto import decode_task_definition
+    from blaze_tpu.runtime import resources
+    from blaze_tpu.runtime.executor import collect
 
-    data = _make_data()
+    datas = [_make_data(seed) for seed in range(N_BATCHES)]
+    input_bytes = sum(sum(a.nbytes for a in d.values()) for d in datas)
+
     schema = T.Schema([
         T.Field("ss_item_sk", T.INT32),
         T.Field("ss_quantity", T.INT32),
         T.Field("ss_sales_price", T.FLOAT64),
         T.Field("ss_ext_sales_price", T.FLOAT64),
     ])
-    batch = ColumnBatch.from_numpy(data, schema, capacity=ROWS)
+    # stage on device (HBM) up front; commit with a host sync
+    batches = [ColumnBatch.from_numpy(d, schema, capacity=ROWS)
+               for d in datas]
+    for b in batches:
+        np.asarray(b.columns[0].data[:1])
 
-    def pipeline(b: ColumnBatch, carry):
-        qty = b.columns[1].data
-        price = b.columns[2].data
-        keep = (qty <= 50) & (price > 10.0) & b.row_mask()
-        amount = jnp.where(keep, qty.astype(jnp.float64) * price, 0.0)
-        key = jnp.where(keep, b.columns[0].data, jnp.int32(GROUPS - 1))
-        # sort-based grouped sum: sort pairs, cumsum, segment-boundary diff
-        ks, vs = jax.lax.sort((key, amount), num_keys=1)
-        csum = jnp.concatenate([jnp.zeros((1,), vs.dtype), jnp.cumsum(vs)])
-        bounds = jnp.searchsorted(
-            ks, jnp.arange(GROUPS + 1, dtype=ks.dtype), side="left")
-        sums = csum[bounds[1:]] - csum[bounds[:-1]]
-        return sums + carry * 1e-300
+    rid = resources.register(lambda: iter(batches))
+    task = _build_task(
+        [("ss_item_sk", pb.TK_INT32), ("ss_quantity", pb.TK_INT32),
+         ("ss_sales_price", pb.TK_FLOAT64),
+         ("ss_ext_sales_price", pb.TK_FLOAT64)], rid)
+    plan, _ = decode_task_definition(task)
 
-    def make_scan(K):
-        def fn(b):
-            def step(c, _):
-                return pipeline(b, c), None
-            c0 = jnp.zeros((GROUPS,), jnp.float64)
-            c, _ = jax.lax.scan(step, c0, None, length=K)
-            return c
-        return fn
+    def run_once():
+        out = collect(plan)
+        n = int(out.num_rows)
+        keys = np.asarray(out.columns[0].data[:n])
+        sums = np.asarray(out.columns[1].data[:n])
+        cnts = np.asarray(out.columns[2].data[:n])
+        return keys, sums, cnts
 
-    def timed(fn, reps=3):
-        f = jax.jit(fn)
-        out = np.asarray(f(batch))  # compile + warm
-        best = float("inf")
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            out = np.asarray(f(batch))
-            best = min(best, time.perf_counter() - t0)
-        return best, out
+    # sync floor: host pull of a tiny device array (tunnel round-trip)
+    tiny = jax.device_put(np.zeros(8, np.float32))
+    np.asarray(tiny)
+    floors = []
+    for _ in range(7):
+        t0 = time.perf_counter()
+        np.asarray(tiny)
+        floors.append(time.perf_counter() - t0)
+    floor = float(np.median(floors))
 
-    t_short, out = timed(make_scan(K_SHORT))
-    t_long, out = timed(make_scan(K_LONG))
-    per_iter = max((t_long - t_short) / (K_LONG - K_SHORT), 1e-9)
-    gbps = _input_bytes(data) / per_iter / 1e9
+    keys, sums, cnts = run_once()  # compile + warm every shape bucket
+    times = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        keys, sums, cnts = run_once()
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    per_rep = max(best - floor, 1e-6)
+    gbps = input_bytes / per_rep / 1e9
 
     # numpy single-core proxy baseline (best of 3)
-    best = float("inf")
+    nbest = float("inf")
     for _ in range(3):
         t0 = time.perf_counter()
-        ref = _numpy_pipeline(data)
-        best = min(best, time.perf_counter() - t0)
-    base_gbps = _input_bytes(data) / best / 1e9
+        ref_sums, ref_cnts = _numpy_pipeline(datas)
+        nbest = min(nbest, time.perf_counter() - t0)
+    base_gbps = input_bytes / nbest / 1e9
+    vs = gbps / base_gbps
 
-    # correctness: grouped sums must match numpy (last group absorbs the
-    # filtered-out sentinel rows with amount 0, so it matches too).
-    # rtol must tolerate differing float accumulation order: the TPU path
-    # sums in sorted-key order, np.add.at in row order.
-    np.testing.assert_allclose(out, ref, rtol=1e-6)
+    # correctness: engine grouped sums/counts must match numpy
+    order = np.argsort(keys, kind="stable")
+    keys, sums, cnts = keys[order], sums[order], cnts[order]
+    nz = ref_cnts > 0
+    np.testing.assert_array_equal(keys, np.nonzero(nz)[0])
+    np.testing.assert_array_equal(cnts, ref_cnts[nz])
+    np.testing.assert_allclose(sums, ref_sums[nz], rtol=1e-9)
+
+    # plausibility gate (round-2 post-mortem: never emit physically
+    # impossible numbers)
+    problems = []
+    if not (0.0 < gbps < HBM_GBPS_CEILING):
+        problems.append(
+            f"GB/s {gbps:.3f} outside (0, {HBM_GBPS_CEILING}) — exceeds "
+            "the HBM bandwidth class of any single chip")
+    if not (0.0 < vs < VS_BASELINE_CEILING):
+        problems.append(f"vs_baseline {vs:.3f} outside plausible range")
+    if best <= floor:
+        problems.append(
+            f"best rep {best * 1e3:.3f} ms <= sync floor {floor * 1e3:.3f} "
+            "ms — measurement is all latency, no work")
+
+    print(
+        f"[bench] platform={jax.devices()[0].platform} "
+        f"input={input_bytes / 1e9:.3f} GB reps_ms="
+        f"{[round(t * 1e3, 1) for t in times]} floor_ms={floor * 1e3:.2f} "
+        f"engine={gbps:.2f} GB/s numpy={base_gbps:.2f} GB/s",
+        file=sys.stderr)
+    print(
+        f"[bench] bandwidth utilization ≈ {gbps / 819 * 100:.1f}% of a "
+        "v5e chip's 819 GB/s HBM (pipeline reads input ~3x: "
+        "filter/project + sort + segment-sum)", file=sys.stderr)
+    if problems:
+        for p in problems:
+            print(f"[bench] GATE FAILED: {p}", file=sys.stderr)
+        sys.exit(1)
 
     print(json.dumps({
-        "metric": "scan_filter_project_groupby_sum",
+        "metric": "engine_scan_filter_project_groupby",
         "value": round(gbps, 3),
         "unit": "GB/s",
-        "vs_baseline": round(gbps / base_gbps, 3),
+        "vs_baseline": round(vs, 3),
     }))
 
 

@@ -112,14 +112,37 @@ class ListData:
 
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
+class StructData:
+    """struct<...> storage: one row-aligned child Column per field.
+
+    MAP columns do not get their own container — a map is stored as
+    list<struct<key, value>> (Arrow's map layout, types.storage_element),
+    so all list machinery (take/concat/serde/spill) covers maps."""
+
+    children: List["Column"]
+
+    @property
+    def capacity(self) -> int:
+        return self.children[0].capacity
+
+    def tree_flatten(self):
+        return tuple(self.children), len(self.children)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(list(children))
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
 class Column:
     dtype: DataType
-    data: Union[Array, StringData, ListData]
+    data: Union[Array, StringData, ListData, StructData]
     validity: Optional[Array] = None  # bool (capacity,); None = all valid
 
     @property
     def capacity(self) -> int:
-        if isinstance(self.data, (StringData, ListData)):
+        if isinstance(self.data, (StringData, ListData, StructData)):
             return self.data.capacity
         return self.data.shape[0]
 
@@ -131,6 +154,10 @@ class Column:
     def is_list(self) -> bool:
         return isinstance(self.data, ListData)
 
+    @property
+    def is_struct(self) -> bool:
+        return isinstance(self.data, StructData)
+
     def valid_mask(self) -> Array:
         if self.validity is None:
             return jnp.ones((self.capacity,), dtype=jnp.bool_)
@@ -138,7 +165,7 @@ class Column:
 
     def normalized(self) -> "Column":
         """Zero out data in invalid slots (canonical form for hash/sort/serde)."""
-        if self.validity is None or self.is_list:
+        if self.validity is None or self.is_list or self.is_struct:
             return self
         if self.is_string:
             v = self.validity
@@ -159,6 +186,8 @@ class Column:
         v = self.validity
         if self.is_list:
             data = _list_take(self.data, idx)
+        elif self.is_struct:
+            data = StructData([ch.take(idx) for ch in self.data.children])
         elif self.is_string:
             data = StringData(self.data.bytes[idx], self.data.lengths[idx])
         else:
@@ -283,8 +312,23 @@ class ColumnBatch:
                     jnp.asarray(int(offs[n]), jnp.int32),
                     c.data.elements.capacity)
                 elems = esub.to_numpy()["e"]
-                vals = [list(elems[offs[i]:offs[i + 1]]) if valid[i] else None
-                        for i in range(n)]
+                if f.dtype.kind == TypeKind.MAP:
+                    # entries are (key, value) structs -> dict per row
+                    vals = [dict(elems[offs[i]:offs[i + 1]]) if valid[i]
+                            else None for i in range(n)]
+                else:
+                    vals = [list(elems[offs[i]:offs[i + 1]]) if valid[i]
+                            else None for i in range(n)]
+                out[f.name] = vals
+                continue
+            if c.is_struct:
+                sub = ColumnBatch(
+                    Schema([Field(sf.name, sf.dtype)
+                            for sf in c.dtype.fields]),
+                    list(c.data.children), self.num_rows, c.capacity)
+                cols = sub.to_numpy()
+                vals = [tuple(cols[sf.name][i] for sf in c.dtype.fields)
+                        if valid[i] else None for i in range(n)]
                 out[f.name] = vals
                 continue
             if c.is_string:
@@ -316,6 +360,9 @@ def _col_shape_key(c: Column) -> tuple:
     if c.is_list:
         return ("l", c.data.elements.capacity,
                 _col_shape_key(c.data.elements), c.validity is not None)
+    if c.is_struct:
+        return ("t", tuple(_col_shape_key(ch) for ch in c.data.children),
+                c.validity is not None)
     if c.is_string:
         return ("s", c.data.width, c.validity is not None)
     return (str(c.data.dtype), c.validity is not None)
@@ -324,43 +371,52 @@ def _col_shape_key(c: Column) -> tuple:
 def _list_take(ld: ListData, idx: Array) -> ListData:
     """Gather list rows: rebuild offsets from gathered lengths and compact
     the referenced element ranges to the front of the element storage."""
+    from blaze_tpu.ops.segment import element_rows
+
     lens = ld.lengths()[idx]
     new_off = jnp.concatenate([jnp.zeros((1,), jnp.int32),
                                jnp.cumsum(lens, dtype=jnp.int32)])
     ecap = ld.elements.capacity
     out_rows = idx.shape[0]
-    # element slot j of output: which output row + which position within it
-    slot = jnp.arange(ecap, dtype=jnp.int32)
-    row = jnp.searchsorted(new_off[1:out_rows + 1], slot, side="right")
-    row = jnp.clip(row, 0, out_rows - 1)
-    within = slot - new_off[row]
+    _, row, within, live = element_rows(new_off, out_rows, ecap)
     src = ld.offsets[idx[row]] + within
-    live = slot < new_off[out_rows]
     elems = ld.elements.take(jnp.where(live, src, 0))
     return ListData(new_off, elems)
 
 
 def _zero_column(dtype: DataType, cap: int) -> Column:
+    from blaze_tpu.columnar.types import storage_element
+
     if dtype.is_string_like:
         w = bucket_width(1)
         return Column(dtype, StringData(jnp.zeros((cap, w), jnp.uint8),
                                         jnp.zeros((cap,), jnp.int32)), None)
-    if dtype.kind == TypeKind.LIST:
+    if dtype.kind in (TypeKind.LIST, TypeKind.MAP):
         return Column(dtype, ListData(jnp.zeros((cap + 1,), jnp.int32),
-                                      _zero_column(dtype.element,
+                                      _zero_column(storage_element(dtype),
                                                    bucket_capacity(0))),
                       None)
+    if dtype.kind == TypeKind.STRUCT:
+        return Column(dtype, StructData(
+            [_zero_column(f.dtype, cap) for f in dtype.fields]), None)
     if dtype.kind == TypeKind.NULL:
         return Column(dtype, jnp.zeros((cap,), jnp.int8), jnp.zeros((cap,), jnp.bool_))
     return Column(dtype, jnp.zeros((cap,), dtype.jnp_dtype()), None)
 
 
 def _host_to_column(dtype: DataType, raw, cap: int, validity_np: Optional[np.ndarray]) -> Column:
-    if dtype.kind == TypeKind.LIST:
+    from blaze_tpu.columnar.types import storage_element
+
+    if dtype.kind in (TypeKind.LIST, TypeKind.MAP):
         vals = list(raw)
         if validity_np is None and any(v is None for v in vals):
             validity_np = np.array([v is not None for v in vals], bool)
-        vals = [v if v is not None else [] for v in vals]
+        if dtype.kind == TypeKind.MAP:
+            # accept dicts (or (k, v) pair lists); store entries as structs
+            vals = [(list(v.items()) if isinstance(v, dict) else list(v))
+                    if v is not None else [] for v in vals]
+        else:
+            vals = [v if v is not None else [] for v in vals]
         n = len(vals)
         lens = np.zeros((cap,), np.int32)
         lens[:n] = [len(v) for v in vals]
@@ -368,9 +424,27 @@ def _host_to_column(dtype: DataType, raw, cap: int, validity_np: Optional[np.nda
         offsets[1:] = np.cumsum(lens)
         flat = [x for v in vals for x in v]
         ecap = bucket_capacity(len(flat))
-        elems = _host_to_column(dtype.element, flat, ecap, None)
+        elems = _host_to_column(storage_element(dtype), flat, ecap, None)
         return Column(dtype,
                       ListData(jnp.asarray(offsets), elems),
+                      _pad_validity(validity_np, n, cap))
+    if dtype.kind == TypeKind.STRUCT:
+        vals = list(raw)
+        if validity_np is None and any(v is None for v in vals):
+            validity_np = np.array([v is not None for v in vals], bool)
+        n = len(vals)
+        children = []
+        for fi, f in enumerate(dtype.fields):
+            fvals = []
+            for v in vals:
+                if v is None:
+                    fvals.append(None)
+                elif isinstance(v, dict):
+                    fvals.append(v.get(f.name))
+                else:
+                    fvals.append(v[fi])
+            children.append(_host_to_column(f.dtype, fvals, cap, None))
+        return Column(dtype, StructData(children),
                       _pad_validity(validity_np, n, cap))
     if dtype.is_string_like:
         vals = [v if v is not None else b"" for v in raw]

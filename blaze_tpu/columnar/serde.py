@@ -41,12 +41,13 @@ MAGIC = b"BTB1"
 
 @dataclasses.dataclass
 class _HostCol:
-    kind: str                      # "num" | "str" | "list" | "null"
+    kind: str                      # "num" | "str" | "list" | "struct" | "null"
     data: Optional[np.ndarray]     # (n,) values | (n, W) bytes | None
     lengths: Optional[np.ndarray]  # strings/lists: per-row lengths
     validity: Optional[np.ndarray]
     child: Optional["_HostCol"] = None        # lists: element column
     child_offsets: Optional[np.ndarray] = None  # lists: (n+1,) elem offsets
+    children: Optional[List["_HostCol"]] = None  # structs: field columns
 
 
 @dataclasses.dataclass
@@ -94,6 +95,10 @@ def _write_col(out, c: _HostCol, lo: int, hi: int) -> None:
         out.write(struct.pack("<I", ehi - elo) + lens.tobytes())
         _write_col(out, c.child, elo, ehi)
         return
+    if c.kind == "struct":
+        for ch in c.children:
+            _write_col(out, ch, lo, hi)
+        return
     out.write(np.ascontiguousarray(c.data[lo:hi]).tobytes())
 
 
@@ -108,6 +113,10 @@ def _host_col(col, n: int) -> _HostCol:
         child = _host_col(col.data.elements, n_elems)
         lens = (offs[1:] - offs[:-1]).astype(np.int32)
         return _HostCol("list", None, lens, validity, child, offs)
+    if col.is_struct:
+        return _HostCol("struct", None, None, validity,
+                        children=[_host_col(ch, n)
+                                  for ch in col.data.children])
     if col.is_string:
         return _HostCol("str", np.asarray(col.data.bytes)[:n],
                         np.asarray(col.data.lengths)[:n], validity)
@@ -201,15 +210,23 @@ def _decode_col(fp: BinaryIO, dtype, n: int, cap: int):
     if dtype.kind == TypeKind.NULL:
         return Column(dtype, jnp.zeros((cap,), jnp.int8),
                       jnp.zeros((cap,), jnp.bool_))
-    if dtype.kind == TypeKind.LIST:
+    if dtype.kind in (TypeKind.LIST, TypeKind.MAP):
+        from blaze_tpu.columnar.types import storage_element
+
         (total,) = struct.unpack("<I", _read_exact(fp, 4))
         lens = np.frombuffer(_read_exact(fp, 4 * n), np.uint32)
         ecap = bucket_capacity(total)
-        elems = _decode_col(fp, dtype.element, total, ecap)
+        elems = _decode_col(fp, storage_element(dtype), total, ecap)
         offsets = np.zeros((cap + 1,), np.int32)
         offsets[1:n + 1] = np.cumsum(lens.astype(np.int32))
         offsets[n + 1:] = offsets[n]
         return Column(dtype, ListData(jnp.asarray(offsets), elems),
+                      _pad_validity(validity_np, n, cap))
+    if dtype.kind == TypeKind.STRUCT:
+        from blaze_tpu.columnar.batch import StructData
+
+        children = [_decode_col(fp, f.dtype, n, cap) for f in dtype.fields]
+        return Column(dtype, StructData(children),
                       _pad_validity(validity_np, n, cap))
     if dtype.is_string_like:
         (total,) = struct.unpack("<I", _read_exact(fp, 4))

@@ -182,3 +182,14 @@ def map_of(key: DataType, value: DataType) -> DataType:
 
 def struct_of(fields) -> DataType:
     return DataType(TypeKind.STRUCT, fields=tuple(fields))
+
+
+def storage_element(dtype: DataType) -> DataType:
+    """Element dtype of the flat storage under a LIST or MAP column.
+
+    A MAP column is stored as list<struct<key, value>> (Arrow's map layout),
+    so its storage element is the entry struct, not the value type."""
+    if dtype.kind == TypeKind.MAP:
+        return struct_of([Field("key", dtype.key, nullable=False),
+                          Field("value", dtype.element)])
+    return dtype.element

@@ -103,7 +103,110 @@ def compile_expr(expr: ir.Expr, schema) -> CompiledExpr:
         return _compile_udf_wrapper(expr, schema)
     if isinstance(expr, ir.ScalarSubquery):
         return _compile_scalar_subquery(expr)
+    if isinstance(expr, ir.GetStructField):
+        c = compile_expr(expr.child, schema)
+        i = expr.index
+
+        def run_gsf(b):
+            col = c(b)
+            child = col.data.children[i]
+            v = _and_valid(col.validity, child.valid_mask()) \
+                if (col.validity is not None or child.validity is not None) \
+                else None
+            return Column(child.dtype, child.data, v)
+
+        return run_gsf
+    if isinstance(expr, ir.GetIndexedField):
+        return _compile_get_indexed(expr, schema)
+    if isinstance(expr, ir.GetMapValue):
+        return _compile_get_map_value(expr, schema)
+    if isinstance(expr, ir.NamedStruct):
+        val_fns = [compile_expr(v, schema) for v in expr.values]
+        rt = expr.result_type
+
+        def run_ns(b):
+            from blaze_tpu.columnar.batch import StructData
+
+            return Column(rt, StructData([fn(b) for fn in val_fns]), None)
+
+        return run_ns
     raise NotImplementedError(f"cannot compile {type(expr).__name__}")
+
+
+def _compile_get_indexed(expr: ir.GetIndexedField, schema) -> CompiledExpr:
+    """spark GetArrayItem: 0-based element gather; negative or out-of-range
+    index -> null (ref get_indexed_field.rs)."""
+    c = compile_expr(expr.child, schema)
+    # null index: i = -1 makes every row null while keeping the element
+    # dtype (returning a null column of the INDEX dtype would corrupt the
+    # output schema)
+    i = -1 if expr.index.value is None else int(expr.index.value)
+
+    def run(b: ColumnBatch) -> Column:
+        col = c(b)
+        ld = col.data
+        lens = ld.lengths()
+        ok = col.valid_mask() & (i >= 0) & (lens > i)
+        src = jnp.clip(ld.offsets[:-1] + i, 0, ld.elements.capacity - 1)
+        elem = ld.elements.take(jnp.where(ok, src, 0))
+        return Column(elem.dtype, elem.data,
+                      _and_valid(elem.validity, ok))
+
+    return run
+
+
+def _compile_get_map_value(expr: ir.GetMapValue, schema) -> CompiledExpr:
+    """map[key]: match the literal key against each row's entries (stored as
+    list<struct<key,value>>, types.storage_element) and gather the first
+    match's value; absent -> null (ref get_map_value.rs)."""
+    c = compile_expr(expr.child, schema)
+    key_lit = expr.map_key
+
+    def run(b: ColumnBatch) -> Column:
+        import jax
+
+        from blaze_tpu.ops.segment import element_rows
+
+        mcol = c(b)
+        ld = mcol.data
+        entries = ld.elements.data  # StructData(key, value)
+        kcol, vcol = entries.children
+        ecap = kcol.capacity
+        cap = mcol.capacity
+        if key_lit.value is None:
+            # map[NULL] is NULL for every row (spark strict-null lookup)
+            return Column(vcol.dtype, vcol.take(jnp.zeros((cap,), jnp.int32)).data,
+                          jnp.zeros((cap,), jnp.bool_))
+        slot, row, _, in_row = element_rows(ld.offsets, cap, ecap)
+        in_row = in_row & (slot >= ld.offsets[row])
+        lit_col = _compile_literal(
+            ir.Literal(key_lit.dtype, key_lit.value))
+        # build a capacity-ecap batch to evaluate the literal against
+        kmatch = _equal_values(kcol, lit_col, ecap)
+        hit = in_row & kmatch & kcol.valid_mask()
+        # first matching entry per row
+        idx = jax.ops.segment_min(
+            jnp.where(hit, slot, jnp.int32(ecap)),
+            jnp.where(hit, row, jnp.int32(cap)), num_segments=cap)
+        ok = (idx < ecap) & mcol.valid_mask()
+        val = vcol.take(jnp.clip(idx, 0, ecap - 1))
+        return Column(vcol.dtype, val.data, _and_valid(val.validity, ok))
+
+    return run
+
+
+def _equal_values(col: Column, lit_fn, cap: int):
+    """Row-wise equality of a column against a literal value."""
+    class _FakeBatch:
+        capacity = cap
+
+        def row_mask(self):
+            return jnp.ones((cap,), jnp.bool_)
+
+    lit_col = lit_fn(_FakeBatch())
+    if col.is_string:
+        return S.equals(col.data, lit_col.data)
+    return col.data == lit_col.data
 
 
 def _compile_udf_wrapper(expr: ir.UdfWrapper, schema) -> CompiledExpr:
@@ -151,10 +254,11 @@ def _compile_udf_wrapper(expr: ir.UdfWrapper, schema) -> CompiledExpr:
                           else np.asarray(validity)[:n])
             return out_v, out_ok
 
+        from blaze_tpu.exprs.hostfns import host_apply
+
         out_shape = (jax.ShapeDtypeStruct((b.capacity,), rt.np_dtype()),
                      jax.ShapeDtypeStruct((b.capacity,), np.bool_))
-        vals, ok = jax.pure_callback(callback, out_shape,
-                                     *host_args, vmap_method="sequential")
+        vals, ok = host_apply(callback, out_shape, *host_args)
         validity = ok & b.row_mask() if expr.nullable else None
         return Column(rt, vals, validity)
 

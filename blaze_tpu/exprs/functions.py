@@ -44,6 +44,18 @@ def is_supported(name: str) -> bool:
     return name.lower() in _REGISTRY
 
 
+# functions evaluated on the host (hostfns.py) — their operators run
+# unjitted (see ir.contains_host_fn / Operator.jit_safe)
+HOST_EVAL_FNS = frozenset({
+    "md5", "sha224", "sha256", "sha384", "sha512", "crc32",
+    "get_json_object", "get_parsed_json_object", "parse_json",
+})
+
+
+def is_host_fn(name: str) -> bool:
+    return name.lower() in HOST_EVAL_FNS
+
+
 def compile_function(expr: ir.ScalarFn, schema):
     from blaze_tpu.exprs.compiler import compile_expr
 
@@ -388,3 +400,207 @@ def _murmur3(cols, batch, expr):
     from blaze_tpu.exprs.hash import hash_columns
 
     return Column(INT32, hash_columns(cols, 42), None)
+
+
+# ---- string tail (ref spark_strings.rs) ----
+
+def _static_str_arg(expr, i: int, what: str) -> bytes:
+    from blaze_tpu.exprs import ir as _ir
+
+    arg = expr.args[i]
+    if not isinstance(arg, _ir.Literal) or arg.value is None:
+        raise NotImplementedError(
+            f"{expr.name}: {what} must be a non-null literal")
+    v = arg.value
+    return v.encode() if isinstance(v, str) else bytes(v)
+
+
+@register("reverse")
+def _reverse(cols, batch, expr):
+    (c,) = cols
+    return Column(c.dtype, S.reverse(c.data), c.validity)
+
+
+@register("initcap")
+def _initcap(cols, batch, expr):
+    (c,) = cols
+    return Column(c.dtype, S.initcap(c.data), c.validity)
+
+
+@register("left")
+def _left(cols, batch, expr):
+    c, n = cols[0], cols[1].data.astype(jnp.int32)
+    length = jnp.maximum(n, 0)  # spark: len <= 0 -> empty
+    return Column(c.dtype, S.substring(c.data, jnp.ones_like(length), length),
+                  _strict(cols))
+
+
+@register("right")
+def _right(cols, batch, expr):
+    c, n = cols[0], cols[1].data.astype(jnp.int32)
+    length = jnp.maximum(n, 0)
+    start = jnp.where(length > 0, -length, 1)
+    return Column(c.dtype, S.substring(c.data, start, length), _strict(cols))
+
+
+@register("lpad")
+def _lpad(cols, batch, expr):
+    c = cols[0]
+    n = _static_int_arg(expr, 1, "length")
+    pad = _static_str_arg(expr, 2, "pad") if len(cols) > 2 else b" "
+    return Column(c.dtype, S.lpad(c.data, n, pad), c.validity)
+
+
+@register("rpad")
+def _rpad(cols, batch, expr):
+    c = cols[0]
+    n = _static_int_arg(expr, 1, "length")
+    pad = _static_str_arg(expr, 2, "pad") if len(cols) > 2 else b" "
+    return Column(c.dtype, S.rpad(c.data, n, pad), c.validity)
+
+
+@register("strpos")
+@register("instr")
+@register("position")
+def _strpos(cols, batch, expr):
+    c = cols[0]
+    pat = _static_str_arg(expr, 1, "substring")
+    return Column(INT32, S.strpos(c.data, pat), _strict(cols))
+
+
+@register("replace")
+def _replace(cols, batch, expr):
+    c = cols[0]
+    search = _static_str_arg(expr, 1, "search")
+    rep = _static_str_arg(expr, 2, "replacement") if len(cols) > 2 else b""
+    return Column(c.dtype, S.replace(c.data, search, rep), _strict(cols[:1]))
+
+
+@register("translate")
+def _translate(cols, batch, expr):
+    c = cols[0]
+    frm = _static_str_arg(expr, 1, "from")
+    to = _static_str_arg(expr, 2, "to")
+    return Column(c.dtype, S.translate(c.data, frm, to), c.validity)
+
+
+@register("split_part")
+def _split_part(cols, batch, expr):
+    c = cols[0]
+    delim = _static_str_arg(expr, 1, "delimiter")
+    n = cols[2].data
+    res, defined = S.split_part(c.data, delim, n)
+    return Column(c.dtype, res, _and_valid(_strict(cols), defined))
+
+
+@register("chr")
+def _chr(cols, batch, expr):
+    (n,) = cols
+    return Column(STRING, S.chr_fn(n.data, batch.capacity), n.validity)
+
+
+@register("to_hex")
+@register("hex")
+def _to_hex(cols, batch, expr):
+    (n,) = cols
+    return Column(STRING, S.to_hex(n.data.astype(jnp.int64), batch.capacity),
+                  n.validity)
+
+
+# ---- digests / crc (host kernels, see hostfns.py) ----
+
+def _digest_impl(name):
+    def impl(cols, batch, expr):
+        from blaze_tpu.exprs import hostfns as H
+
+        width, row_fn = H.DIGESTS[name]
+        return H.host_bytes_to_string(cols[0], batch,
+                                      _hex_width(width), row_fn)
+
+    return impl
+
+
+def _hex_width(w: int) -> int:
+    from blaze_tpu.columnar.batch import bucket_width
+
+    return bucket_width(w)
+
+
+for _d in ("md5", "sha224", "sha256", "sha384", "sha512"):
+    _REGISTRY[_d] = _digest_impl(_d)
+
+
+@register("crc32")
+def _crc32(cols, batch, expr):
+    from blaze_tpu.exprs import hostfns as H
+
+    return H.host_bytes_to_int64(cols[0], batch, H.crc32_value)
+
+
+# ---- json (host kernels; ref spark_get_json_object.rs) ----
+
+@register("get_json_object")
+@register("get_parsed_json_object")
+def _get_json_object(cols, batch, expr):
+    from blaze_tpu.exprs import hostfns as H
+
+    c = cols[0]
+    path = _static_str_arg(expr, 1, "json path").decode()
+    steps = H.parse_json_path(path)
+    if steps is None:
+        # malformed path: all-null column of the input's width
+        return Column(STRING, StringData(jnp.zeros_like(c.data.bytes),
+                                         jnp.zeros_like(c.data.lengths)),
+                      jnp.zeros((batch.capacity,), jnp.bool_))
+    return H.host_bytes_to_string(
+        c, batch, c.data.width,
+        lambda raw: H.get_json_object_row(raw, steps))
+
+
+@register("parse_json")
+def _parse_json(cols, batch, expr):
+    from blaze_tpu.exprs import hostfns as H
+
+    c = cols[0]
+    return H.host_bytes_to_string(c, batch, c.data.width,
+                                  H.validate_json_row)
+
+
+@register("null_if_zero")
+def _null_if_zero(cols, batch, expr):
+    return _nullifzero(cols, batch, expr)
+
+
+@register("make_array")
+def _make_array(cols, batch, expr):
+    """spark array(...): one fixed-size list per row (ref spark_make_array.rs).
+
+    Offsets are uniform (k elements per row); element validity carries each
+    argument's nullability."""
+    from blaze_tpu.columnar.batch import ListData
+    from blaze_tpu.columnar import types as T
+
+    k = len(cols)
+    cap = batch.capacity
+    if k == 0:
+        raise NotImplementedError("make_array() with no args")
+    elem_dtype = cols[0].dtype
+    offsets = (jnp.arange(cap + 1, dtype=jnp.int32) * k)
+    if cols[0].is_string:
+        w = max(c.data.width for c in cols)
+        datas = [S.ensure_width(c.data, w) for c in cols]
+        eb = jnp.stack([d.bytes for d in datas], axis=1).reshape(cap * k, w)
+        el = jnp.stack([d.lengths for d in datas], axis=1).reshape(cap * k)
+        elems = Column(elem_dtype, StringData(eb, el),
+                       _interleave_validity(cols, cap, k))
+    else:
+        ed = jnp.stack([c.data for c in cols], axis=1).reshape(cap * k)
+        elems = Column(elem_dtype, ed, _interleave_validity(cols, cap, k))
+    return Column(T.list_of(elem_dtype), ListData(offsets, elems), None)
+
+
+def _interleave_validity(cols, cap, k):
+    if all(c.validity is None for c in cols):
+        return None
+    vs = [c.valid_mask() for c in cols]
+    return jnp.stack(vs, axis=1).reshape(cap * k)

@@ -242,6 +242,52 @@ class GetStructField(Expr):
 
 
 @dataclasses.dataclass(frozen=True)
+class GetIndexedField(Expr):
+    """arr[i] over a list column — 0-based, null when out of bounds (spark
+    GetArrayItem; ref datafusion-ext-exprs get_indexed_field.rs)."""
+
+    child: Expr
+    index: "Literal"
+
+    def children(self):
+        return (self.child,)
+
+    def key(self):
+        return ("getidx", self.index.key(), self.child.key())
+
+
+@dataclasses.dataclass(frozen=True)
+class GetMapValue(Expr):
+    """map[key] with a literal key — null when absent (ref
+    get_map_value.rs)."""
+
+    child: Expr
+    map_key: "Literal"
+
+    def children(self):
+        return (self.child,)
+
+    def key(self):
+        return ("getmap", self.map_key.key(), self.child.key())
+
+
+@dataclasses.dataclass(frozen=True)
+class NamedStruct(Expr):
+    """struct(name1, v1, ...) constructor (ref named_struct.rs)."""
+
+    names: Tuple[str, ...]
+    values: Tuple[Expr, ...]
+    result_type: DataType
+
+    def children(self):
+        return self.values
+
+    def key(self):
+        return ("namedstruct", self.names, repr(self.result_type),
+                tuple(v.key() for v in self.values))
+
+
+@dataclasses.dataclass(frozen=True)
 class MakeDecimal(Expr):
     """long unscaled -> decimal (ref proto MakeDecimal / UnscaledValue pair)."""
     child: Expr
@@ -306,6 +352,21 @@ class ScalarSubquery(Expr):
 
     def key(self):
         return ("scalar_subquery", self.resource_id, repr(self.return_type))
+
+
+def contains_host_fn(expr: Expr) -> bool:
+    """True if evaluating the expression crosses to the host (digests, JSON,
+    UDF wrapper). Operators containing such expressions must execute
+    unjitted — the axon TPU backend has no host-callback support (see
+    hostfns.host_apply)."""
+    if isinstance(expr, UdfWrapper):
+        return True
+    if isinstance(expr, ScalarFn):
+        from blaze_tpu.exprs.functions import is_host_fn
+
+        if is_host_fn(expr.name):
+            return True
+    return any(contains_host_fn(c) for c in expr.children())
 
 
 # -- convenience builders --

@@ -251,6 +251,266 @@ def repeat(s: StringData, n: int) -> StringData:
         jnp.zeros_like(s.bytes), jnp.zeros_like(s.lengths))
 
 
+def reverse(s: StringData) -> StringData:
+    """Reverse bytes per row (character-exact for ASCII; the engine's string
+    kernels are byte-level throughout, same divergence note as the
+    reference's caseconvert gate, BlazeConf.java:58)."""
+    j = jnp.arange(s.width, dtype=jnp.int32)
+    src = jnp.clip(s.lengths[:, None] - 1 - j[None, :], 0, s.width - 1)
+    taken = jnp.take_along_axis(s.bytes, src, axis=1)
+    mask = j[None, :] < s.lengths[:, None]
+    return StringData(jnp.where(mask, taken, jnp.uint8(0)), s.lengths)
+
+
+def initcap(s: StringData) -> StringData:
+    """Uppercase the first letter of each whitespace-delimited word,
+    lowercase the rest (ref spark_strings.rs initcap, ASCII subset)."""
+    b = s.bytes
+    is_ws = (b == 0x20) | ((b >= 0x09) & (b <= 0x0D))
+    # word start: position 0, or previous byte is whitespace
+    prev_ws = jnp.concatenate(
+        [jnp.ones((s.capacity, 1), jnp.bool_), is_ws[:, :-1]], axis=1)
+    lo = jnp.where((b >= 0x41) & (b <= 0x5A), b + 32, b)
+    up = jnp.where((lo >= 0x61) & (lo <= 0x7A), lo - 32, lo)
+    return StringData(jnp.where(prev_ws, up, lo), s.lengths)
+
+
+def lpad(s: StringData, n: int, pad: bytes) -> StringData:
+    """Left-pad (cyclically) with `pad` to byte-length n; truncate if longer.
+    n and pad are plan-time literals (static output width)."""
+    from blaze_tpu.columnar.batch import bucket_width
+
+    n = max(int(n), 0)
+    w_out = bucket_width(max(n, 1))
+    j = jnp.arange(w_out, dtype=jnp.int32)
+    if not pad:  # spark: nothing to pad with -> str truncated to n
+        return substring(s, jnp.ones_like(s.lengths),
+                         jnp.full_like(s.lengths, n))
+    npad = jnp.maximum(n - s.lengths, 0)
+    # byte j: pad[j % P] while j < npad, else input byte j - npad
+    body = jnp.take_along_axis(
+        s.bytes, jnp.clip(j[None, :] - npad[:, None], 0, s.width - 1), axis=1)
+    pat = _pattern_array(pad)
+    out = jnp.where(j[None, :] < npad[:, None], pat[j % len(pad)][None, :],
+                    body)
+    out_len = jnp.full_like(s.lengths, n)  # pad or truncate: always n
+    mask = j[None, :] < out_len[:, None]
+    return StringData(jnp.where(mask, out, jnp.uint8(0)), out_len)
+
+
+def rpad(s: StringData, n: int, pad: bytes) -> StringData:
+    """Right-pad (cyclically) with `pad` to byte-length n; truncate if
+    longer. n and pad are plan-time literals."""
+    from blaze_tpu.columnar.batch import bucket_width
+
+    n = max(int(n), 0)
+    w_out = bucket_width(max(n, 1))
+    j = jnp.arange(w_out, dtype=jnp.int32)
+    if not pad:
+        return substring(s, jnp.ones_like(s.lengths),
+                         jnp.full_like(s.lengths, n))
+    # byte j: input byte j while j < strlen, else pad[(j - strlen) % P]
+    body = jnp.take_along_axis(
+        s.bytes,
+        jnp.broadcast_to(jnp.clip(j[None, :], 0, s.width - 1),
+                         (s.capacity, w_out)), axis=1)
+    pat = _pattern_array(pad)
+    rel = jnp.maximum(j[None, :] - s.lengths[:, None], 0)
+    out = jnp.where(j[None, :] < s.lengths[:, None], body,
+                    pat[rel % len(pad)])
+    out_len = jnp.full_like(s.lengths, n)
+    mask = j[None, :] < out_len[:, None]
+    return StringData(jnp.where(mask, out, jnp.uint8(0)), out_len)
+
+
+def strpos(s: StringData, pattern: bytes) -> Array:
+    """1-based byte position of the first occurrence; 0 if absent
+    (spark instr/strpos). Empty pattern -> 1."""
+    p = len(pattern)
+    if p == 0:
+        return jnp.ones((s.capacity,), jnp.int32)
+    if p > s.width:
+        return jnp.zeros((s.capacity,), jnp.int32)
+    pos = match_positions(s, pattern)
+    shifts = jnp.arange(pos.shape[1], dtype=jnp.int32)
+    ok = pos & (shifts[None, :] + p <= s.lengths[:, None])
+    any_ok = jnp.any(ok, axis=1)
+    first = jnp.argmax(ok, axis=1).astype(jnp.int32)
+    return jnp.where(any_ok, first + 1, 0)
+
+
+def greedy_matches(s: StringData, pattern: bytes):
+    """Left-to-right non-overlapping matches of a literal pattern.
+
+    Returns (emitted (cap, nshift) bool — match chosen at shift j;
+    inside (cap, W) bool — byte position lies within a chosen match;
+    cum_em (cap, W) int32 — chosen matches with start <= j).
+    The greedy pass is a lax.scan over the static width (short loop, small
+    per-step work — fine on TPU for bucketed widths)."""
+    p = len(pattern)
+    cap = s.capacity
+    if p == 0 or p > s.width:
+        nshift = max(s.width - p + 1, 1)
+        z = jnp.zeros((cap, nshift), jnp.bool_)
+        return (z, jnp.zeros((cap, s.width), jnp.bool_),
+                jnp.zeros((cap, s.width), jnp.int32))
+    pos = match_positions(s, pattern)
+    nshift = pos.shape[1]
+    shifts = jnp.arange(nshift, dtype=jnp.int32)
+    ok = pos & (shifts[None, :] + p <= s.lengths[:, None])
+
+    def step(next_ok, x):
+        m, j = x
+        emit = m & (j >= next_ok)
+        return jnp.where(emit, j + p, next_ok), emit
+
+    _, em = jax.lax.scan(step, jnp.zeros((cap,), jnp.int32),
+                         (ok.T, shifts))
+    emitted = em.T  # (cap, nshift)
+    em_w = jnp.zeros((cap, s.width), jnp.bool_).at[:, :nshift].set(emitted)
+    inside = jnp.zeros((cap, s.width), jnp.bool_)
+    for t in range(p):
+        shifted = jnp.roll(em_w, t, axis=1)
+        if t:
+            shifted = shifted.at[:, :t].set(False)
+        inside = inside | shifted
+    cum_em = jnp.cumsum(em_w.astype(jnp.int32), axis=1)
+    return emitted, inside, cum_em
+
+
+def replace(s: StringData, search: bytes, rep: bytes) -> StringData:
+    """Replace every (greedy, non-overlapping) occurrence. Literal args.
+    Output width statically bounds the worst-case expansion — no silent
+    truncation."""
+    from blaze_tpu.columnar.batch import bucket_width
+
+    p, r = len(search), len(rep)
+    if p == 0:  # spark: empty search -> unchanged
+        return s
+    cap = s.capacity
+    emitted, inside, cum_em = greedy_matches(s, search)
+    grow = max(r - p, 0)
+    w_out = bucket_width(s.width + (s.width // p) * grow)
+    j = jnp.arange(s.width, dtype=jnp.int32)
+    rows = jnp.arange(cap, dtype=jnp.int32)[:, None]
+    out = jnp.zeros((cap, w_out), jnp.uint8)
+    # kept bytes: every chosen match with start <= j ended before j
+    keep = (j[None, :] < s.lengths[:, None]) & ~inside
+    kept_idx = j[None, :] + cum_em * (r - p)
+    kept_idx = jnp.where(keep, jnp.clip(kept_idx, 0, w_out - 1), w_out)
+    out = out.at[rows, kept_idx].set(s.bytes, mode="drop")
+    if r:
+        nshift = emitted.shape[1]
+        cum_at = cum_em[:, :nshift]
+        base = jnp.arange(nshift, dtype=jnp.int32)[None, :] + \
+            (cum_at - 1) * (r - p)
+        pat = _pattern_array(rep)
+        for t in range(r):
+            idx = jnp.where(emitted, jnp.clip(base + t, 0, w_out - 1), w_out)
+            out = out.at[rows, idx].set(
+                jnp.full((cap, nshift), pat[t], jnp.uint8), mode="drop")
+    nmatches = jnp.sum(emitted, axis=1, dtype=jnp.int32)
+    out_len = jnp.maximum(s.lengths + nmatches * (r - p), 0)
+    mask = jnp.arange(w_out, dtype=jnp.int32)[None, :] < out_len[:, None]
+    return StringData(jnp.where(mask, out, jnp.uint8(0)), out_len)
+
+
+def split_part(s: StringData, delim: bytes, n: Array) -> Tuple[StringData, Array]:
+    """spark split_part(str, delim, n): n-th (1-based) piece; negative n
+    counts from the end; out-of-range -> empty string. Returns
+    (result, defined) where defined=False marks n == 0 (spark raises; we
+    null the row, converters may reject earlier)."""
+    cap = s.capacity
+    n = n.astype(jnp.int32)
+    if len(delim) == 0 or len(delim) > s.width:
+        # no splits: one part = whole string
+        whole_ok = (n == 1) | (n == -1)
+        empty = StringData(jnp.zeros_like(s.bytes), jnp.zeros_like(s.lengths))
+        res = StringData(jnp.where(whole_ok[:, None], s.bytes, empty.bytes),
+                         jnp.where(whole_ok, s.lengths, 0))
+        return res, n != 0
+    _, inside, cum_em = greedy_matches(s, delim)
+    j = jnp.arange(s.width, dtype=jnp.int32)
+    in_len = j[None, :] < s.lengths[:, None]
+    last = cum_em[:, -1]
+    nparts = last + 1
+    eff = jnp.where(n > 0, n - 1, nparts + n)  # 0-based part index
+    keep = in_len & ~inside & (cum_em == eff[:, None])
+    count = jnp.sum(keep, axis=1, dtype=jnp.int32)
+    start = jnp.argmax(keep, axis=1).astype(jnp.int32)
+    res = substring(s, start + 1, count)
+    in_range = (eff >= 0) & (eff < nparts)
+    res = StringData(jnp.where(in_range[:, None], res.bytes, jnp.uint8(0)),
+                     jnp.where(in_range, res.lengths, 0))
+    return res, n != 0
+
+
+def translate(s: StringData, frm: bytes, to: bytes) -> StringData:
+    """spark translate: map chars of `frm` to `to` positionally; chars of
+    `frm` beyond len(to) are deleted; first occurrence in `frm` wins."""
+    import numpy as np
+
+    table = np.arange(256, dtype=np.uint8)
+    delete = np.zeros(256, bool)
+    seen = set()
+    for i, c in enumerate(frm):
+        if c in seen:
+            continue
+        seen.add(c)
+        if i < len(to):
+            table[c] = to[i]
+        else:
+            delete[c] = True
+    mapped = jnp.asarray(table)[s.bytes]
+    dele = jnp.asarray(delete)[s.bytes]
+    j = jnp.arange(s.width, dtype=jnp.int32)
+    keep = (j[None, :] < s.lengths[:, None]) & ~dele
+    # stable-compact kept bytes to the front of each row
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    packed = jnp.take_along_axis(mapped, order, axis=1)
+    new_len = jnp.sum(keep, axis=1, dtype=jnp.int32)
+    mask = j[None, :] < new_len[:, None]
+    return StringData(jnp.where(mask, packed, jnp.uint8(0)), new_len)
+
+
+def chr_fn(n: Array, capacity: int) -> StringData:
+    """spark chr(bigint): ASCII char of n % 256; negative -> empty."""
+    from blaze_tpu.columnar.batch import bucket_width
+
+    w = bucket_width(4)
+    v = (n.astype(jnp.int64) % 256).astype(jnp.uint8)
+    neg = n.astype(jnp.int64) < 0
+    mat = jnp.zeros((capacity, w), jnp.uint8).at[:, 0].set(
+        jnp.where(neg, jnp.uint8(0), v))
+    return StringData(mat, jnp.where(neg, 0, 1).astype(jnp.int32))
+
+
+def to_hex(n: Array, capacity: int) -> StringData:
+    """spark hex(bigint): uppercase, no leading zeros; negatives print the
+    full 16-digit two's complement (java Long.toHexString)."""
+    from blaze_tpu.columnar.batch import bucket_width
+
+    w = bucket_width(16)
+    x = n.astype(jnp.int64)
+    u = x.astype(jnp.uint64)
+    nibbles = jnp.stack(
+        [((u >> jnp.uint64(4 * (15 - k))) & jnp.uint64(0xF)).astype(jnp.uint8)
+         for k in range(16)], axis=1)
+    digit = jnp.where(nibbles < 10, nibbles + 0x30, nibbles - 10 + 0x41)
+    nz = nibbles != 0
+    any_nz = jnp.any(nz, axis=1)
+    lead = jnp.where(any_nz, jnp.argmax(nz, axis=1).astype(jnp.int32), 15)
+    out_len = (16 - lead).astype(jnp.int32)
+    j = jnp.arange(w, dtype=jnp.int32)
+    src = jnp.clip(lead[:, None] + j[None, :], 0, 15)
+    shifted = jnp.take_along_axis(
+        jnp.concatenate([digit, jnp.zeros((capacity, max(w - 16, 0)),
+                                          jnp.uint8)], axis=1)
+        if w > 16 else digit, src, axis=1)[:, :w]
+    mask = j[None, :] < out_len[:, None]
+    return StringData(jnp.where(mask, shifted, jnp.uint8(0)), out_len)
+
+
 def trim(s: StringData, left: bool = True, right: bool = True,
          chars: bytes = b" ") -> StringData:
     """Trim leading/trailing characters in `chars` (default space)."""

@@ -73,6 +73,12 @@ def _sum_state_dtype(d: DataType) -> DataType:
     return T.INT64
 
 
+def collect_state_dtype(call: AggCall) -> DataType:
+    """List dtype of a collect_list/collect_set state/result column."""
+    return (call.dtype if call.dtype.kind == TypeKind.LIST
+            else T.list_of(call.dtype))
+
+
 def state_fields(call: AggCall, i: int) -> List[Field]:
     """Typed state columns for one agg (named with the agg-buf convention)."""
     p = f"{AGG_BUF_PREFIX}.{i}"
@@ -91,6 +97,8 @@ def state_fields(call: AggCall, i: int) -> List[Field]:
                 Field(f"{p}.has", T.BOOLEAN)]
     if call.fn == "first_ignores_null":
         return [Field(f"{p}.val", call.dtype), Field(f"{p}.has", T.BOOLEAN)]
+    if call.fn in ("collect_list", "collect_set"):
+        return [Field(f"{p}.list", collect_state_dtype(call))]
     raise NotImplementedError(f"agg function {call.fn}")
 
 
@@ -105,10 +113,7 @@ def result_field(call: AggCall) -> Field:
 
 
 def _seg_any(flags, layout):
-    v, _ = seg.seg_reduce_scan(flags.astype(jnp.int32), layout,
-                               jnp.ones_like(flags, jnp.bool_),
-                               lambda a, b: a | b, 0)
-    return v.astype(jnp.bool_)
+    return seg.seg_any(flags, layout)
 
 
 def _first_by_index(values_cols: Sequence[Column], layout, has) -> Tuple[list, jax.Array]:
@@ -122,6 +127,48 @@ def _first_by_index(values_cols: Sequence[Column], layout, has) -> Tuple[list, j
     for c in values_cols:
         out.append(c.take(idx))
     return out, ok
+
+
+def _first_occurrence(x: Column, gid_key: jax.Array) -> jax.Array:
+    """True at the first row of each distinct (gid, value) pair.
+
+    Sorts (gid, value-encoding, iota), marks run starts, scatters the marks
+    back to original row positions. Rows whose gid_key is the out-of-range
+    sentinel never mark. Used by collect_set dedup (ref collect_set.rs's
+    per-group HashSet — sort-based here, SURVEY.md §7b)."""
+    cap = x.capacity
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    if x.is_list or x.is_struct:
+        # nested value types have no sort encoding yet; the planner rejects
+        # collect_set over them (converters._check_agg_call)
+        raise NotImplementedError(
+            "collect_set over nested value types is not supported")
+    if x.is_string:
+        from blaze_tpu.ops.sort_keys import string_words
+
+        words = string_words(x.data)
+        vals = tuple(words) + (x.data.lengths,)
+    else:
+        data = x.data
+        if data.dtype == jnp.bool_:
+            data = data.astype(jnp.int32)
+            vals = (data,)
+        elif jnp.issubdtype(data.dtype, jnp.floating):
+            # total-order bit encoding: adjacent NaNs compare EQUAL so the
+            # dedup collapses them (spark set semantics: NaN == NaN)
+            from blaze_tpu.ops.sort_keys import _float_total_order
+
+            vals = tuple(_float_total_order(data))
+        else:
+            vals = (data,)
+    ops = (gid_key,) + vals + (iota,)
+    sorted_ops = jax.lax.sort(ops, num_keys=len(ops) - 1, is_stable=True)
+    sgid, svals, perm = sorted_ops[0], sorted_ops[1:-1], sorted_ops[-1]
+    neq = sgid != jnp.roll(sgid, 1)
+    for v in svals:
+        neq = neq | (v != jnp.roll(v, 1))
+    first = (neq.at[0].set(True)) & (sgid < 2 ** 30)
+    return jnp.zeros((cap,), jnp.bool_).at[perm].set(first)
 
 
 class _AggState:
@@ -251,6 +298,9 @@ class AggExec(Operator):
                                for e in self.group_exprs]
             self._input_fns = [[compile_expr(e, child_schema)
                                 for e in call.inputs] for call in self.aggs]
+            self._work_jit = not any(
+                ir.contains_host_fn(e) for e in list(self.group_exprs) +
+                [x for call in self.aggs for x in call.inputs])
             probe = ColumnBatch.empty(child_schema, bucket_capacity(0))
             gcols = [jax.eval_shape(fn, probe) for fn in self._group_fns]
             group_fields = [Field(n, c.dtype)
@@ -324,7 +374,8 @@ class AggExec(Operator):
         working layout."""
         if self.mode != AggMode.PARTIAL:
             return batch  # already group+state layout
-        key = ("agg_work", self.plan_key(), batch.shape_key())
+        key = ("agg_work", self._work_jit, self.plan_key(),
+               batch.shape_key())
 
         def make():
             gfns, ifns = self._group_fns, self._input_fns
@@ -341,7 +392,8 @@ class AggExec(Operator):
 
             return run
 
-        return jit_cache.get_or_compile(key, make)(batch)
+        return jit_cache.get_or_compile(key, make,
+                                        jit=self._work_jit)(batch)
 
     def _collapse(self, batches: List[ColumnBatch], raw_input: bool
                   ) -> ColumnBatch:
@@ -433,7 +485,70 @@ class AggExec(Operator):
             val, has = seg.seg_first(x.data, layout, valid, ignores_null=True)
             return [Column(call.dtype, val, None),
                     Column(T.BOOLEAN, has, None)]
+        if fn in ("collect_list", "collect_set"):
+            return self._collect_raw(call, x, layout,
+                                     dedup=(fn == "collect_set"))
         raise NotImplementedError(f"agg function {fn}")
+
+    # ---- collect_list / collect_set (ref agg/collect_list.rs,
+    # collect_set.rs — there per-group Vec/HashSet accumulators; here the
+    # state is a ListData column whose group slices are built by segmented
+    # counting + stable compaction over the group-sorted rows) ----
+
+    def _list_dtype(self, call: AggCall) -> DataType:
+        return collect_state_dtype(call)
+
+    def _collect_raw(self, call: AggCall, x: Column, layout,
+                     dedup: bool) -> List[Column]:
+        from blaze_tpu.columnar.batch import ListData
+
+        valid = x.valid_mask() & layout.row_mask  # spark: nulls are dropped
+        keep = valid
+        if dedup:
+            gid_key = jnp.where(valid, layout.gid, jnp.int32(2 ** 30))
+            keep = keep & _first_occurrence(x, gid_key)
+        lens = seg.seg_sum(keep.astype(jnp.int32), layout,
+                           jnp.ones_like(keep))
+        lens = jnp.where(layout.group_mask, lens, 0)
+        goff = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                jnp.cumsum(lens, dtype=jnp.int32)])
+        # kept rows to the front, original (group-sorted) order preserved
+        order = jnp.argsort(~keep, stable=True).astype(jnp.int32)
+        elems = x.take(order)
+        dt = self._list_dtype(call)
+        return [Column(dt, ListData(goff, Column(dt.element, elems.data,
+                                                 None)), None)]
+
+    def _collect_merge(self, call: AggCall, lcol: Column, layout,
+                       dedup: bool) -> List[Column]:
+        from blaze_tpu.columnar.batch import ListData
+
+        dt = self._list_dtype(call)
+        ld = lcol.data
+        cap = layout.row_mask.shape[0]
+        ecap = ld.elements.capacity
+        lens_r = jnp.where(layout.row_mask & lcol.valid_mask(),
+                           ld.lengths(), 0).astype(jnp.int32)
+        cum = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                               jnp.cumsum(lens_r, dtype=jnp.int32)])
+        # explode rows (already gid-sorted) into one element stream
+        _, row, within, live = seg.element_rows(cum, cap, ecap)
+        src = jnp.clip(ld.offsets[row] + within, 0, ecap - 1)
+        elems = ld.elements.take(jnp.where(live, src, 0))
+        elems = Column(dt.element, elems.data, None)
+        egid = jnp.where(live, layout.gid[row], jnp.int32(2 ** 30))
+        if dedup:
+            keep = live & _first_occurrence(elems, egid)
+            order = jnp.argsort(~keep, stable=True).astype(jnp.int32)
+            elems = Column(dt.element, elems.take(order).data, None)
+            glens = jnp.zeros((cap,), jnp.int32).at[egid].add(
+                keep.astype(jnp.int32), mode="drop")
+        else:
+            glens = seg.seg_sum(lens_r, layout, jnp.ones((cap,), jnp.bool_))
+            glens = jnp.where(layout.group_mask, glens, 0)
+        goff = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                jnp.cumsum(glens, dtype=jnp.int32)])
+        return [Column(dt, ListData(goff, elems), None)]
 
     def _minmax_string(self, call, x: Column, layout, fn: str) -> List[Column]:
         """String min/max: sort rows by (gid, encoded string) and pick each
@@ -511,6 +626,9 @@ class AggExec(Operator):
                 (v,), ok = _first_by_index([cols[0]], layout, cols[1].data)
                 out += [Column(cols[0].dtype, v.data, None),
                         Column(T.BOOLEAN, ok, None)]
+            elif fn in ("collect_list", "collect_set"):
+                out.extend(self._collect_merge(call, cols[0], layout,
+                                               dedup=(fn == "collect_set")))
             else:
                 raise NotImplementedError(fn)
         return out
@@ -554,6 +672,10 @@ class AggExec(Operator):
         if fn == "first":
             return Column(call.dtype, scols[0].data,
                           scols[1].data & scols[2].data)
+        if fn in ("collect_list", "collect_set"):
+            # spark: groups with no collected values get an EMPTY array,
+            # not null
+            return scols[0]
         raise NotImplementedError(fn)
 
     def _empty_global_result(self) -> ColumnBatch:

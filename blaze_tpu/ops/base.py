@@ -86,6 +86,11 @@ class MapLikeOp(Operator):
     def make_batch_fn(self) -> Callable[[ColumnBatch], ColumnBatch]:
         raise NotImplementedError
 
+    def jit_safe(self) -> bool:
+        """False when the batch fn crosses to the host (digests/JSON/UDF) —
+        the fused chain then runs unjitted (hostfns.host_apply)."""
+        return True
+
     def execute(self, ctx: ExecContext) -> BatchStream:
         from blaze_tpu.runtime.executor import execute_fused
 

@@ -76,6 +76,9 @@ class ProjectExec(MapLikeOp):
         return ("project", tuple(e.key() for e in self.exprs), tuple(self.names),
                 self.child.plan_key())
 
+    def jit_safe(self) -> bool:
+        return not any(ir.contains_host_fn(e) for e in self.exprs)
+
     def make_batch_fn(self) -> Callable[[ColumnBatch], ColumnBatch]:
         fns, schema = self._fns, self._schema
 
@@ -100,6 +103,9 @@ class FilterExec(MapLikeOp):
 
     def plan_key(self) -> tuple:
         return ("filter", tuple(p.key() for p in self.predicates), self.child.plan_key())
+
+    def jit_safe(self) -> bool:
+        return not any(ir.contains_host_fn(p) for p in self.predicates)
 
     def make_batch_fn(self) -> Callable[[ColumnBatch], ColumnBatch]:
         fns = self._fns

@@ -45,28 +45,46 @@ def concat_batches(batches: List[ColumnBatch], schema: Optional[Schema] = None,
     out_cols = []
     for ci, field in enumerate(schema):
         parts = [b.columns[ci] for b in batches]
-        if parts[0].is_list:
-            out_cols.append(_concat_list_columns(parts, idx, field, cap))
-            continue
-        if parts[0].is_string:
-            w = max(p.data.width for p in parts)
-            datas = [S.ensure_width(p.data, w) for p in parts]
-            big_bytes = jnp.concatenate([d.bytes for d in datas], axis=0)
-            big_lens = jnp.concatenate([d.lengths for d in datas], axis=0)
-            data = StringData(big_bytes[idx], big_lens[idx])
-        else:
-            big = jnp.concatenate([p.data for p in parts], axis=0)
-            data = big[idx]
-        vs = [p.valid_mask() if p.validity is not None else None for p in parts]
-        if any(v is not None for v in vs):
-            big_v = jnp.concatenate(
-                [v if v is not None else jnp.ones((p.capacity,), jnp.bool_)
-                 for v, p in zip(vs, parts)], axis=0)
-            validity = big_v[idx]
-        else:
-            validity = None
-        out_cols.append(Column(field.dtype, data, validity))
+        out_cols.append(_concat_one(parts, idx, field, cap))
     return ColumnBatch(schema, out_cols, jnp.asarray(total, jnp.int32), cap)
+
+
+def _concat_validity(parts, idx):
+    vs = [p.valid_mask() if p.validity is not None else None for p in parts]
+    if not any(v is not None for v in vs):
+        return None
+    big_v = jnp.concatenate(
+        [v if v is not None else jnp.ones((p.capacity,), jnp.bool_)
+         for v, p in zip(vs, parts)], axis=0)
+    return big_v[idx]
+
+
+def _concat_one(parts, idx, field, cap):
+    """Concatenate one column across batches: every storage kind gathers
+    live rows through the SAME parent `idx` (positions in the virtual
+    concatenation of part capacities), so children stay row-aligned."""
+    if parts[0].is_list:
+        return _concat_list_columns(parts, idx, field, cap)
+    if parts[0].is_struct:
+        from blaze_tpu.columnar.batch import StructData
+        from blaze_tpu.columnar.types import Field
+
+        children = [
+            _concat_one([p.data.children[fi] for p in parts], idx,
+                        Field(f.name, f.dtype), cap)
+            for fi, f in enumerate(field.dtype.fields)]
+        return Column(field.dtype, StructData(children),
+                      _concat_validity(parts, idx))
+    if parts[0].is_string:
+        w = max(p.data.width for p in parts)
+        datas = [S.ensure_width(p.data, w) for p in parts]
+        big_bytes = jnp.concatenate([d.bytes for d in datas], axis=0)
+        big_lens = jnp.concatenate([d.lengths for d in datas], axis=0)
+        data = StringData(big_bytes[idx], big_lens[idx])
+    else:
+        big = jnp.concatenate([p.data for p in parts], axis=0)
+        data = big[idx]
+    return Column(field.dtype, data, _concat_validity(parts, idx))
 
 
 def _concat_list_columns(parts, idx, field, cap):
@@ -82,7 +100,9 @@ def _concat_list_columns(parts, idx, field, cap):
         bases.append(total_elems)
         total_elems += p.data.elements.capacity
         elem_parts.append(p.data.elements)
-    elem_schema = Schema([Field("e", field.dtype.element)])
+    from blaze_tpu.columnar.types import storage_element
+
+    elem_schema = Schema([Field("e", storage_element(field.dtype))])
     elem_batches = [
         ColumnBatch(elem_schema, [e],
                     jnp.asarray(e.capacity, jnp.int32), e.capacity)
@@ -100,18 +120,17 @@ def _concat_list_columns(parts, idx, field, cap):
             [v if v is not None else jnp.ones((p.capacity,), jnp.bool_)
              for v, p in zip(vs, parts)])[idx]
     # gather rows: emulate _list_take over the concatenated layout
+    from blaze_tpu.ops.segment import element_rows
+
     glens = lens[idx]
     new_off = jnp.concatenate([jnp.zeros((1,), jnp.int32),
                                jnp.cumsum(glens, dtype=jnp.int32)])
-    # direct expansion (starts are not contiguous, so inline the gather)
+    # starts are not contiguous in the concatenated storage, so gather via
+    # the shared slot->row mapping then offset by each row's start
     ecap = big_elems.capacity
-    slot = jnp.arange(ecap, dtype=jnp.int32)
     out_rows = idx.shape[0]
-    row = jnp.searchsorted(new_off[1:out_rows + 1], slot, side="right")
-    row = jnp.clip(row, 0, out_rows - 1)
-    within = slot - new_off[row]
+    _, row, within, live = element_rows(new_off, out_rows, ecap)
     src = starts[idx[row]] + within
-    live = slot < new_off[out_rows]
     elems = big_elems.take(jnp.where(live, src, 0))
     from blaze_tpu.columnar.batch import Column
 

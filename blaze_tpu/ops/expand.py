@@ -52,8 +52,10 @@ class ExpandExec(Operator):
         def gen():
             for batch in self.children[0].execute(ctx):
                 ctx.check_running()
+                jit = not any(ir.contains_host_fn(e)
+                              for p_ in self.projections for e in p_)
                 for pi, fns in enumerate(self._fns):
-                    key = ("expand_kernel", self.plan_key(), pi,
+                    key = ("expand_kernel", jit, self.plan_key(), pi,
                            batch.shape_key())
 
                     def make(fns=fns):
@@ -63,7 +65,8 @@ class ExpandExec(Operator):
                         return run
 
                     with self.metrics.timer():
-                        yield jit_cache.get_or_compile(key, make)(batch)
+                        yield jit_cache.get_or_compile(key, make,
+                                                       jit=jit)(batch)
 
         return count_stream(self, gen())
 
@@ -183,4 +186,5 @@ class GenerateExec(Operator):
             return run
 
         with self.metrics.timer():
-            return jit_cache.get_or_compile(key, make)(batch)
+            return jit_cache.get_or_compile(
+                key, make, jit=not ir.contains_host_fn(self.child_expr))(batch)

@@ -134,7 +134,9 @@ class ShuffleWriterExec(Operator):
 
         state = _WriterBuffers(self.partitioning.num_partitions,
                                M.get_manager(ctx))
-        key = ("shuffle_part", self.plan_key())
+        keys_jit = not any(ir.contains_host_fn(e)
+                           for e in self.partitioning.key_exprs)
+        key = ("shuffle_part", keys_jit, self.plan_key())
         try:
             for batch in self.children[0].execute(ctx):
                 ctx.check_running()
@@ -144,7 +146,8 @@ class ShuffleWriterExec(Operator):
                     fn = jit_cache.get_or_compile(
                         key + batch.shape_key(),
                         lambda: (lambda b: partition_and_sort(
-                            b, self.partitioning, self._key_fns)))
+                            b, self.partitioning, self._key_fns)),
+                        jit=keys_jit)
                     sb, counts = fn(batch)
                     hb = serde.to_host(sb)
                     counts = np.asarray(counts)
@@ -268,7 +271,9 @@ class RssShuffleWriterExec(ShuffleWriterExec):
     def execute(self, ctx: ExecContext) -> BatchStream:
         P = self.partitioning.num_partitions
         writer: RssPartitionWriterBase = resources.get(self.rss_resource_id)
-        key = ("shuffle_part", self.plan_key())
+        keys_jit = not any(ir.contains_host_fn(e)
+                           for e in self.partitioning.key_exprs)
+        key = ("shuffle_part", keys_jit, self.plan_key())
         for batch in self.children[0].execute(ctx):
             ctx.check_running()
             if int(batch.num_rows) == 0:

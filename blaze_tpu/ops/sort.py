@@ -42,9 +42,17 @@ def truncate(batch: ColumnBatch, limit: int) -> ColumnBatch:
     cols = []
     from blaze_tpu.columnar.batch import Column, StringData
 
+    iota = jnp.arange(cap, dtype=jnp.int32)
     for c in batch.columns:
         if c.is_string:
             data = StringData(c.data.bytes[:cap], c.data.lengths[:cap])
+        elif c.is_list:
+            from blaze_tpu.columnar.batch import ListData
+
+            data = ListData(c.data.offsets[:cap + 1], c.data.elements)
+        elif c.is_struct:
+            cols.append(c.take(iota))
+            continue
         else:
             data = c.data[:cap]
         v = c.validity[:cap] if c.validity is not None else None

@@ -136,10 +136,14 @@ class WindowExec(Operator):
                 if not buf.batches:
                     return
                 big = concat_batches(buf.batches, self.children[0].schema)
-                key = ("window_kernel", self.plan_key(), big.shape_key())
+                jit = not any(
+                    ir.contains_host_fn(e) for e in list(self.partition_exprs) +
+                    [x for c in self.calls for x in c.inputs])
+                key = ("window_kernel", jit, self.plan_key(),
+                       big.shape_key())
                 with self.metrics.timer():
                     out = jit_cache.get_or_compile(
-                        key, lambda: self._kernel)(big)
+                        key, lambda: self._kernel, jit=jit)(big)
                 yield out
             finally:
                 buf.close()

@@ -198,6 +198,18 @@ def decode_expr(p: pb.ExprNode) -> ir.Expr:
     if which == "get_struct_field":
         g = p.get_struct_field
         return ir.GetStructField(decode_expr(g.child), g.index)
+    if which == "get_indexed_field":
+        g = p.get_indexed_field
+        return ir.GetIndexedField(decode_expr(g.child),
+                                  decode_scalar(g.index))
+    if which == "get_map_value":
+        g = p.get_map_value
+        return ir.GetMapValue(decode_expr(g.child), decode_scalar(g.key))
+    if which == "named_struct":
+        g = p.named_struct
+        return ir.NamedStruct(tuple(g.names),
+                              tuple(decode_expr(v) for v in g.values),
+                              decode_dtype(g.result_type))
     if which == "make_decimal":
         m = p.make_decimal
         return ir.MakeDecimal(decode_expr(m.child), m.precision, m.scale)

@@ -166,6 +166,17 @@ def encode_expr(e: ir.Expr) -> pb.ExprNode:
     elif isinstance(e, ir.GetStructField):
         out.get_struct_field.child.CopyFrom(encode_expr(e.child))
         out.get_struct_field.index = e.index
+    elif isinstance(e, ir.GetIndexedField):
+        out.get_indexed_field.child.CopyFrom(encode_expr(e.child))
+        out.get_indexed_field.index.CopyFrom(encode_literal(e.index))
+    elif isinstance(e, ir.GetMapValue):
+        out.get_map_value.child.CopyFrom(encode_expr(e.child))
+        out.get_map_value.key.CopyFrom(encode_literal(e.map_key))
+    elif isinstance(e, ir.NamedStruct):
+        out.named_struct.names.extend(e.names)
+        for v in e.values:
+            out.named_struct.values.add().CopyFrom(encode_expr(v))
+        out.named_struct.result_type.CopyFrom(encode_dtype(e.result_type))
     elif isinstance(e, ir.MakeDecimal):
         out.make_decimal.child.CopyFrom(encode_expr(e.child))
         out.make_decimal.precision = e.precision

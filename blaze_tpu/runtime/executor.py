@@ -27,9 +27,16 @@ def _fused_chain(op: MapLikeOp) -> tuple:
 
 
 def execute_fused(op: MapLikeOp, ctx: ExecContext) -> BatchStream:
-    """Execute a map-like operator, fusing its maximal map-like chain."""
+    """Execute a map-like operator, fusing its maximal map-like chain.
+
+    Chains containing host-evaluated expressions (digests/JSON/UDF — see
+    Operator.jit_safe) run UNJITTED: device ops still dispatch eagerly on
+    device, host kernels get concrete arrays (hostfns.host_apply). The axon
+    TPU backend rejects XLA host callbacks, so this is the only execution
+    mode for such pipelines on real hardware."""
     top, source, chain = _fused_chain(op)
-    key = ("fused", top.plan_key())
+    jit = all(c.jit_safe() for c in chain)
+    key = ("fused", jit, top.plan_key())
 
     def make():
         fns = [c.make_batch_fn() for c in chain]
@@ -44,7 +51,8 @@ def execute_fused(op: MapLikeOp, ctx: ExecContext) -> BatchStream:
     def gen():
         for batch in source.execute(ctx):
             ctx.check_running()
-            fused = jit_cache.get_or_compile(key + batch.shape_key(), make)
+            fused = jit_cache.get_or_compile(key + batch.shape_key(), make,
+                                             jit=jit)
             with op.metrics.timer():
                 out = fused(batch)
             yield out

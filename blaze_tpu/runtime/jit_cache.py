@@ -21,15 +21,20 @@ _stats = {"hits": 0, "misses": 0}
 
 
 def get_or_compile(key: Hashable, make_fn: Callable[[], Callable],
-                   **jit_kwargs) -> Callable:
-    """Return a jitted function for `key`, building it once."""
+                   jit: bool = True, **jit_kwargs) -> Callable:
+    """Return a jitted function for `key`, building it once.
+
+    `jit=False` caches the bare callable instead: used for pipelines with
+    host-evaluated expressions (digests/JSON/UDF) — the axon TPU backend has
+    no host-callback support, so those run op-at-a-time on concrete arrays
+    (hostfns.host_apply) rather than inside one compiled program."""
     with _lock:
         fn = _cache.get(key)
         if fn is not None:
             _stats["hits"] += 1
             return fn
         _stats["misses"] += 1
-    built = jax.jit(make_fn(), **jit_kwargs)
+    built = jax.jit(make_fn(), **jit_kwargs) if jit else make_fn()
     with _lock:
         return _cache.setdefault(key, built)
 

@@ -20,7 +20,7 @@ import threading
 import uuid
 from typing import Callable, Dict, Iterator, List, Optional
 
-from blaze_tpu.columnar.types import Schema
+from blaze_tpu.columnar.types import Schema, TypeKind
 from blaze_tpu.config import conf
 from blaze_tpu.exprs import ir
 from blaze_tpu.plan import plan_pb2 as pb
@@ -45,8 +45,9 @@ _AGG_FN = {
 _AGG_MODE = {"partial": pb.AGG_PARTIAL, "partial_merge": pb.AGG_PARTIAL_MERGE,
              "final": pb.AGG_FINAL}
 
-# operators this engine does not run natively yet -> planner falls back
-_UNSUPPORTED_AGG_FNS = {"collect_list", "collect_set"}
+# agg functions the engine cannot run natively -> planner falls back
+# (empty since collect_list/collect_set landed on ListData state)
+_UNSUPPORTED_AGG_FNS: set = set()
 
 
 class ConversionError(Exception):
@@ -303,6 +304,14 @@ def _convert_agg(plan: SparkPlan) -> pb.PlanNode:
     for call in plan.attrs["aggs"]:
         if call["fn"] in _UNSUPPORTED_AGG_FNS:
             raise ConversionError(f"agg fn {call['fn']} not native yet")
+        if call["fn"] == "collect_set":
+            elem = call["dtype"]
+            if elem.kind == TypeKind.LIST:
+                elem = elem.element
+            if elem is not None and elem.is_nested:
+                # set dedup needs a sort encoding; nested values have none
+                raise ConversionError(
+                    "collect_set over nested value types is not native")
         ae = a.aggs.add()
         ae.fn = _AGG_FN[call["fn"]]
         for arg in call["args"]:

@@ -1,0 +1,154 @@
+"""collect_list / collect_set vs pandas oracle — raw, merge and spill paths.
+
+Ref: datafusion-ext-plans agg/collect_list.rs + collect_set.rs (per-group
+Vec/HashSet accumulators); here state is a ListData column built by
+segmented counting + stable compaction (ops/agg.py _collect_raw/_collect_merge).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from blaze_tpu.columnar import types as T
+from blaze_tpu.columnar.batch import ColumnBatch
+from blaze_tpu.exprs import ir
+from blaze_tpu.ops.agg import AggCall, AggExec, AggMode
+from blaze_tpu.ops.basic import MemorySourceExec
+from blaze_tpu.runtime.executor import collect
+
+SCHEMA = T.Schema([
+    T.Field("k", T.INT64),
+    T.Field("v", T.INT64),
+    T.Field("s", T.STRING),
+])
+
+LIST_I64 = T.list_of(T.INT64)
+LIST_STR = T.list_of(T.STRING)
+
+
+def _batches(rng, sizes, null_frac=0.0, nkeys=7, nvals=5):
+    out = []
+    for n in sizes:
+        data = {
+            "k": rng.integers(0, nkeys, n).astype(np.int64),
+            "v": rng.integers(0, nvals, n).astype(np.int64),
+            "s": [f"s{j}" for j in rng.integers(0, nvals, n)],
+        }
+        validity = None
+        if null_frac:
+            validity = {c: rng.random(n) > null_frac for c in ("v", "s")}
+        out.append(ColumnBatch.from_numpy(data, SCHEMA, validity=validity))
+    return out
+
+
+def _oracle(batches):
+    frames = []
+    for b in batches:
+        d = b.to_numpy()
+        frames.append(pd.DataFrame({
+            "k": np.asarray(d["k"]),
+            "v": [x for x in d["v"]],
+            "s": [x.decode() if x is not None else None for x in d["s"]],
+        }))
+    return pd.concat(frames, ignore_index=True)
+
+
+def _got_lists(out, name):
+    d = out.to_numpy()
+    return dict(zip(np.asarray(d["k"]), d[name]))
+
+
+@pytest.mark.parametrize("null_frac", [0.0, 0.3])
+@pytest.mark.parametrize("chain", [
+    [AggMode.PARTIAL, AggMode.FINAL],
+    [AggMode.PARTIAL, AggMode.PARTIAL_MERGE, AggMode.FINAL],
+])
+def test_collect_list_int(rng, null_frac, chain):
+    batches = _batches(rng, [150, 83], null_frac=null_frac)
+    node = MemorySourceExec(batches, SCHEMA)
+    calls = [AggCall("collect_list", (ir.col("v"),), LIST_I64, "lst")]
+    for mode in chain:
+        node = AggExec(node, [ir.col("k")], ["k"], calls, mode)
+    got = _got_lists(collect(node), "lst")
+    df = _oracle(batches)
+    for k, grp in df.groupby("k"):
+        want = [int(x) for x in grp["v"] if pd.notna(x)]
+        assert sorted(got[k]) == sorted(want), f"k={k}"
+        # within one partition order is row order
+        assert list(got[k]) == want, f"k={k} order"
+
+
+@pytest.mark.parametrize("chain", [
+    [AggMode.PARTIAL, AggMode.FINAL],
+    [AggMode.PARTIAL, AggMode.PARTIAL_MERGE, AggMode.FINAL],
+])
+def test_collect_set_int(rng, chain):
+    batches = _batches(rng, [200, 61], null_frac=0.2)
+    node = MemorySourceExec(batches, SCHEMA)
+    calls = [AggCall("collect_set", (ir.col("v"),), LIST_I64, "st")]
+    for mode in chain:
+        node = AggExec(node, [ir.col("k")], ["k"], calls, mode)
+    got = _got_lists(collect(node), "st")
+    df = _oracle(batches)
+    for k, grp in df.groupby("k"):
+        want = {int(x) for x in grp["v"] if pd.notna(x)}
+        assert set(got[k]) == want, f"k={k}"
+        assert len(got[k]) == len(want), f"k={k} dup"
+
+
+def test_collect_list_strings(rng):
+    batches = _batches(rng, [120], null_frac=0.25)
+    node = MemorySourceExec(batches, SCHEMA)
+    calls = [AggCall("collect_list", (ir.col("s"),), LIST_STR, "lst"),
+             AggCall("collect_set", (ir.col("s"),), LIST_STR, "st")]
+    for mode in (AggMode.PARTIAL, AggMode.FINAL):
+        node = AggExec(node, [ir.col("k")], ["k"], calls, mode)
+    out = collect(node)
+    d = out.to_numpy()
+    lists = dict(zip(np.asarray(d["k"]), d["lst"]))
+    sets_ = dict(zip(np.asarray(d["k"]), d["st"]))
+    df = _oracle(batches)
+    for k, grp in df.groupby("k"):
+        want = [x for x in grp["s"] if pd.notna(x)]
+        got_l = [x.decode() for x in lists[k]]
+        got_s = {x.decode() for x in sets_[k]}
+        assert got_l == want, f"k={k}"
+        assert got_s == set(want), f"k={k}"
+
+
+def test_collect_empty_group_is_empty_list(rng):
+    """A group whose values are all null collects an EMPTY list, not null."""
+    b = ColumnBatch.from_numpy(
+        {"k": np.array([1, 1, 2], np.int64),
+         "v": np.array([0, 0, 5], np.int64),
+         "s": ["a", "b", "c"]},
+        SCHEMA, validity={"v": np.array([False, False, True])})
+    node = MemorySourceExec([b], SCHEMA)
+    calls = [AggCall("collect_list", (ir.col("v"),), LIST_I64, "lst")]
+    for mode in (AggMode.PARTIAL, AggMode.FINAL):
+        node = AggExec(node, [ir.col("k")], ["k"], calls, mode)
+    got = _got_lists(collect(node), "lst")
+    assert list(got[1]) == []
+    assert list(got[2]) == [5]
+
+
+def test_collect_with_other_aggs_and_spill(rng):
+    """collect_list alongside scalar aggs, with the collapse threshold
+    forced low so the merge path runs repeatedly."""
+    batches = _batches(rng, [64] * 6, nkeys=4)
+    node = MemorySourceExec(batches, SCHEMA)
+    calls = [AggCall("collect_list", (ir.col("v"),), LIST_I64, "lst"),
+             AggCall("sum", (ir.col("v"),), T.INT64, "sum_v"),
+             AggCall("count", (ir.col("v"),), T.INT64, "cnt")]
+    for mode in (AggMode.PARTIAL, AggMode.FINAL):
+        node = AggExec(node, [ir.col("k")], ["k"], calls, mode,
+                       collapse_threshold=70)
+    out = collect(node)
+    d = out.to_numpy()
+    got = dict(zip(np.asarray(d["k"]), d["lst"]))
+    sums = dict(zip(np.asarray(d["k"]), d["sum_v"]))
+    df = _oracle(batches)
+    for k, grp in df.groupby("k"):
+        want = [int(x) for x in grp["v"]]
+        assert sorted(got[k]) == sorted(want), f"k={k}"
+        assert sums[k] == sum(want), f"k={k}"

@@ -1,0 +1,145 @@
+"""Struct / map / array-index expressions + storage round trips.
+
+Ref: datafusion-ext-exprs get_indexed_field.rs (233 LoC), get_map_value.rs
+(387), named_struct.rs (187) — here structs are StructData child columns and
+maps are list<struct<key,value>> (Arrow map layout, types.storage_element).
+"""
+
+import numpy as np
+import pytest
+
+from blaze_tpu.columnar import types as T
+from blaze_tpu.columnar.batch import ColumnBatch
+from blaze_tpu.columnar import serde
+from blaze_tpu.exprs import ir
+from blaze_tpu.exprs.ir import col
+from blaze_tpu.exprs.compiler import compile_expr
+from blaze_tpu.ops.basic import MemorySourceExec, ProjectExec
+from blaze_tpu.ops.common import concat_batches
+from blaze_tpu.runtime.executor import collect
+
+
+def run_expr(expr, data, schema, validity=None):
+    batch = ColumnBatch.from_numpy(data, schema, validity=validity)
+    out_col = compile_expr(expr, schema)(batch)
+    res = ColumnBatch(T.Schema([T.Field("r", out_col.dtype)]), [out_col],
+                      batch.num_rows, batch.capacity)
+    return res.to_numpy()["r"]
+
+
+STRUCT_T = T.struct_of([T.Field("a", T.INT64), T.Field("b", T.STRING)])
+MAP_T = T.map_of(T.STRING, T.INT64)
+LIST_T = T.list_of(T.INT64)
+
+
+def test_struct_storage_roundtrip():
+    schema = T.Schema([T.Field("st", STRUCT_T)])
+    data = {"st": [(1, "x"), (2, "y"), None, (4, "w")]}
+    b = ColumnBatch.from_numpy(data, schema)
+    out = b.to_numpy()["st"]
+    assert out[0] == (1, b"x") and out[1] == (2, b"y")
+    assert out[2] is None
+    assert out[3] == (4, b"w")
+
+
+def test_get_struct_field():
+    schema = T.Schema([T.Field("st", STRUCT_T)])
+    data = {"st": [(1, "x"), (2, "y"), None]}
+    out = run_expr(ir.GetStructField(col("st"), 0), data, schema)
+    assert list(out) == [1, 2, None]
+    out = run_expr(ir.GetStructField(col("st"), 1), data, schema)
+    assert list(out) == [b"x", b"y", None]
+
+
+def test_named_struct_then_field():
+    schema = T.Schema([T.Field("a", T.INT64), T.Field("s", T.STRING)])
+    data = {"a": np.array([10, 20], np.int64), "s": ["p", "q"]}
+    ns = ir.NamedStruct(("x", "y"), (col("a"), col("s")), STRUCT_T)
+    out = run_expr(ns, data, schema)
+    assert out[0] == (10, b"p") and out[1] == (20, b"q")
+    out = run_expr(ir.GetStructField(ns, 0), data, schema)
+    assert list(out) == [10, 20]
+
+
+def test_get_indexed_field():
+    schema = T.Schema([T.Field("xs", LIST_T)])
+    data = {"xs": [[1, 2, 3], [], [7], None]}
+    out = run_expr(
+        ir.GetIndexedField(col("xs"), ir.Literal(T.INT64, 1)), data, schema)
+    assert list(out) == [2, None, None, None]
+    out = run_expr(
+        ir.GetIndexedField(col("xs"), ir.Literal(T.INT64, 0)), data, schema)
+    assert list(out) == [1, None, 7, None]
+    # negative / out of range -> null (spark GetArrayItem)
+    out = run_expr(
+        ir.GetIndexedField(col("xs"), ir.Literal(T.INT64, -1)), data, schema)
+    assert list(out) == [None, None, None, None]
+
+
+def test_map_storage_and_get_map_value():
+    schema = T.Schema([T.Field("m", MAP_T)])
+    data = {"m": [{"a": 1, "b": 2}, {"b": 5}, {}, None]}
+    b = ColumnBatch.from_numpy(data, schema)
+    out = b.to_numpy()["m"]
+    assert out[0] == {b"a": 1, b"b": 2}
+    assert out[1] == {b"b": 5}
+    assert out[2] == {}
+    assert out[3] is None
+
+    got = run_expr(
+        ir.GetMapValue(col("m"), ir.Literal(T.STRING, "b")), data, schema)
+    assert list(got) == [2, 5, None, None]
+    got = run_expr(
+        ir.GetMapValue(col("m"), ir.Literal(T.STRING, "zz")), data, schema)
+    assert list(got) == [None, None, None, None]
+
+
+def test_int_key_map():
+    mt = T.map_of(T.INT64, T.STRING)
+    schema = T.Schema([T.Field("m", mt)])
+    data = {"m": [{1: "one", 2: "two"}, {2: "zwei"}]}
+    got = run_expr(
+        ir.GetMapValue(col("m"), ir.Literal(T.INT64, 2)), data, schema)
+    assert list(got) == [b"two", b"zwei"]
+
+
+def test_struct_map_serde_roundtrip():
+    schema = T.Schema([T.Field("st", STRUCT_T), T.Field("m", MAP_T)])
+    data = {"st": [(1, "x"), None, (3, "z")],
+            "m": [{"k": 9}, {"j": 1, "k": 2}, None]}
+    b = ColumnBatch.from_numpy(data, schema)
+    buf = serde.serialize_batch(b)
+    back = serde.deserialize_batch(buf, schema)
+    got = back.to_numpy()
+    want = b.to_numpy()
+    assert got["st"] == want["st"]
+    assert got["m"] == want["m"]
+
+
+def test_struct_concat_alignment():
+    """Regression: children must gather live rows via the parent idx
+    (partially-full batches used to misalign, review finding r3)."""
+    schema = T.Schema([T.Field("st", STRUCT_T)])
+    b1 = ColumnBatch.from_numpy({"st": [(1, "a"), (2, "b")]}, schema,
+                                capacity=8)
+    b2 = ColumnBatch.from_numpy({"st": [(5, "e")]}, schema, capacity=8)
+    out = concat_batches([b1, b2], schema)
+    vals = out.to_numpy()["st"]
+    assert vals == [(1, b"a"), (2, b"b"), (5, b"e")]
+
+
+def test_struct_through_plan_proto():
+    """Full contract: encode NamedStruct/GetMapValue through the proto and
+    execute the decoded plan."""
+    from blaze_tpu.plan import plan_pb2 as pb
+    from blaze_tpu.plan.to_proto import encode_expr
+    from blaze_tpu.plan.from_proto import decode_expr
+
+    ns = ir.NamedStruct(("x", "y"),
+                        (col("a"), ir.Literal(T.STRING, "w")), STRUCT_T)
+    round1 = decode_expr(encode_expr(ns))
+    assert round1 == ns
+    gmv = ir.GetMapValue(col("m"), ir.Literal(T.STRING, "k"))
+    assert decode_expr(encode_expr(gmv)) == gmv
+    gif = ir.GetIndexedField(col("xs"), ir.Literal(T.INT64, 3))
+    assert decode_expr(encode_expr(gif)) == gif

@@ -1,0 +1,206 @@
+"""String-function tail + digests + JSON path — golden tests vs Spark
+semantics.
+
+Ref test analogs: datafusion-ext-functions spark_strings.rs tests (replace/
+translate/pad/initcap/strpos/split_part...), lib.rs digest registrations,
+and spark_get_json_object.rs tests.
+"""
+
+import hashlib
+import zlib
+
+import numpy as np
+import pytest
+
+from blaze_tpu.columnar import (
+    ColumnBatch, Schema, Field, INT32, INT64, STRING,
+)
+from blaze_tpu.exprs import ir
+from blaze_tpu.exprs.ir import col
+from blaze_tpu.exprs.compiler import compile_expr
+
+
+def run(expr, data, schema, validity=None):
+    batch = ColumnBatch.from_numpy(data, schema, validity=validity)
+    out_col = compile_expr(expr, schema)(batch)
+    out_schema = Schema([Field("r", out_col.dtype)])
+    res = ColumnBatch(out_schema, [out_col], batch.num_rows, batch.capacity)
+    vals = res.to_numpy()["r"]
+    return [v.decode() if isinstance(v, bytes) else v for v in vals]
+
+
+SS = Schema([Field("s", STRING)])
+SN = Schema([Field("s", STRING), Field("n", INT32)])
+
+
+def slit(v):
+    return ir.Literal(STRING, v)
+
+
+def ilit(v):
+    return ir.Literal(INT32, v)
+
+
+def test_reverse():
+    data = {"s": ["abc", "", "a", "hello"]}
+    out = run(ir.ScalarFn("reverse", (col("s"),)), data, SS)
+    assert list(out) == ["cba", "", "a", "olleh"]
+
+
+def test_initcap():
+    data = {"s": ["hello world", "ALL CAPS", "x", "", "a  b\tc"]}
+    out = run(ir.ScalarFn("initcap", (col("s"),)), data, SS)
+    assert list(out) == ["Hello World", "All Caps", "X", "", "A  B\tC"]
+
+
+def test_left_right():
+    data = {"s": ["hello", "ab", ""], "n": np.array([3, 5, 2], np.int32)}
+    assert list(run(ir.ScalarFn("left", (col("s"), col("n"))), data, SN)) == \
+        ["hel", "ab", ""]
+    assert list(run(ir.ScalarFn("right", (col("s"), col("n"))), data, SN)) == \
+        ["llo", "ab", ""]
+    # negative length -> empty (spark)
+    data = {"s": ["hello"], "n": np.array([-2], np.int32)}
+    assert list(run(ir.ScalarFn("left", (col("s"), col("n"))), data, SN)) == [""]
+    assert list(run(ir.ScalarFn("right", (col("s"), col("n"))), data, SN)) == [""]
+
+
+def test_lpad_rpad():
+    data = {"s": ["hi", "hello", ""]}
+    out = run(ir.ScalarFn("lpad", (col("s"), ilit(5), slit("ab"))), data, SS)
+    assert list(out) == ["abahi", "hello", "ababa"]
+    out = run(ir.ScalarFn("rpad", (col("s"), ilit(5), slit("ab"))), data, SS)
+    assert list(out) == ["hiaba", "hello", "ababa"]
+    # truncation when longer than target
+    out = run(ir.ScalarFn("lpad", (col("s"), ilit(3), slit("x"))), data, SS)
+    assert list(out) == ["xhi", "hel", "xxx"]
+    out = run(ir.ScalarFn("rpad", (col("s"), ilit(3), slit("x"))), data, SS)
+    assert list(out) == ["hix", "hel", "xxx"]
+
+
+def test_strpos():
+    data = {"s": ["hello", "xyz", "aaab", ""]}
+    out = run(ir.ScalarFn("strpos", (col("s"), slit("l"))), data, SS)
+    assert list(out) == [3, 0, 0, 0]
+    out = run(ir.ScalarFn("instr", (col("s"), slit("ab"))), data, SS)
+    assert list(out) == [0, 0, 3, 0]
+
+
+def test_replace():
+    data = {"s": ["aaa", "banana", "", "xyx"]}
+    out = run(ir.ScalarFn("replace", (col("s"), slit("a"), slit("bb"))),
+              data, SS)
+    assert list(out) == ["bbbbbb", "bbbnbbnbb", "", "xyx"]
+    # shrinking replacement
+    out = run(ir.ScalarFn("replace", (col("s"), slit("an"), slit(""))),
+              data, SS)
+    assert list(out) == ["aaa", "ba", "", "xyx"]
+    # overlapping candidates are consumed greedily left-to-right
+    data = {"s": ["aaaa"]}
+    out = run(ir.ScalarFn("replace", (col("s"), slit("aa"), slit("b"))),
+              data, SS)
+    assert list(out) == ["bb"]
+
+
+def test_translate():
+    data = {"s": ["AaBbCc", "translate", ""]}
+    out = run(ir.ScalarFn("translate", (col("s"), slit("abc"), slit("xyz"))),
+              data, SS)
+    assert list(out) == ["AxByCz", "trxnslxte", ""]
+    # from longer than to: extra chars deleted
+    out = run(ir.ScalarFn("translate", (col("s"), slit("abt"), slit("1"))),
+              data, SS)
+    # a->1; b and t map beyond len(to) so they are deleted
+    assert list(out) == ["A1BCc", "r1nsl1e", ""]
+
+
+def test_split_part():
+    data = {"s": ["a,b,c", "one", ",x,", "a,,b"],
+            "n": np.array([2, 1, 1, 2], np.int32)}
+    out = run(ir.ScalarFn("split_part", (col("s"), slit(","), col("n"))),
+              data, SN)
+    assert list(out) == ["b", "one", "", ""]
+    # negative index counts from the end; out-of-range -> empty
+    data = {"s": ["a,b,c", "a,b,c"], "n": np.array([-1, 5], np.int32)}
+    out = run(ir.ScalarFn("split_part", (col("s"), slit(","), col("n"))),
+              data, SN)
+    assert list(out) == ["c", ""]
+
+
+def test_chr_to_hex():
+    SI = Schema([Field("n", INT64)])
+    data = {"n": np.array([65, 97, 321, -1, 0], np.int64)}
+    out = run(ir.ScalarFn("chr", (col("n"),)), data, SI)
+    assert list(out) == ["A", "a", "A", "", "\x00"]
+    data = {"n": np.array([264, 0, 15, -1], np.int64)}
+    out = run(ir.ScalarFn("to_hex", (col("n"),)), data, SI)
+    assert list(out) == ["108", "0", "F", "FFFFFFFFFFFFFFFF"]
+
+
+def test_digests():
+    vals = ["abc", "", "hello world"]
+    data = {"s": vals}
+    for name, fn in [("md5", hashlib.md5), ("sha224", hashlib.sha224),
+                     ("sha256", hashlib.sha256), ("sha384", hashlib.sha384),
+                     ("sha512", hashlib.sha512)]:
+        out = run(ir.ScalarFn(name, (col("s"),)), data, SS)
+        assert list(out) == [fn(v.encode()).hexdigest() for v in vals], name
+
+
+def test_digest_null_propagates():
+    data = {"s": ["abc", "def"]}
+    out = run(ir.ScalarFn("md5", (col("s"),)), data, SS,
+              validity={"s": np.array([True, False])})
+    assert out[0] == hashlib.md5(b"abc").hexdigest()
+    assert out[1] is None
+
+
+def test_crc32():
+    vals = ["abc", "", "spark"]
+    out = run(ir.ScalarFn("crc32", (col("s"),)), {"s": vals}, SS)
+    assert list(out) == [zlib.crc32(v.encode()) & 0xFFFFFFFF for v in vals]
+
+
+def test_get_json_object():
+    docs = ['{"a": {"b": 1}, "c": "text"}',
+            '{"a": {"b": [1,2,3]}}',
+            'not json',
+            '{"c": null}',
+            '{"list": [{"x": 1}, {"x": 2}]}']
+    data = {"s": docs}
+    out = run(ir.ScalarFn("get_json_object", (col("s"), slit("$.a.b"))),
+              data, SS)
+    assert list(out) == ["1", "[1,2,3]", None, None, None]
+    out = run(ir.ScalarFn("get_json_object", (col("s"), slit("$.c"))),
+              data, SS)
+    assert list(out) == ["text", None, None, None, None]
+    out = run(ir.ScalarFn("get_json_object", (col("s"), slit("$.a.b[1]"))),
+              data, SS)
+    assert list(out) == [None, "2", None, None, None]
+    out = run(ir.ScalarFn("get_json_object",
+                          (col("s"), slit("$.list[*].x"))), data, SS)
+    assert list(out) == [None, None, None, None, "[1,2]"]
+
+
+def test_parse_json():
+    docs = ['{"a": 1}', "[1,2]", "oops", "123"]
+    out = run(ir.ScalarFn("parse_json", (col("s"),)), {"s": docs}, SS)
+    assert list(out) == ['{"a": 1}', "[1,2]", None, "123"]
+
+
+def test_make_array_explodes():
+    """make_array feeds the list machinery: build then explode round-trips."""
+    from blaze_tpu.ops.basic import MemorySourceExec, ProjectExec
+    from blaze_tpu.ops.expand import GenerateExec
+    from blaze_tpu.runtime.executor import collect
+
+    S2 = Schema([Field("a", INT64), Field("b", INT64)])
+    batch = ColumnBatch.from_numpy(
+        {"a": np.array([1, 2], np.int64), "b": np.array([10, 20], np.int64)},
+        S2)
+    src = MemorySourceExec([batch], S2)
+    proj = ProjectExec(src, [ir.ScalarFn("make_array",
+                                         (col("a"), col("b")))], ["arr"])
+    gen = GenerateExec(proj, col("arr"), [], ["v"], pos=False, outer=False)
+    out = collect(gen).to_numpy()
+    assert list(out["v"]) == [1, 10, 2, 20]
