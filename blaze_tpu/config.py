@@ -41,6 +41,12 @@ class BlazeConf:
     spill_dir: str = os.environ.get("BLAZE_TPU_SPILL_DIR", "/tmp/blaze_tpu_spill")
     # zstd level for shuffle/spill/broadcast frames (ref uses level 1)
     zstd_level: int = 1
+    # whole-stage single-dispatch compiler (runtime/stage_compiler.py):
+    # amortizes the ~90ms-per-dispatch cost of remote-attached TPUs
+    enable_stage_compiler: bool = True
+    # dense grouped-agg key range for the MXU one-hot path (<= 2^16:
+    # 256x256 byte decomposition); stages whose keys exceed it fall back
+    dense_agg_range: int = 1 << 16
     # per-operator enable flags (tier b, spark.blaze.enable.<op>)
     enable_ops: Dict[str, bool] = dataclasses.field(default_factory=dict)
 

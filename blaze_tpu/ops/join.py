@@ -454,6 +454,10 @@ class HashJoinLikeExec(Operator):
             probe, build_sorted)
         matched_now = bmatch > 0
 
+        if self.join_filter is not None and jt != JoinType.INNER:
+            return self._join_batch_filtered(probe, build_sorted, start, cnt,
+                                             probe_is_left, build_side_semi)
+
         if build_side_semi:
             return None, matched_now
         if jt in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI, JoinType.EXISTENCE):
@@ -489,9 +493,7 @@ class HashJoinLikeExec(Operator):
         out = jit_cache.get_or_compile(key2, make2)(
             probe, build_sorted, start, cnt)
         if self.join_filter is not None:
-            out, matched_now = self._apply_filter(out, probe, build_sorted,
-                                                  start, cnt, matched_now,
-                                                  probe_is_left)
+            out = self._apply_inner_filter(out)
         return out, matched_now
 
     def _semi_like(self, probe: ColumnBatch, cnt: Array, jt: JoinType
@@ -503,23 +505,108 @@ class HashJoinLikeExec(Operator):
         keep = (cnt > 0) if jt == JoinType.LEFT_SEMI else (cnt == 0)
         return probe.with_columns(self._schema, probe.columns).compact(keep)
 
-    def _apply_filter(self, out, probe, build_sorted, start, cnt,
-                      matched_now, probe_is_left):
-        """Residual non-equi filter over expanded rows; outer rows whose
-        matches all fail revert to null-extended (two-pass, ref SMJ filter
-        semantics)."""
+    def _apply_inner_filter(self, out):
+        """Residual non-equi filter on INNER joins: simple compaction.
+        (Non-inner filters take _join_batch_filtered.)"""
         pred = compile_expr(self.join_filter, self._schema)
         c = pred(out)
         ok = c.data.astype(jnp.bool_) & c.valid_mask() & out.row_mask()
+        return out.compact(ok)
+
+    def _join_batch_filtered(self, probe, build_sorted, start, cnt,
+                             probe_is_left, build_side_semi):
+        """Join filter on non-inner joins (ref sort_merge_join_exec.rs join
+        filter handling): expand matched pairs, evaluate the residual
+        predicate, then re-derive per-probe surviving counts and per-build
+        matched flags from the SURVIVORS — outer rows whose matches all fail
+        the filter revert to null-extended, semi/anti/existence count only
+        passing matches."""
         jt = self.join_type
-        if jt == JoinType.INNER:
-            return out.compact(ok), matched_now
-        # outer joins with filters need per-probe surviving counts: done on
-        # host-free arrays via segment trick over probe_idx runs — deferred
-        # to the dedicated filtered-outer kernel (round 2); for now fall back
-        # to inner-filter semantics plus unmatched emission.
-        raise NotImplementedError(
-            "join filters on outer joins not yet supported")
+        capP, capB = probe.capacity, build_sorted.capacity
+        probe_outer = (not build_side_semi) and (
+            (jt == JoinType.LEFT and probe_is_left) or
+            (jt == JoinType.RIGHT and not probe_is_left) or
+            jt == JoinType.FULL)
+        semi_like = (not build_side_semi) and jt in (
+            JoinType.LEFT_SEMI, JoinType.LEFT_ANTI, JoinType.EXISTENCE)
+
+        eff = jnp.maximum(cnt, 1) if probe_outer else cnt
+        total = int(jnp.sum(jnp.where(probe.row_mask(), eff, 0)))
+        no_matched = jnp.zeros((capB,), jnp.bool_)
+        # the filter always sees left-fields + right-fields, regardless of
+        # the join's OUTPUT schema (semi/anti/existence outputs omit the
+        # build side but the predicate references it)
+        pair_schema = Schema(list(self.children[0].schema.fields) +
+                             list(self.children[1].schema.fields))
+        if total == 0:
+            cnt_ok = jnp.zeros((capP,), jnp.int32)
+            out = pidx = bvalid = None
+            matched_now = no_matched
+        else:
+            out_cap = bucket_capacity(total)
+            key = ("join_expandf", self.plan_key(), probe_outer,
+                   probe.shape_key(), build_sorted.shape_key(), out_cap)
+
+            def make():
+                def run(p, b, start, cnt):
+                    pidx, bidx, bvalid, num = expand_pairs(
+                        start, cnt, out_cap, probe_outer,
+                        probe_mask=p.row_mask())
+                    pcols = [c.take(pidx) for c in p.columns]
+                    bcols = [c.take(bidx, index_valid=bvalid)
+                             for c in b.columns]
+                    cols = (pcols + bcols) if probe_is_left \
+                        else (bcols + pcols)
+                    return (ColumnBatch(pair_schema, cols, num, out_cap),
+                            pidx, bidx, bvalid)
+                return run
+
+            out, pidx, bidx, bvalid = jit_cache.get_or_compile(key, make)(
+                probe, build_sorted, start, cnt)
+            # predicate runs eagerly (may contain host fns); survivors only
+            # among real pairs
+            pred = compile_expr(self.join_filter, pair_schema)
+            c = pred(out)
+            ok = (c.data.astype(jnp.bool_) & c.valid_mask() &
+                  out.row_mask() & bvalid)
+            cnt_ok = jax.ops.segment_sum(
+                ok.astype(jnp.int32), jnp.where(ok, pidx, jnp.int32(capP)),
+                num_segments=capP)
+            matched_now = jax.ops.segment_sum(
+                ok.astype(jnp.int32), jnp.where(ok, bidx, jnp.int32(capB)),
+                num_segments=capB) > 0
+
+        if build_side_semi:
+            return None, matched_now
+        if semi_like:
+            if jt == JoinType.EXISTENCE:
+                cols = probe.columns + [Column(T.BOOLEAN, cnt_ok > 0, None)]
+                return (ColumnBatch(self._schema, cols, probe.num_rows,
+                                    probe.capacity), matched_now)
+            keep = (cnt_ok > 0) if jt == JoinType.LEFT_SEMI else (cnt_ok == 0)
+            return (probe.with_columns(self._schema,
+                                       probe.columns).compact(keep),
+                    matched_now)
+
+        if out is None:
+            return None, matched_now
+        # probe-side outer (LEFT/RIGHT/FULL): keep passing pairs, keep the
+        # key-unmatched null emissions, and DEMOTE the first pair of probe
+        # rows whose matches all failed to a null-extended row
+        live = out.row_mask()
+        is_first = (pidx != jnp.roll(pidx, 1)).at[0].set(True)
+        demote = (is_first & bvalid & (cnt_ok[pidx] == 0) & live
+                  ) if probe_outer else jnp.zeros_like(live)
+        keep = ok | (live & ~bvalid) | demote
+        # build columns become null on demoted rows
+        nb = len(build_sorted.schema.fields)
+        cols = list(out.columns)
+        brange = range(len(cols) - nb, len(cols)) if probe_is_left \
+            else range(nb)
+        for i in brange:
+            cols[i] = Column(cols[i].dtype, cols[i].data,
+                             cols[i].valid_mask() & ok)
+        return out.with_columns(self._schema, cols).compact(keep), matched_now
 
     def _unmatched_build(self, build_sorted, build_matched, probe_is_left,
                          probe_schema) -> Optional[ColumnBatch]:

@@ -1,0 +1,205 @@
+"""Dense-key grouped aggregation on the MXU (one-hot matmul accumulate).
+
+The sort-based agg path (ops/agg.py) is general but leans on `lax.sort` and
+scatters — both weak primitives on TPU (a 2M-row sort is ~100ms; a 2M-row
+scatter ~250ms). When the grouping key is integral with a bounded range —
+the common TPC-DS shape: surrogate keys like ss_item_sk — grouped sums and
+counts become ONE-HOT MATMULS: decompose key k into (hi, lo) parts, then
+
+    S[hi, lo] = sum_r v_r * onehot_hi(r) (x) onehot_lo(r)
+              = A^T B  with  A = onehot_lo * v  (n x GL),  B = onehot_hi
+
+which runs on the systolic array at TFLOP rates instead of the VPU's
+sort/scatter paths. Exactness: values are decomposed into 8-bit integer
+digits (integers <= 256 are exact in bfloat16); per-block partial sums stay
+below 2^24 so the MXU's f32 accumulation is exact; digits recombine in f64.
+Relative error is bounded by the fixed-point quantization, 2^-48 of the
+batch max — the same 49-bit effective mantissa this backend's emulated f64
+has anyway. GL is 128 (not 256): the digit-scaled side is the (n, GL)
+matrix, and halving it halves the dominant memory traffic while the matmul
+FLOPs (2*n*R) stay identical.
+
+No reference analog: this is the TPU-first replacement for the hash-table
+accumulate of agg_tables.rs:360-430 (SURVEY.md §7b).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+CHUNK_BITS = 8          # integers <= 256 are exact in bfloat16
+F64_CHUNKS = 6          # 48 bits ~ this backend's effective f64 mantissa
+I64_CHUNKS = 8          # 64 bits (top chunk carries bits 56..62)
+MAX_RANGE = 1 << 16
+_GL = 128
+
+
+def _blk(n: int) -> int:
+    # per-block accumulated digit sums must stay < 2^24 (f32-exact):
+    # BLK * 255 < 2^24  ->  BLK <= 2^16 (n is a power of two)
+    return min(n, 1 << 16)
+
+
+def _onehots(keys: Array, valid: Array, gh: int) -> Tuple[Array, Array]:
+    """(n, GL) digit-carrier side and (n, gh) one-hot side, bfloat16;
+    invalid rows are all-zero on the GL side."""
+    kh = (keys // _GL).astype(jnp.int32)
+    kl = (keys % _GL).astype(jnp.int32)
+    oh_l = ((kl[:, None] == jnp.arange(_GL, dtype=jnp.int32)[None, :]) &
+            valid[:, None]).astype(jnp.bfloat16)
+    oh_h = (kh[:, None] == jnp.arange(gh, dtype=jnp.int32)[None, :]
+            ).astype(jnp.bfloat16)
+    return oh_l, oh_h
+
+
+def _accumulate(a: Array, b: Array, n: int, gh: int) -> Array:
+    """sum_r a[r, l] * b[r, h], f32-exact per block, f64 across blocks."""
+    blk = _blk(n)
+    nb = n // blk
+    part = jax.lax.dot_general(
+        b.reshape(nb, blk, gh), a.reshape(nb, blk, _GL),
+        (((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)     # (nb, gh, GL)
+    return jnp.sum(part.astype(jnp.float64), axis=0)  # (gh, GL)
+
+
+def grouped_sum(keys: Array, values: Array, valid: Array, rng: int) -> Array:
+    """Per-key sums over keys in [0, rng). Returns values.dtype (rng,).
+
+    f64: exact to 48 bits of the batch max magnitude. int64: exact while
+    the true sums stay within 2^53 (the f64 recombination's exact range)."""
+    n = keys.shape[0]
+    gh = (rng + _GL - 1) // _GL
+    is_float = jnp.issubdtype(values.dtype, jnp.floating)
+
+    v = jnp.where(valid, values, 0)
+    oh_l, oh_h = _onehots(keys, valid, gh)
+    acc = jnp.zeros((gh, _GL), jnp.float64)
+
+    if is_float:
+        v = v.astype(jnp.float64)
+        absv = jnp.abs(v)
+        maxv = jnp.max(absv)
+        exp = jnp.floor(jnp.log2(jnp.maximum(maxv, 1e-300))) + 1.0
+        # clamp so exp2(s) stays finite when the batch max is 0/denormal
+        s = jnp.minimum((CHUNK_BITS * F64_CHUNKS) - exp, 1000.0)
+        scaled = jnp.round(absv * jnp.exp2(s))  # < 2^48: f64-exact digits
+        sign = jnp.where(v < 0, -1.0, 1.0).astype(jnp.bfloat16)
+        rem = scaled
+        for c in range(F64_CHUNKS - 1, -1, -1):
+            base = float(2 ** (CHUNK_BITS * c))
+            digit = jnp.floor(rem / base)
+            rem = rem - digit * base
+            a = oh_l * (digit.astype(jnp.bfloat16) * sign)[:, None]
+            acc = acc + _accumulate(a, oh_h, n, gh) * base
+        return acc.reshape(gh * _GL)[:rng] * jnp.exp2(-s)
+
+    # integral: bit-slice digits in int64 (f64 would lose beyond 2^53)
+    v = v.astype(jnp.int64)
+    absv = jnp.abs(v)
+    sign = jnp.where(v < 0, -1.0, 1.0).astype(jnp.bfloat16)
+    for c in range(I64_CHUNKS):
+        digit = ((absv >> (CHUNK_BITS * c)) & 0xFF).astype(jnp.bfloat16)
+        a = oh_l * (digit * sign)[:, None]
+        acc = acc + _accumulate(a, oh_h, n, gh) * float(
+            2 ** (CHUNK_BITS * c))
+    out = acc.reshape(gh * _GL)[:rng]
+    return jnp.round(out).astype(jnp.int64)
+
+
+def grouped_count(keys: Array, valid: Array, rng: int) -> Array:
+    """Per-key counts of valid rows (exact). int64 (rng,)."""
+    n = keys.shape[0]
+    gh = (rng + _GL - 1) // _GL
+    oh_l, oh_h = _onehots(keys, valid, gh)
+    acc = _accumulate(oh_l, oh_h, n, gh)
+    return jnp.round(acc.reshape(gh * _GL)[:rng]).astype(jnp.int64)
+
+
+def grouped_multi(keys: Array, valid: Array, specs, rng: int):
+    """Compute several grouped aggregates in ONE matmul.
+
+    Each spec is ("sum", values, value_valid) or ("count", count_valid).
+    All digit planes of every spec stack along the matmul's N dimension, so
+    the hi-side one-hot streams through the MXU once per batch instead of
+    once per plane — the dominant memory traffic at large n.
+
+    Returns a list aligned with specs: f64/int64 (rng,) arrays.
+    """
+    n = keys.shape[0]
+    gh = (rng + _GL - 1) // _GL
+    oh_l, oh_h = _onehots(keys, valid, gh)
+
+    planes = []      # (n,) bf16 per plane
+    layout = []      # per spec: ("sumf", start, scale_s) | ("sumi", start)
+                     #         | ("count", start)
+    for spec in specs:
+        if spec[0] == "count":
+            _, cvalid = spec
+            planes.append(jnp.where(valid & cvalid, 1.0, 0.0
+                                    ).astype(jnp.bfloat16))
+            layout.append(("count", len(planes) - 1, None))
+            continue
+        _, values, vvalid = spec
+        ok = valid & vvalid
+        v = jnp.where(ok, values, 0)
+        if jnp.issubdtype(values.dtype, jnp.floating):
+            v = v.astype(jnp.float64)
+            absv = jnp.abs(v)
+            maxv = jnp.max(absv)
+            exp = jnp.floor(jnp.log2(jnp.maximum(maxv, 1e-300))) + 1.0
+            # clamp so exp2(s) stays finite when the batch max is 0
+            s = jnp.minimum((CHUNK_BITS * F64_CHUNKS) - exp, 1000.0)
+            scaled = jnp.round(absv * jnp.exp2(s)).astype(jnp.int64)
+            sign = jnp.where(v < 0, -1.0, 1.0).astype(jnp.bfloat16)
+            start = len(planes)
+            for c in range(F64_CHUNKS):
+                digit = ((scaled >> (CHUNK_BITS * c)) & 0xFF
+                         ).astype(jnp.bfloat16)
+                planes.append(digit * sign)
+            layout.append(("sumf", start, s))
+        else:
+            v = v.astype(jnp.int64)
+            absv = jnp.abs(v)
+            sign = jnp.where(v < 0, -1.0, 1.0).astype(jnp.bfloat16)
+            start = len(planes)
+            for c in range(I64_CHUNKS):
+                digit = ((absv >> (CHUNK_BITS * c)) & 0xFF
+                         ).astype(jnp.bfloat16)
+                planes.append(digit * sign)
+            layout.append(("sumi", start, None))
+
+    P = len(planes)
+    D = jnp.stack(planes, axis=1)                       # (n, P)
+    A = (oh_l[:, None, :] * D[:, :, None]).reshape(n, P * _GL)
+    blk = _blk(n)
+    nb = n // blk
+    part = jax.lax.dot_general(
+        oh_h.reshape(nb, blk, gh), A.reshape(nb, blk, P * _GL),
+        (((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)             # (nb, gh, P*GL)
+    acc = jnp.sum(part.astype(jnp.float64), axis=0
+                  ).reshape(gh, P, _GL)                 # (gh, P, GL)
+
+    outs = []
+    for kind, start, s in layout:
+        if kind == "count":
+            plane = acc[:, start, :].reshape(gh * _GL)[:rng]
+            outs.append(jnp.round(plane).astype(jnp.int64))
+            continue
+        nch = F64_CHUNKS if kind == "sumf" else I64_CHUNKS
+        total = jnp.zeros((gh, _GL), jnp.float64)
+        for c in range(nch):
+            total = total + acc[:, start + c, :] * float(
+                2 ** (CHUNK_BITS * c))
+        flat = total.reshape(gh * _GL)[:rng]
+        if kind == "sumf":
+            outs.append(flat * jnp.exp2(-s))
+        else:
+            outs.append(jnp.round(flat).astype(jnp.int64))
+    return outs

@@ -69,6 +69,13 @@ def collect(root: Operator, ctx: Optional[ExecContext] = None) -> ColumnBatch:
     """Materialize all output into one batch (test/driver helper)."""
     from blaze_tpu.ops.common import concat_batches
 
+    ctx = ctx or ExecContext()
+    from blaze_tpu.runtime.stage_compiler import try_run_stage
+
+    staged = try_run_stage(root, ctx)
+    if staged is not None:
+        return staged
+
     batches = list(execute_plan(root, ctx))
     if not batches:
         return ColumnBatch.empty(root.schema)

@@ -162,13 +162,48 @@ def _iter_attr_exprs(obj) -> Iterator[ir.Expr]:
             yield from _iter_attr_exprs(v)
 
 
+def _expr_dtypes(e: ir.Expr):
+    for attr in ("dtype", "result_type", "return_type"):
+        dt = getattr(e, attr, None)
+        if dt is not None and hasattr(dt, "kind"):
+            yield dt
+
+
+def _any_wide_decimal(plan: SparkPlan) -> bool:
+    """p>18 anywhere visible at this node: its schema, its CHILDREN's
+    schemas (input columns), or any expression-carried dtype."""
+    for sch in [plan.schema] + [c.schema for c in plan.children]:
+        if any(f.dtype.wide_decimal for f in sch.fields):
+            return True
+    for root in _iter_attr_exprs(plan.attrs):
+        stack = [root]
+        while stack:
+            e = stack.pop()
+            if any(dt.wide_decimal for dt in _expr_dtypes(e)):
+                return True
+            if isinstance(e, (ir.MakeDecimal, ir.CheckOverflow)) \
+                    and e.precision > 18:
+                return True
+            stack.extend(e.children())
+    return False
+
+
 def _exprs_convertible(plan: SparkPlan) -> bool:
     """Walk every expression in the node's attrs and reject unknown scalar
     functions at tag time — the reference walks expressions during
     conversion (NativeConverters.convertExpr:290-372); serializing an
-    unknown fn by name would only explode at execution."""
+    unknown fn by name would only explode at execution.
+
+    Also rejects wide decimals (precision > 18) anywhere visible at the
+    node — output schema, input (child) schemas, or expression dtypes: the
+    engine's decimal columns are int64-unscaled, so a p>18 plan would
+    silently truncate instead of computing 128-bit (the reference is
+    Decimal128 throughout blaze-serde/cast.rs). Such nodes stay on the
+    fallback path."""
     from blaze_tpu.exprs.functions import is_supported
 
+    if _any_wide_decimal(plan):
+        return False
     for root in _iter_attr_exprs(plan.attrs):
         stack = [root]
         while stack:

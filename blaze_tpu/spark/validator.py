@@ -1,0 +1,423 @@
+"""Query-level correctness gate: BASELINE configs as query shapes, each run
+through the FULL driver path (tagging -> conversion -> stage splitting ->
+multi-stage execution) against a pandas oracle, across BOTH join configs.
+
+Ref: the reference's north-star gate is the TPC-DS validator matrix —
+every query x {BHJ, forced-SMJ (autoBroadcastJoinThreshold=-1)} x spark
+version, executed with the plugin and diffed against vanilla answers
+(dev/run-tpcds-test:52-57, .github/workflows/tpcds.yml:92-147). This module
+is that gate for this engine: TPC-DS-shaped queries over generated
+store_sales/date_dim/item parquet, one command (`python validate.py`),
+per-query diffs on failure.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+import traceback
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from blaze_tpu.columnar import types as T
+from blaze_tpu.exprs import ir
+from blaze_tpu.exprs.ir import BinOp, col, lit
+from blaze_tpu.spark import plan_model as P
+from blaze_tpu.spark.local_runner import run_plan
+
+# ---------------------------------------------------------------------------
+# TPC-DS-shaped data
+# ---------------------------------------------------------------------------
+
+SS_SCHEMA = T.Schema([
+    T.Field("ss_sold_date_sk", T.INT64),
+    T.Field("ss_item_sk", T.INT64),
+    T.Field("ss_customer_sk", T.INT64),
+    T.Field("ss_store_sk", T.INT64),
+    T.Field("ss_quantity", T.INT32),
+    T.Field("ss_sales_price", T.FLOAT64),
+    T.Field("ss_ext_sales_price", T.FLOAT64),
+])
+DD_SCHEMA = T.Schema([
+    T.Field("d_date_sk", T.INT64),
+    T.Field("d_year", T.INT32),
+    T.Field("d_moy", T.INT32),
+])
+ITEM_SCHEMA = T.Schema([
+    T.Field("i_item_sk", T.INT64),
+    T.Field("i_category_id", T.INT32),
+    T.Field("i_current_price", T.FLOAT64),
+])
+
+
+def generate_tables(tmpdir: str, rows: int = 20_000, seed: int = 7):
+    """Write store_sales/date_dim/item parquet; returns (paths, frames)."""
+    rng = np.random.default_rng(seed)
+    n_dd, n_item = 730, 400
+    ss = pd.DataFrame({
+        "ss_sold_date_sk": rng.integers(0, n_dd, rows),
+        "ss_item_sk": rng.integers(1, n_item + 1, rows),
+        "ss_customer_sk": rng.integers(1, 500, rows),
+        "ss_store_sk": rng.integers(1, 8, rows),
+        "ss_quantity": rng.integers(1, 100, rows).astype(np.int32),
+        "ss_sales_price": np.round(rng.random(rows) * 200, 2),
+        "ss_ext_sales_price": np.round(rng.random(rows) * 1000, 2),
+    })
+    dd = pd.DataFrame({
+        "d_date_sk": np.arange(n_dd),
+        "d_year": (1998 + np.arange(n_dd) // 365).astype(np.int32),
+        "d_moy": ((np.arange(n_dd) // 30) % 12 + 1).astype(np.int32),
+    })
+    item = pd.DataFrame({
+        "i_item_sk": np.arange(1, n_item + 1),
+        "i_category_id": rng.integers(1, 11, n_item).astype(np.int32),
+        "i_current_price": np.round(rng.random(n_item) * 90 + 10, 2),
+    })
+    paths = {}
+    for name, df in (("store_sales", ss), ("date_dim", dd), ("item", item)):
+        path = f"{tmpdir}/{name}.parquet"
+        pq.write_table(pa.Table.from_pandas(df), path, row_group_size=4096)
+        paths[name] = path
+    return paths, {"store_sales": ss, "date_dim": dd, "item": item}
+
+
+# ---------------------------------------------------------------------------
+# query catalogue (BASELINE configs 1-5 shapes)
+# ---------------------------------------------------------------------------
+
+
+def _join(left, right, lkeys, rkeys, how, schema, mode, build="right"):
+    """BHJ or forced-SMJ — the matrix axis (ref: tpcds.yml runs every query
+    with and without autoBroadcastJoinThreshold=-1)."""
+    if mode == "bhj":
+        return P.bhj(left, P.broadcast_exchange(right), lkeys, rkeys, how,
+                     build, schema)
+    lx = P.shuffle_exchange(left, lkeys, 4)
+    rx = P.shuffle_exchange(right, rkeys, 4)
+    return P.smj(lx, rx, lkeys, rkeys, how, schema)
+
+
+def q1_scan_filter_project(paths, frames, mode):
+    """BASELINE config 1: scan + filter + project."""
+    sc = P.scan(SS_SCHEMA, [(paths["store_sales"], [])])
+    flt = P.filter_(sc, ir.Binary(
+        BinOp.AND,
+        ir.Binary(BinOp.LE, col("ss_quantity"), lit(50)),
+        ir.Binary(BinOp.GT, col("ss_sales_price"), lit(10.0))))
+    proj = P.project(
+        flt,
+        [col("ss_item_sk"),
+         ir.Binary(BinOp.MUL, ir.Cast(col("ss_quantity"), T.FLOAT64),
+                   col("ss_sales_price"))],
+        ["item", "amount"],
+        T.Schema([T.Field("item", T.INT64), T.Field("amount", T.FLOAT64)]))
+    srt = P.sort(proj, [(col("item"), True, True),
+                        (col("amount"), True, True)])
+
+    def oracle():
+        ss = frames["store_sales"]
+        f = ss[(ss.ss_quantity <= 50) & (ss.ss_sales_price > 10.0)]
+        out = pd.DataFrame({
+            "item": f.ss_item_sk,
+            "amount": f.ss_quantity.astype(np.float64) * f.ss_sales_price})
+        return out.sort_values(["item", "amount"]).reset_index(drop=True)
+
+    return srt, oracle
+
+
+def q2_q06_core_agg(paths, frames, mode):
+    """BASELINE config 2: scan + two-phase grouped agg (q06 core)."""
+    sc = P.scan(SS_SCHEMA, [(paths["store_sales"], [])])
+    flt = P.filter_(sc, ir.Binary(BinOp.GT, col("ss_ext_sales_price"),
+                                  lit(100.0)))
+    aggs = [{"fn": "sum", "args": [col("ss_ext_sales_price")],
+             "dtype": T.FLOAT64, "name": "total"},
+            {"fn": "count", "args": [col("ss_ext_sales_price")],
+             "dtype": T.INT64, "name": "cnt"},
+            {"fn": "avg", "args": [col("ss_sales_price")],
+             "dtype": T.FLOAT64, "name": "avg_price"}]
+    partial = P.hash_agg(flt, "partial", [col("ss_item_sk")], ["item"],
+                         aggs, T.Schema([T.Field("item", T.INT64)]))
+    x = P.shuffle_exchange(partial, [col("item")], 4)
+    final = P.hash_agg(
+        x, "final", [col("ss_item_sk")], ["item"], aggs,
+        T.Schema([T.Field("item", T.INT64), T.Field("total", T.FLOAT64),
+                  T.Field("cnt", T.INT64), T.Field("avg_price", T.FLOAT64)]))
+    srt = P.sort(final, [(col("item"), True, True)])
+
+    def oracle():
+        ss = frames["store_sales"]
+        f = ss[ss.ss_ext_sales_price > 100.0]
+        g = f.groupby("ss_item_sk").agg(
+            total=("ss_ext_sales_price", "sum"),
+            cnt=("ss_ext_sales_price", "count"),
+            avg_price=("ss_sales_price", "mean")).reset_index()
+        g = g.rename(columns={"ss_item_sk": "item"})
+        return g.sort_values("item").reset_index(drop=True)
+
+    return srt, oracle
+
+
+def q3_join_agg_sort(paths, frames, mode):
+    """BASELINE config 3: q03 — ss x date_dim, grouped sum, sort desc."""
+    ss = P.scan(SS_SCHEMA, [(paths["store_sales"], [])])
+    dd = P.scan(DD_SCHEMA, [(paths["date_dim"], [])])
+    ddf = P.filter_(dd, ir.Binary(BinOp.EQ, col("d_moy"), lit(11)))
+    jschema = T.Schema(list(SS_SCHEMA.fields) + list(DD_SCHEMA.fields))
+    j = _join(ss, ddf, [col("ss_sold_date_sk")], [col("d_date_sk")],
+              "inner", jschema, mode)
+    aggs = [{"fn": "sum", "args": [col("ss_ext_sales_price")],
+             "dtype": T.FLOAT64, "name": "sumsales"}]
+    partial = P.hash_agg(j, "partial",
+                         [col("ss_item_sk"), col("d_year")],
+                         ["item", "year"], aggs,
+                         T.Schema([T.Field("item", T.INT64),
+                                   T.Field("year", T.INT32)]))
+    x = P.shuffle_exchange(partial, [col("item")], 4)
+    final = P.hash_agg(
+        x, "final", [col("ss_item_sk"), col("d_year")], ["item", "year"],
+        aggs, T.Schema([T.Field("item", T.INT64), T.Field("year", T.INT32),
+                        T.Field("sumsales", T.FLOAT64)]))
+    srt = P.sort(final, [(col("sumsales"), False, True),
+                         (col("item"), True, True)])
+
+    def oracle():
+        ssd, ddd = frames["store_sales"], frames["date_dim"]
+        m = ssd.merge(ddd[ddd.d_moy == 11], left_on="ss_sold_date_sk",
+                      right_on="d_date_sk")
+        g = m.groupby(["ss_item_sk", "d_year"])[
+            "ss_ext_sales_price"].sum().reset_index()
+        g.columns = ["item", "year", "sumsales"]
+        return g.sort_values(["sumsales", "item"],
+                             ascending=[False, True]).reset_index(drop=True)
+
+    return srt, oracle
+
+
+def q4_repartition_sort(paths, frames, mode):
+    """BASELINE config 4: repartition across 8 + per-partition sort +
+    global order (q01 WITH-clause shape)."""
+    sc = P.scan(SS_SCHEMA, [(paths["store_sales"], [])])
+    proj = P.project(
+        sc, [col("ss_customer_sk"), col("ss_store_sk"),
+             col("ss_ext_sales_price")],
+        ["customer", "store", "price"],
+        T.Schema([T.Field("customer", T.INT64), T.Field("store", T.INT64),
+                  T.Field("price", T.FLOAT64)]))
+    x = P.shuffle_exchange(proj, [col("customer")], 8)
+    srt = P.sort(x, [(col("customer"), True, True),
+                     (col("store"), True, True),
+                     (col("price"), False, True)])
+
+    def oracle():
+        ss = frames["store_sales"]
+        out = pd.DataFrame({"customer": ss.ss_customer_sk,
+                            "store": ss.ss_store_sk,
+                            "price": ss.ss_ext_sales_price})
+        return out.sort_values(["customer", "store", "price"],
+                               ascending=[True, True, False]
+                               ).reset_index(drop=True)
+
+    return srt, oracle
+
+
+def q5_multijoin_limit(paths, frames, mode):
+    """BASELINE config 5 (lite): 3-table multi-stage — ss x dd x item,
+    grouped agg, sort, limit."""
+    ss = P.scan(SS_SCHEMA, [(paths["store_sales"], [])])
+    dd = P.scan(DD_SCHEMA, [(paths["date_dim"], [])])
+    it = P.scan(ITEM_SCHEMA, [(paths["item"], [])])
+    ddf = P.filter_(dd, ir.Binary(BinOp.EQ, col("d_year"), lit(1998)))
+    j1s = T.Schema(list(SS_SCHEMA.fields) + list(DD_SCHEMA.fields))
+    j1 = _join(ss, ddf, [col("ss_sold_date_sk")], [col("d_date_sk")],
+               "inner", j1s, mode)
+    j2s = T.Schema(list(j1s.fields) + list(ITEM_SCHEMA.fields))
+    j2 = _join(j1, it, [col("ss_item_sk")], [col("i_item_sk")],
+               "inner", j2s, mode)
+    aggs = [{"fn": "sum", "args": [col("ss_ext_sales_price")],
+             "dtype": T.FLOAT64, "name": "rev"},
+            {"fn": "count", "args": [col("ss_item_sk")],
+             "dtype": T.INT64, "name": "n"}]
+    partial = P.hash_agg(j2, "partial", [col("i_category_id")], ["cat"],
+                         aggs, T.Schema([T.Field("cat", T.INT32)]))
+    x = P.shuffle_exchange(partial, [col("cat")], 4)
+    final = P.hash_agg(
+        x, "final", [col("i_category_id")], ["cat"], aggs,
+        T.Schema([T.Field("cat", T.INT32), T.Field("rev", T.FLOAT64),
+                  T.Field("n", T.INT64)]))
+    srt = P.sort(final, [(col("rev"), False, True)])
+    lim = P.limit(srt, 5, True)
+
+    def oracle():
+        ssd, ddd, itd = (frames["store_sales"], frames["date_dim"],
+                         frames["item"])
+        m = ssd.merge(ddd[ddd.d_year == 1998], left_on="ss_sold_date_sk",
+                      right_on="d_date_sk")
+        m = m.merge(itd, left_on="ss_item_sk", right_on="i_item_sk")
+        g = m.groupby("i_category_id").agg(
+            rev=("ss_ext_sales_price", "sum"),
+            n=("ss_item_sk", "count")).reset_index()
+        g.columns = ["cat", "rev", "n"]
+        return g.sort_values("rev", ascending=False).head(5).reset_index(
+            drop=True)
+
+    return lim, oracle
+
+
+def q6_semi_join(paths, frames, mode):
+    """LEFT SEMI over a filtered dimension (EXISTS subquery shape)."""
+    ss = P.scan(SS_SCHEMA, [(paths["store_sales"], [])])
+    dd = P.scan(DD_SCHEMA, [(paths["date_dim"], [])])
+    ddf = P.filter_(dd, ir.Binary(BinOp.EQ, col("d_moy"), lit(12)))
+    j = _join(ss, ddf, [col("ss_sold_date_sk")], [col("d_date_sk")],
+              "left_semi", SS_SCHEMA, mode)
+    aggs = [{"fn": "count", "args": [col("ss_item_sk")],
+             "dtype": T.INT64, "name": "n"}]
+    partial = P.hash_agg(j, "partial", [col("ss_store_sk")], ["store"],
+                         aggs, T.Schema([T.Field("store", T.INT64)]))
+    x = P.shuffle_exchange(partial, [col("store")], 4)
+    final = P.hash_agg(x, "final", [col("ss_store_sk")], ["store"], aggs,
+                       T.Schema([T.Field("store", T.INT64),
+                                 T.Field("n", T.INT64)]))
+    srt = P.sort(final, [(col("store"), True, True)])
+
+    def oracle():
+        ssd, ddd = frames["store_sales"], frames["date_dim"]
+        keys = set(ddd[ddd.d_moy == 12].d_date_sk)
+        f = ssd[ssd.ss_sold_date_sk.isin(keys)]
+        g = f.groupby("ss_store_sk")["ss_item_sk"].count().reset_index()
+        g.columns = ["store", "n"]
+        return g.sort_values("store").reset_index(drop=True)
+
+    return srt, oracle
+
+
+def q7_left_outer_join(paths, frames, mode):
+    """LEFT OUTER item x sales counts (null-extension correctness)."""
+    it = P.scan(ITEM_SCHEMA, [(paths["item"], [])])
+    ss = P.scan(SS_SCHEMA, [(paths["store_sales"], [])])
+    ssf = P.filter_(ss, ir.Binary(BinOp.GT, col("ss_ext_sales_price"),
+                                  lit(950.0)))
+    jschema = T.Schema(list(ITEM_SCHEMA.fields) + list(SS_SCHEMA.fields))
+    j = _join(it, ssf, [col("i_item_sk")], [col("ss_item_sk")], "left",
+              jschema, mode)
+    aggs = [{"fn": "count", "args": [col("ss_item_sk")],
+             "dtype": T.INT64, "name": "n"}]
+    partial = P.hash_agg(j, "partial", [col("i_item_sk")], ["item"],
+                         aggs, T.Schema([T.Field("item", T.INT64)]))
+    x = P.shuffle_exchange(partial, [col("item")], 4)
+    final = P.hash_agg(x, "final", [col("i_item_sk")], ["item"], aggs,
+                       T.Schema([T.Field("item", T.INT64),
+                                 T.Field("n", T.INT64)]))
+    srt = P.sort(final, [(col("item"), True, True)])
+
+    def oracle():
+        itd, ssd = frames["item"], frames["store_sales"]
+        f = ssd[ssd.ss_ext_sales_price > 950.0]
+        m = itd.merge(f, left_on="i_item_sk", right_on="ss_item_sk",
+                      how="left")
+        g = m.groupby("i_item_sk")["ss_item_sk"].count().reset_index()
+        g.columns = ["item", "n"]
+        return g.sort_values("item").reset_index(drop=True)
+
+    return srt, oracle
+
+
+QUERIES: Dict[str, Callable] = {
+    "q1_scan_filter_project": q1_scan_filter_project,
+    "q2_q06_core_agg": q2_q06_core_agg,
+    "q3_join_agg_sort": q3_join_agg_sort,
+    "q4_repartition_sort": q4_repartition_sort,
+    "q5_multijoin_limit": q5_multijoin_limit,
+    "q6_semi_join": q6_semi_join,
+    "q7_left_outer_join": q7_left_outer_join,
+}
+
+# join-less queries run once (the axis changes nothing)
+_JOINLESS = {"q1_scan_filter_project", "q2_q06_core_agg",
+             "q4_repartition_sort"}
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Result:
+    query: str
+    mode: str
+    ok: bool
+    seconds: float
+    error: Optional[str] = None
+    diff: Optional[str] = None
+
+
+def _compare(got: pd.DataFrame, want: pd.DataFrame) -> Optional[str]:
+    if len(got) != len(want):
+        return f"row count {len(got)} != {len(want)}"
+    for c in want.columns:
+        if c not in got.columns:
+            return f"missing column {c}"
+        g = got[c].to_numpy()
+        w = want[c].to_numpy()
+        if w.dtype.kind == "f" or g.dtype.kind == "f":
+            bad = ~np.isclose(g.astype(np.float64), w.astype(np.float64),
+                              rtol=1e-6, equal_nan=True)
+        else:
+            bad = g.astype(np.int64) != w.astype(np.int64)
+        if bad.any():
+            i = int(np.argmax(bad))
+            return (f"column {c}: {int(bad.sum())} mismatches, first at row "
+                    f"{i}: got={g[i]} want={w[i]}")
+    return None
+
+
+def _to_pandas(batch) -> pd.DataFrame:
+    d = batch.to_numpy()
+    return pd.DataFrame({k: list(v) for k, v in d.items()})
+
+
+def run_matrix(tmpdir: str, rows: int = 20_000,
+               queries: Optional[List[str]] = None) -> List[Result]:
+    paths, frames = generate_tables(tmpdir, rows=rows)
+    results: List[Result] = []
+    for name, build in QUERIES.items():
+        if queries and name not in queries:
+            continue
+        modes = ["bhj"] if name in _JOINLESS else ["bhj", "smj"]
+        for mode in modes:
+            t0 = time.time()
+            try:
+                plan, oracle = build(paths, frames, mode)
+                out = run_plan(plan, num_partitions=4)
+                got = _to_pandas(out)
+                want = oracle()
+                # order-insensitive where the plan has no global sort tail
+                diff = _compare(got.reset_index(drop=True),
+                                want.reset_index(drop=True))
+                results.append(Result(name, mode, diff is None,
+                                      time.time() - t0, diff=diff))
+            except Exception:
+                results.append(Result(name, mode, False, time.time() - t0,
+                                      error=traceback.format_exc(limit=8)))
+    return results
+
+
+def print_report(results: List[Result]) -> bool:
+    ok = True
+    print(f"{'query':34s} {'join':5s} {'status':8s} {'sec':>6s}")
+    for r in results:
+        status = "PASS" if r.ok else "FAIL"
+        ok = ok and r.ok
+        print(f"{r.query:34s} {r.mode:5s} {status:8s} {r.seconds:6.1f}")
+        if r.diff:
+            print(f"    diff: {r.diff}")
+        if r.error:
+            print("    " + r.error.replace("\n", "\n    "))
+    n_pass = sum(1 for r in results if r.ok)
+    print(f"\n{n_pass}/{len(results)} passed")
+    return ok

@@ -182,7 +182,11 @@ def test_grouped_agg_empty_input():
 
 
 def test_streaming_collapse(rng):
-    # small collapse threshold forces the hierarchical fold path
+    # small collapse threshold forces the hierarchical fold path; pin the
+    # streaming executor (the stage compiler would take this whole plan in
+    # one dispatch and never collapse)
+    from blaze_tpu.config import conf
+
     batches = _batches(rng, [64] * 10)
     node = MemorySourceExec(batches, SCHEMA)
     calls = [AggCall("sum", (ir.col("v"),), T.FLOAT64, "s"),
@@ -190,7 +194,11 @@ def test_streaming_collapse(rng):
     p = AggExec(node, [ir.col("k")], ["k"], calls, AggMode.PARTIAL,
                 collapse_threshold=100)
     f = AggExec(p, [ir.col("k")], ["k"], calls, AggMode.FINAL)
-    d = collect(f).to_numpy()
+    conf.enable_stage_compiler = False
+    try:
+        d = collect(f).to_numpy()
+    finally:
+        conf.enable_stage_compiler = True
     df = _to_df(batches)
     want = df.groupby("k")["v"].sum()
     got = {int(k): float(s) for k, s in zip(d["k"], d["s"])}

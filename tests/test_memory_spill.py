@@ -13,8 +13,19 @@ from blaze_tpu.ops.basic import MemorySourceExec
 from blaze_tpu.ops.shuffle import Partitioning, ShuffleWriterExec, read_shuffle_partition
 from blaze_tpu.ops.sort import SortExec
 from blaze_tpu.ops.sort_keys import SortSpec
+from blaze_tpu.config import conf
 from blaze_tpu.runtime import memory as M
 from blaze_tpu.runtime.executor import collect, execute_plan
+
+
+@pytest.fixture(autouse=True)
+def _streaming_only():
+    """These tests exercise the streaming executor's spill machinery; the
+    whole-stage compiler would take eligible plans in one dispatch and
+    never touch the MemManager."""
+    conf.enable_stage_compiler = False
+    yield
+    conf.enable_stage_compiler = True
 
 SCHEMA = T.Schema([T.Field("k", T.INT64), T.Field("v", T.FLOAT64),
                    T.Field("s", T.STRING)])

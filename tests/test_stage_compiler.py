@@ -1,0 +1,178 @@
+"""Whole-stage single-dispatch execution (runtime/stage_compiler.py) and the
+MXU dense grouped aggregation (ops/mxu_agg.py).
+
+The stage compiler exists because remote-attached TPUs pay ~90ms per
+dispatch; correctness contract: identical results to the streaming executor,
+with range/null violations falling back to it transparently.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax.numpy as jnp
+
+from blaze_tpu.columnar import types as T
+from blaze_tpu.columnar.batch import ColumnBatch
+from blaze_tpu.config import conf
+from blaze_tpu.exprs import ir
+from blaze_tpu.exprs.ir import BinOp, col
+from blaze_tpu.ops import mxu_agg
+from blaze_tpu.ops.agg import AggCall, AggExec, AggMode
+from blaze_tpu.ops.basic import FilterExec, MemorySourceExec, ProjectExec
+from blaze_tpu.runtime.executor import collect
+
+SCHEMA = T.Schema([T.Field("k", T.INT64), T.Field("v", T.FLOAT64),
+                   T.Field("n", T.INT32)])
+
+CALLS = [AggCall("sum", (col("v"),), T.FLOAT64, "sv"),
+         AggCall("sum", (col("n"),), T.INT64, "sn"),
+         AggCall("count", (col("v"),), T.INT64, "cnt"),
+         AggCall("avg", (col("v"),), T.FLOAT64, "av")]
+
+
+def _batches(rng, nb, n, kmin=0, kmax=300, null_frac=0.0):
+    out = []
+    for _ in range(nb):
+        data = {"k": rng.integers(kmin, kmax, n).astype(np.int64),
+                "v": rng.random(n) * 10 - 3,
+                "n": rng.integers(-50, 50, n).astype(np.int32)}
+        validity = None
+        if null_frac:
+            validity = {"v": rng.random(n) > null_frac}
+        out.append(ColumnBatch.from_numpy(data, SCHEMA, validity=validity,
+                                          capacity=max(n, 1024)))
+    return out
+
+
+def _plan(batches, with_filter=True):
+    node = MemorySourceExec(batches, SCHEMA)
+    if with_filter:
+        node = FilterExec(node, [ir.Binary(BinOp.GE, col("v"),
+                                           ir.Literal(T.FLOAT64, -1.0))])
+    for mode in (AggMode.PARTIAL, AggMode.FINAL):
+        node = AggExec(node, [col("k")], ["k"], CALLS, mode)
+    return node
+
+
+def _oracle(batches, with_filter=True):
+    frames = []
+    for b in batches:
+        d = b.to_numpy()
+        frames.append(pd.DataFrame({"k": np.asarray(d["k"]),
+                                    "v": [x for x in d["v"]],
+                                    "n": [x for x in d["n"]]}))
+    df = pd.concat(frames, ignore_index=True)
+    if with_filter:
+        df = df[df["v"] >= -1.0]
+    return df
+
+
+def _check(out, batches, with_filter=True):
+    d = out.to_numpy()
+    df = _oracle(batches, with_filter)
+    want = df.groupby("k").agg(
+        sv=("v", lambda x: x.dropna().sum()),
+        sn=("n", "sum"),
+        cnt=("v", lambda x: x.notna().sum()),
+        av=("v", lambda x: x.dropna().mean()))
+    got_k = list(np.asarray(d["k"]))
+    assert got_k == sorted(want.index), "groups"
+    for i, k in enumerate(got_k):
+        np.testing.assert_allclose(float(d["sv"][i]), want.loc[k, "sv"],
+                                   rtol=1e-9)
+        assert int(d["sn"][i]) == int(want.loc[k, "sn"])
+        assert int(np.asarray(d["cnt"])[i]) == int(want.loc[k, "cnt"])
+        np.testing.assert_allclose(float(d["av"][i]), want.loc[k, "av"],
+                                   rtol=1e-9)
+
+
+def test_stage_matches_pandas(rng):
+    batches = _batches(rng, 4, 700)
+    plan = _plan(batches)
+    out = collect(plan)
+    assert plan.metrics["stage_compiled"] == 1
+    _check(out, batches)
+
+
+def test_stage_matches_streaming(rng):
+    batches = _batches(rng, 3, 500, null_frac=0.3)
+    got = collect(_plan(batches)).to_numpy()
+    conf.enable_stage_compiler = False
+    try:
+        want = collect(_plan(batches)).to_numpy()
+    finally:
+        conf.enable_stage_compiler = True
+    assert list(np.asarray(got["k"])) == list(np.asarray(want["k"]))
+    np.testing.assert_allclose(
+        [float(x) for x in got["sv"]], [float(x) for x in want["sv"]],
+        rtol=1e-9)
+    assert list(np.asarray(got["cnt"])) == list(np.asarray(want["cnt"]))
+
+
+def test_negative_and_offset_keys(rng):
+    """Key range is offset by the observed minimum, so negative/huge-base
+    keys still take the dense path."""
+    batches = _batches(rng, 2, 400, kmin=-150, kmax=80)
+    plan = _plan(batches, with_filter=False)
+    out = collect(plan)
+    assert plan.metrics["stage_compiled"] == 1
+    _check(out, batches, with_filter=False)
+    batches = _batches(rng, 2, 400, kmin=10 ** 12, kmax=10 ** 12 + 500)
+    plan = _plan(batches, with_filter=False)
+    out = collect(plan)
+    assert plan.metrics["stage_compiled"] == 1
+    _check(out, batches, with_filter=False)
+
+
+def test_wide_range_falls_back(rng):
+    """Keys spanning more than dense_agg_range: in-program flag trips and
+    the result comes from the streaming path — identical values."""
+    batches = _batches(rng, 2, 300, kmin=0, kmax=10 ** 9)
+    plan = _plan(batches, with_filter=False)
+    out = collect(plan)
+    assert plan.metrics["stage_compiled"] == 0
+    _check(out, batches, with_filter=False)
+
+
+def test_null_group_keys_fall_back(rng):
+    """Null grouping keys form their own group (Spark): dense path cannot
+    represent them, so the stage falls back and the result still carries
+    the null group."""
+    n = 200
+    data = {"k": rng.integers(0, 5, n).astype(np.int64),
+            "v": rng.random(n), "n": np.zeros(n, np.int32)}
+    knull = rng.random(n) > 0.8
+    b = ColumnBatch.from_numpy(data, SCHEMA, validity={"k": ~knull})
+    plan = _plan([b], with_filter=False)
+    out = collect(plan)
+    d = out.to_numpy()
+    ks = list(d["k"])
+    assert None in ks  # the null group survived via fallback
+    nn = ks.index(None)
+    df = pd.DataFrame({"k": np.where(knull, np.nan, data["k"]),
+                       "v": data["v"]})
+    np.testing.assert_allclose(
+        float(d["sv"][nn]), df[df["k"].isna()]["v"].sum(), rtol=1e-9)
+
+
+def test_mxu_grouped_sum_kernels(rng):
+    n = 1 << 12
+    R = 1 << 10
+    keys = jnp.asarray(rng.integers(0, R, n).astype(np.int32))
+    valid = jnp.asarray(rng.random(n) > 0.2)
+    fvals = jnp.asarray(rng.random(n) * 1e6 - 4e5)
+    ivals = jnp.asarray(rng.integers(-10 ** 12, 10 ** 12, n))
+    got = np.asarray(mxu_agg.grouped_sum(keys, fvals, valid, R))
+    want = np.zeros(R)
+    np.add.at(want, np.asarray(keys)[np.asarray(valid)],
+              np.asarray(fvals)[np.asarray(valid)])
+    np.testing.assert_allclose(got, want, rtol=1e-12, atol=1e-6)
+    got = np.asarray(mxu_agg.grouped_sum(keys, ivals, valid, R))
+    want = np.zeros(R, np.int64)
+    np.add.at(want, np.asarray(keys)[np.asarray(valid)],
+              np.asarray(ivals)[np.asarray(valid)])
+    np.testing.assert_array_equal(got, want)
+    got = np.asarray(mxu_agg.grouped_count(keys, valid, R))
+    want = np.bincount(np.asarray(keys)[np.asarray(valid)], minlength=R)
+    np.testing.assert_array_equal(got, want)
