@@ -83,8 +83,9 @@ def run_plan(root: SparkPlan, num_partitions: int = 4,
             elif stage.kind == "broadcast":
                 _run_broadcast_stage(stage)
             else:
-                out = _run_result_stage(stage, num_partitions)
-                return _merge_fallback_root_sort(root, out, num_partitions)
+                parts = _input_tasks(stage, stages, fallback=num_partitions)
+                out = _run_result_stage(stage, parts)
+                return _merge_fallback_root_sort(root, out, parts)
         raise AssertionError("no result stage produced")
     finally:
         # release per-query registry entries: FFI export subtrees and the
@@ -120,13 +121,15 @@ def _merge_fallback_root_sort(root: SparkPlan, out: ColumnBatch,
                             schema=root.schema)
 
 
-def _input_tasks(stage: Stage, stages: List[Stage]) -> int:
-    """Map task count = upstream shuffle partition count (1 for scans)."""
+def _input_tasks(stage: Stage, stages: List[Stage],
+                 fallback: int = 1) -> int:
+    """Task count for a stage = its upstream shuffle partition count;
+    `fallback` when it has dependencies but none are shuffles (scans -> 1)."""
     if not stage.depends_on:
         return 1
-    return max(stages[d].num_partitions for d in stage.depends_on
-               if stages[d].kind == "shuffle_map") if any(
-        stages[d].kind == "shuffle_map" for d in stage.depends_on) else 1
+    upstream = [stages[d].num_partitions for d in stage.depends_on
+                if stages[d].kind == "shuffle_map"]
+    return max(upstream) if upstream else fallback
 
 
 def _schema_of_reader(node: pb.PlanNode):
@@ -182,9 +185,11 @@ def _run_broadcast_stage(stage: Stage) -> None:
                   lambda partition=0: iter(list(frames)))
 
 
-def _run_result_stage(stage: Stage, num_partitions: int) -> ColumnBatch:
+def _run_result_stage(stage: Stage, parts: int) -> ColumnBatch:
+    """`parts` is the upstream exchange's partition count (_input_tasks) —
+    NOT the global default: an 8-way repartition read with 4 tasks would
+    silently drop half the shuffle partitions."""
     op = decode_plan(stage.plan)
-    parts = num_partitions if stage.depends_on else 1
     batches: List[ColumnBatch] = []
     for p in range(parts):
         op_p = decode_plan(stage.plan)  # fresh operator state per task
@@ -195,12 +200,31 @@ def _run_result_stage(stage: Stage, num_partitions: int) -> ColumnBatch:
     out = concat_batches(batches, op.schema)
     # Ordered collect: a root SortExec sorts each partition; merging the
     # sorted partitions gives the total order the query asked for (the
-    # analog of Spark's range-partitioned global sort collect).
+    # analog of Spark's range-partitioned global sort collect). A global
+    # limit above the sort re-applies after the merge (TakeOrdered shape).
+    from blaze_tpu.ops.basic import GlobalLimitExec
     from blaze_tpu.ops.sort import SortExec, truncate
     from blaze_tpu.ops.sort_keys import sort_batch
 
-    if isinstance(op, SortExec) and parts > 1:
-        out = sort_batch(out, op.specs)
-        if op.fetch:
-            out = truncate(out, op.fetch)
+    if parts > 1:
+        if isinstance(op, SortExec):
+            out = sort_batch(out, op.specs)
+            if op.fetch:
+                out = truncate(out, op.fetch)
+        elif isinstance(op, GlobalLimitExec):
+            # find the ordering below the limit, looking through
+            # schema-preserving ops. A Project in between is Spark's
+            # TakeOrderedAndProject shape, which the planner lowers to
+            # TakeOrderedExec (a SortExec) — a plain GlobalLimit above a
+            # Project is therefore an UNORDERED limit: any n rows satisfy
+            # it and no merge sort is owed.
+            from blaze_tpu.ops.basic import LocalLimitExec
+
+            child = op.children[0]
+            while (isinstance(child, LocalLimitExec)
+                   and not isinstance(child, GlobalLimitExec)):
+                child = child.children[0]
+            if isinstance(child, SortExec):
+                out = sort_batch(out, child.specs)
+            out = truncate(out, op.limit)
     return out

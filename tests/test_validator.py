@@ -1,0 +1,18 @@
+"""The query-level validator matrix as a test (the reference's TPC-DS CI
+gate analog, .github/workflows/tpcds.yml:92-147). `python validate.py`
+runs the same matrix standalone with bigger data."""
+
+import pytest
+
+from blaze_tpu.spark.validator import QUERIES, _JOINLESS, run_matrix
+
+
+def test_validator_matrix(tmp_path):
+    results = run_matrix(str(tmp_path), rows=4000)
+    expected_cells = sum(1 if q in _JOINLESS else 2 for q in QUERIES)
+    assert len(results) == expected_cells
+    failures = [r for r in results if not r.ok]
+    msg = "\n".join(
+        f"{r.query}[{r.mode}]: {r.diff or ''} {r.error or ''}"
+        for r in failures)
+    assert not failures, msg

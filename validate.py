@@ -1,0 +1,36 @@
+#!/usr/bin/env python
+"""Query-level correctness gate (the reference's TPC-DS validator analog).
+
+Runs the BASELINE config query shapes through the full driver path
+(tagging -> conversion -> stage splitting -> multi-stage execution) against
+pandas goldens, across both join configs (BHJ and forced SMJ — the
+reference's autoBroadcastJoinThreshold=-1 axis, tpcds.yml:131-147).
+
+    python validate.py [--rows N] [--queries q3_join_agg_sort,...]
+
+Exit code 0 iff every (query, join-mode) cell passes.
+"""
+
+import argparse
+import sys
+import tempfile
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=20_000,
+                    help="store_sales row count")
+    ap.add_argument("--queries", type=str, default="",
+                    help="comma-separated subset of query names")
+    args = ap.parse_args()
+
+    from blaze_tpu.spark.validator import print_report, run_matrix
+
+    queries = [q for q in args.queries.split(",") if q] or None
+    with tempfile.TemporaryDirectory(prefix="blaze_tpu_validate_") as tmp:
+        results = run_matrix(tmp, rows=args.rows, queries=queries)
+    return 0 if print_report(results) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
