@@ -78,9 +78,28 @@ class Partitioning:
                 tuple(e.key() for e in self.key_exprs))
 
 
+def round_robin_start(task_partition: int, num_partitions: int) -> int:
+    """Per-task starting position, restart-stable (Spark seeds a Random
+    with the task's partitionId so retries land rows identically;
+    we derive it from spark-murmur3 of the partition id — deterministic
+    and well-spread, though not bit-identical to java.util.Random)."""
+    import numpy as np
+
+    from blaze_tpu.exprs.hash import hash_int32
+
+    h = int(np.asarray(hash_int32(jnp.asarray([task_partition], jnp.int32),
+                                  jnp.uint32(SPARK_SHUFFLE_SEED))[0]))
+    return h % num_partitions
+
+
 def partition_and_sort(batch: ColumnBatch, part: Partitioning,
-                       key_fns) -> tuple:
-    """(sorted batch grouped by partition id, per-partition counts)."""
+                       key_fns, row_offset=0, rr_start: int = 0) -> tuple:
+    """(sorted batch grouped by partition id, per-partition counts).
+
+    Round-robin rows get `(rr_start + row_offset + i) % P`: rr_start is
+    the task-seeded position and row_offset the running row count across
+    the task's batches, so a retried task assigns every row the same
+    partition (Spark's restart-stable round robin)."""
     P = part.num_partitions
     mask = batch.row_mask()
     if part.kind == "hash":
@@ -90,7 +109,9 @@ def partition_and_sort(batch: ColumnBatch, part: Partitioning,
     elif part.kind == "single":
         pid = jnp.zeros((batch.capacity,), jnp.int32)
     elif part.kind == "round_robin":
-        pid = jnp.arange(batch.capacity, dtype=jnp.int32) % P
+        base = jnp.asarray(row_offset, jnp.int64) + rr_start
+        pid = ((base + jnp.arange(batch.capacity, dtype=jnp.int64))
+               % P).astype(jnp.int32)
     else:
         raise ValueError(part.kind)
     pid = jnp.where(mask, pid, jnp.int32(P))  # padding last
@@ -136,7 +157,10 @@ class ShuffleWriterExec(Operator):
                                M.get_manager(ctx))
         keys_jit = not any(ir.contains_host_fn(e)
                            for e in self.partitioning.key_exprs)
-        key = ("shuffle_part", keys_jit, self.plan_key())
+        rr = round_robin_start(ctx.partition,
+                               self.partitioning.num_partitions)
+        key = ("shuffle_part", keys_jit, rr, self.plan_key())
+        row_offset = 0
         try:
             for batch in self.children[0].execute(ctx):
                 ctx.check_running()
@@ -145,10 +169,13 @@ class ShuffleWriterExec(Operator):
                 with self.metrics.timer():
                     fn = jit_cache.get_or_compile(
                         key + batch.shape_key(),
-                        lambda: (lambda b: partition_and_sort(
-                            b, self.partitioning, self._key_fns)),
+                        lambda: (lambda b, off: partition_and_sort(
+                            b, self.partitioning, self._key_fns,
+                            row_offset=off, rr_start=rr)),
                         jit=keys_jit)
-                    sb, counts = fn(batch)
+                    sb, counts = fn(batch, jnp.asarray(row_offset,
+                                                       jnp.int64))
+                    row_offset += int(batch.num_rows)
                     hb = serde.to_host(sb)
                     counts = np.asarray(counts)
                     offs = np.concatenate([[0], np.cumsum(counts)])

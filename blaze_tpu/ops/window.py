@@ -55,33 +55,6 @@ class WindowCall:
         return self.fn in ("row_number", "rank", "dense_rank")
 
 
-class _WindowBuffer:
-    name = "window"
-
-    def __init__(self, manager) -> None:
-        from blaze_tpu.runtime import memory as M
-
-        self.batches: List[ColumnBatch] = []
-        self.bytes = 0
-        self.manager = manager
-        self._M = M
-        manager.register(self)
-
-    def mem_used(self) -> int:
-        return self.bytes
-
-    def spill(self) -> int:
-        return 0  # windows cannot shed state yet; usage stays visible
-
-    def add(self, b: ColumnBatch) -> None:
-        self.batches.append(b)
-        self.bytes += self._M.batch_nbytes(b)
-        self.manager.update_mem_used(self)
-
-    def close(self) -> None:
-        self.manager.unregister(self)
-
-
 class WindowExec(Operator):
     def __init__(self, child: Operator, calls: Sequence[WindowCall],
                  partition_exprs: Sequence[ir.Expr],
@@ -119,64 +92,127 @@ class WindowExec(Operator):
                 tuple(s.key() for s in self.order_specs),
                 self.children[0].plan_key())
 
-    def execute(self, ctx: ExecContext) -> BatchStream:
-        def gen():
-            from blaze_tpu.runtime import memory as M
-
-            # Whole-input materialization (window semantics need complete
-            # partitions). Registered with the MemManager so the buffered
-            # bytes are visible to the budget; it cannot spill itself yet —
-            # partition-bounded streaming windows are a follow-up.
-            buf = _WindowBuffer(M.get_manager(ctx))
-            try:
-                for b in self.children[0].execute(ctx):
-                    ctx.check_running()
-                    if int(b.num_rows):
-                        buf.add(b)
-                if not buf.batches:
-                    return
-                big = concat_batches(buf.batches, self.children[0].schema)
-                jit = not any(
-                    ir.contains_host_fn(e) for e in list(self.partition_exprs) +
-                    [x for c in self.calls for x in c.inputs])
-                key = ("window_kernel", jit, self.plan_key(),
-                       big.shape_key())
-                with self.metrics.timer():
-                    out = jit_cache.get_or_compile(
-                        key, lambda: self._kernel, jit=jit)(big)
-                yield out
-            finally:
-                buf.close()
-
-        return count_stream(self, gen())
-
-    # ---- the fused kernel ----
-    def _kernel(self, b: ColumnBatch) -> ColumnBatch:
-        nin = len(b.columns)
-        # working batch: input cols + partition cols + agg input cols
-        cols = list(b.columns)
-        fields = list(b.schema.fields)
+    def _work_layout(self):
+        """(work schema, part col indices, per-call input col indices)."""
+        child_schema = self.children[0].schema
+        nin = len(child_schema.fields)
+        fields = list(child_schema.fields)
         part_idx = []
+        probe = ColumnBatch.empty(child_schema)
         for i, fn in enumerate(self._part_fns):
-            c = fn(b)
-            part_idx.append(len(cols))
-            cols.append(c)
-            fields.append(Field(f"#part{i}", c.dtype))
+            shp = jax.eval_shape(fn, probe)
+            part_idx.append(len(fields))
+            fields.append(Field(f"#part{i}", shp.dtype))
         in_idx: List[List[int]] = []
         for ci, fns in zip(self.calls, self._input_fns):
             row = []
             for j, fn in enumerate(fns):
-                c = fn(b)
-                row.append(len(cols))
-                cols.append(c)
-                fields.append(Field(f"#in{ci.name}{j}", c.dtype))
+                shp = jax.eval_shape(fn, probe)
+                row.append(len(fields))
+                fields.append(Field(f"#in{ci.name}{j}", shp.dtype))
             in_idx.append(row)
-        work = b.with_columns(Schema(fields), cols)
+        return Schema(fields), part_idx, in_idx, nin
 
-        # sort by (partition, order)
-        specs = [SortSpec(i) for i in part_idx] + [
-            SortSpec(s.col, s.asc, s.nulls_first) for s in self.order_specs]
-        sb = sort_batch(work, specs) if specs else work
+    def _make_work(self, b: ColumnBatch, work_schema: Schema) -> ColumnBatch:
+        cols = list(b.columns)
+        for fn in self._part_fns:
+            cols.append(fn(b))
+        for fns in self._input_fns:
+            for fn in fns:
+                cols.append(fn(b))
+        return b.with_columns(work_schema, cols)
+
+    def execute(self, ctx: ExecContext) -> BatchStream:
+        """Partition-bounded streaming (ref window_context.rs:24): input is
+        externally sorted by (partition, order) — spilling under the
+        MemManager budget like any sort — then completed partitions are
+        computed and emitted chunk by chunk; only the OPEN partition's rows
+        carry between chunks, so peak state is one sort pool + the largest
+        single partition."""
+        def gen():
+            from blaze_tpu.ops.common import slice_batch
+            from blaze_tpu.ops.sort import ExternalSorter
+            from blaze_tpu.runtime import memory as M
+
+            work_schema, part_idx, in_idx, nin = self._work_layout()
+            self._part_idx, self._in_idx, self._nin = part_idx, in_idx, nin
+            jit = not any(
+                ir.contains_host_fn(e) for e in list(self.partition_exprs) +
+                [x for c in self.calls for x in c.inputs])
+            specs = [SortSpec(i) for i in part_idx] + [
+                SortSpec(s.col, s.asc, s.nulls_first)
+                for s in self.order_specs]
+            sorter = ExternalSorter(work_schema, specs, M.get_manager(ctx),
+                                    name="window")
+            try:
+                for b in self.children[0].execute(ctx):
+                    ctx.check_running()
+                    if int(b.num_rows) == 0:
+                        continue
+                    wkey = ("window_work", jit, self.plan_key(),
+                            b.shape_key())
+                    work = jit_cache.get_or_compile(
+                        wkey, lambda: (
+                            lambda bb: self._make_work(bb, work_schema)),
+                        jit=jit)(b)
+                    sorter.add(work)
+
+                def compute(chunk: ColumnBatch):
+                    key = ("window_kernel", jit, self.plan_key(),
+                           chunk.shape_key())
+                    with self.metrics.timer():
+                        return jit_cache.get_or_compile(
+                            key, lambda: self._compute_sorted, jit=jit)(chunk)
+
+                if not part_idx:
+                    # global window: one partition spans everything —
+                    # collect the sorted chunks ONCE (re-concatenating a
+                    # growing carry per chunk would be O(n^2) in copies)
+                    chunks = [sb for sb in sorter.finish()
+                              if int(sb.num_rows) > 0]
+                    if chunks:
+                        yield compute(
+                            chunks[0] if len(chunks) == 1
+                            else concat_batches(chunks, work_schema))
+                    self.metrics.add("spill_count", len(sorter.runs))
+                    return
+                carry: Optional[ColumnBatch] = None
+                for sb in sorter.finish():
+                    ctx.check_running()
+                    chunk = (sb if carry is None
+                             else concat_batches([carry, sb], work_schema))
+                    n = int(chunk.num_rows)
+                    split = self._last_partition_start(chunk, part_idx)
+                    if split <= 0:
+                        carry = chunk
+                        continue
+                    done = slice_batch(chunk, 0, split)
+                    carry = slice_batch(chunk, split, n - split)
+                    yield compute(done)
+                if carry is not None and int(carry.num_rows) > 0:
+                    yield compute(carry)
+                self.metrics.add("spill_count", len(sorter.runs))
+            finally:
+                sorter.abort()
+
+        return count_stream(self, gen())
+
+    def _last_partition_start(self, chunk: ColumnBatch,
+                              part_idx: List[int]) -> int:
+        """Row index where the final (possibly incomplete) partition begins
+        — one host pull per merge chunk."""
+        import numpy as np
+
+        starts = seg.group_starts(chunk, part_idx)
+        iota = jnp.arange(chunk.capacity, dtype=jnp.int32)
+        last = jnp.max(jnp.where(starts, iota, -1))
+        return int(np.asarray(last))
+
+    # ---- the fused kernel (input already in sorted work layout) ----
+    def _compute_sorted(self, sb: ColumnBatch) -> ColumnBatch:
+        nin = self._nin
+        part_idx = self._part_idx
+        in_idx = self._in_idx
 
         mask = sb.row_mask()
         cap = sb.capacity
@@ -196,7 +232,6 @@ class WindowExec(Operator):
             jnp.clip(peer_layout.gid, 0, cap - 1)]
 
         out_cols = list(sb.columns[:nin])
-        out_fields = list(self._schema.fields)
         for ci, (call, idxs) in enumerate(zip(self.calls, in_idx)):
             if call.fn == "row_number":
                 v = (iota - part_start_pos + 1).astype(jnp.int32)

@@ -56,16 +56,30 @@ def mesh_key_indices(writer: pb.ShuffleWriterNode,
 
 
 def run_mesh_shuffle_stage(stage_plan: pb.PlanNode, stage_id: int,
-                           ntasks: int, quota: Optional[int] = None) -> bool:
+                           ntasks: int, quota: Optional[int] = None,
+                           work_dir: Optional[str] = None) -> bool:
     """Execute one shuffle_map stage's exchange over the device mesh.
 
-    Runs the map subplan per task, redistributes the rows onto P devices,
-    jits the all_to_all exchange over a P-device mesh, and registers the
-    received per-partition batches as the `shuffle:<sid>` resource. Returns
-    False — with nothing registered — when the stage doesn't fit the mesh
-    or the staging quota overflowed; the caller then uses the file path.
+    STREAMS: each map-output batch is exchanged as it is produced — the
+    staging footprint is bounded by one batch's capacity x P, never the
+    whole stage (ref analog: the incremental sort-repartitioner,
+    sort_repartitioner.rs:199-213). A batch whose skew overflows the
+    per-partition staging quota is routed through the FILE path
+    immediately — the already-exchanged batches are kept and map subplans
+    never re-execute; the reduce-side provider serves mesh-received
+    batches and file segments transparently.
+
+    Returns False — with nothing registered, nothing executed — only when
+    the stage can't ride the mesh at all (shape/keys/partition count).
     """
+    import os
+    import tempfile
+
+    from blaze_tpu.ops.basic import MemorySourceExec
+    from blaze_tpu.ops.shuffle import ShuffleWriterExec, read_shuffle_partition
     from blaze_tpu.plan import decode_plan
+    from blaze_tpu.plan.from_proto import _partitioning
+    from blaze_tpu.runtime import jit_cache
 
     writer = stage_plan.shuffle_writer
     num_partitions = writer.partitioning.num_partitions
@@ -79,63 +93,86 @@ def run_mesh_shuffle_stage(stage_plan: pb.PlanNode, stage_id: int,
     if any(f.dtype.is_nested for f in input_op.schema.fields):
         return False  # variable element capacities can't stack on the mesh
 
-    # map side: run each task's subplan (host-driven, may spill) and pool
-    # the output rows
-    batches: List[ColumnBatch] = []
+    schema = input_op.schema
+    Pn = num_partitions
+    mesh = Mesh(np.array(devices[:Pn]), ("p",))
+    recv_parts: List[List[ColumnBatch]] = [[] for _ in range(Pn)]
+    file_outputs: List[tuple] = []
+
+    def exchange_batch(batch: ColumnBatch) -> bool:
+        """Exchange one batch over the mesh; False on quota overflow."""
+        n = int(batch.num_rows)
+        per = max(1, -(-n // Pn))
+        cap = bucket_capacity(per)
+        q = min(quota, cap) if quota else cap
+        slices = [
+            batch.take(jnp.arange(cap, dtype=jnp.int32) + i * per,
+                       min(max(n - i * per, 0), per))
+            for i in range(Pn)
+        ]
+        cols = jax.tree.map(lambda *xs: jnp.concatenate(xs, 0),
+                            *[b.columns for b in slices])
+        num_rows = jnp.array([int(b.num_rows) for b in slices], jnp.int32)
+
+        key = ("mesh_xchg", Pn, cap, q, tuple(key_idx),
+               slices[0].shape_key())
+
+        def make():
+            def step(local_cols, local_num_rows):
+                b = ColumnBatch(schema, local_cols, local_num_rows[0], cap)
+                out, overflow = mesh_shuffle_batch(b, key_idx, "p", Pn,
+                                                   quota=q)
+                return out.columns, out.num_rows[None], overflow[None]
+
+            return jax.shard_map(step, mesh=mesh,
+                                 in_specs=(P("p"), P("p")),
+                                 out_specs=(P("p"), P("p"), P("p")))
+
+        run = jit_cache.get_or_compile(key, make)
+        out_cols, out_rows, overflow = run(cols, num_rows)
+        if int(np.asarray(overflow)[0]) > 0:
+            return False
+        out_rows = np.asarray(out_rows)
+        recv_cap = Pn * q  # per-device received capacity
+        full = ColumnBatch(schema, out_cols, jnp.asarray(0, jnp.int32),
+                           Pn * recv_cap)
+        for p in range(Pn):
+            if int(out_rows[p]) == 0:
+                continue
+            idx = jnp.arange(recv_cap, dtype=jnp.int32) + p * recv_cap
+            recv_parts[p].append(full.take(idx, int(out_rows[p])))
+        return True
+
+    def spill_batch_to_file(batch: ColumnBatch) -> None:
+        nonlocal work_dir
+        if work_dir is None:
+            work_dir = tempfile.mkdtemp(prefix="blaze_tpu_mesh_ovf_")
+        i = len(file_outputs)
+        data = os.path.join(work_dir, f"stage{stage_id}_meshovf{i}.data")
+        index = os.path.join(work_dir, f"stage{stage_id}_meshovf{i}.index")
+        op = ShuffleWriterExec(MemorySourceExec([batch], schema),
+                               _partitioning(writer.partitioning),
+                               data, index)
+        list(execute_plan(op, ExecContext(partition=0, num_partitions=1)))
+        file_outputs.append((data, index))
+
+    # map side: stream every task's batches straight into the exchange
     for task in range(ntasks):
         op = decode_plan(writer.input)  # fresh operator state per task
-        batches.extend(execute_plan(
-            op, ExecContext(partition=task, num_partitions=ntasks)))
-    schema = input_op.schema
-    if not batches:
-        total = ColumnBatch.empty(schema)
-    else:
-        total = batches[0] if len(batches) == 1 else concat_batches(batches)
-
-    # redistribute rows into P equal-capacity device-local batches
-    Pn = num_partitions
-    n = int(total.num_rows)
-    per = max(1, -(-n // Pn))
-    cap = bucket_capacity(per)
-    dev_batches = [
-        total.take(jnp.arange(cap, dtype=jnp.int32) + i * per,
-                   min(max(n - i * per, 0), per))
-        for i in range(Pn)
-    ]
-    quota = quota or cap
-
-    # one jitted shard_map program: stage rows by murmur3 partition id and
-    # deliver every bucket in a single all_to_all over ICI
-    cols = jax.tree.map(lambda *xs: jnp.concatenate(xs, 0),
-                        *[b.columns for b in dev_batches])
-    num_rows = jnp.array([int(b.num_rows) for b in dev_batches], jnp.int32)
-    mesh = Mesh(np.array(devices[:Pn]), ("p",))
-
-    def step(local_cols, local_num_rows):
-        b = ColumnBatch(schema, local_cols, local_num_rows[0], cap)
-        out, overflow = mesh_shuffle_batch(b, key_idx, "p", Pn, quota=quota)
-        return out.columns, out.num_rows[None], overflow[None]
-
-    run = jax.jit(jax.shard_map(step, mesh=mesh,
-                                in_specs=(P("p"), P("p")),
-                                out_specs=(P("p"), P("p"), P("p"))))
-    out_cols, out_rows, overflow = run(cols, num_rows)
-    out_rows = np.asarray(out_rows)
-    if int(np.asarray(overflow)[0]) > 0:
-        return False  # caller re-runs on the file path (lossless fallback)
-
-    recv_cap = Pn * quota  # per-device received capacity
-    full = ColumnBatch(schema, out_cols, jnp.asarray(0, jnp.int32),
-                       Pn * recv_cap)
-    part_batches = []
-    for p in range(Pn):
-        idx = jnp.arange(recv_cap, dtype=jnp.int32) + p * recv_cap
-        part_batches.append(full.take(idx, int(out_rows[p])))
+        for batch in execute_plan(
+                op, ExecContext(partition=task, num_partitions=ntasks)):
+            if int(batch.num_rows) == 0:
+                continue
+            if not exchange_batch(batch):
+                spill_batch_to_file(batch)
 
     def provider(partition: int):
         # defaulted extra args would miscount as task-context params in
-        # _call_provider's arity dispatch — close over part_batches instead
-        yield part_batches[partition]
+        # _call_provider's arity dispatch — close over state instead
+        for b in recv_parts[partition]:
+            yield b
+        for data, index in file_outputs:
+            yield from read_shuffle_partition(data, index, partition, schema)
 
     resources.put(f"shuffle:{stage_id}", provider)
     return True

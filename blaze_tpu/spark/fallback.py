@@ -183,8 +183,14 @@ def _op_agg(plan: SparkPlan, part: int, nparts: int) -> pd.DataFrame:
     df = _execute(plan.children[0], part, nparts)
     mode = plan.attrs["mode"]
     gnames = list(plan.attrs["grouping_names"])
-    for name, g in zip(gnames, plan.attrs["grouping"]):
-        df[name] = np.asarray(_eval(g, df))
+    if mode == "partial":
+        for name, g in zip(gnames, plan.attrs["grouping"]):
+            df[name] = np.asarray(_eval(g, df))
+    else:
+        # state-layout input (group cols + state cols BY POSITION, ref
+        # NativeAggBase): the original grouping exprs reference pre-shuffle
+        # columns that no longer exist — bind positionally instead
+        df = df.rename(columns=dict(zip(df.columns[:len(gnames)], gnames)))
 
     from blaze_tpu.ops.agg import AGG_BUF_PREFIX
 
@@ -214,6 +220,31 @@ def _op_agg(plan: SparkPlan, part: int, nparts: int) -> pd.DataFrame:
             elif fn == "avg":
                 out_cols[f"{p}.sum"] = g.sum().to_numpy()
                 out_cols[f"{p}.count"] = g.count().to_numpy()
+            elif fn == "first":
+                out_cols[f"{p}.val"] = g.apply(
+                    lambda s: s.iloc[0] if len(s) else None).to_numpy()
+                out_cols[f"{p}.valid"] = g.apply(
+                    lambda s: bool(len(s)) and pd.notna(s.iloc[0])
+                ).to_numpy()
+                out_cols[f"{p}.has"] = (g.size() > 0).to_numpy()
+            elif fn == "first_ignores_null":
+                out_cols[f"{p}.val"] = g.apply(
+                    lambda s: (s.dropna().iloc[0]
+                               if s.notna().any() else None)).to_numpy()
+                out_cols[f"{p}.has"] = g.apply(
+                    lambda s: s.notna().any()).to_numpy()
+            elif fn in ("collect_list", "collect_set"):
+                def coll(s, dedup=(fn == "collect_set")):
+                    vals = [x for x in s if pd.notna(x)]
+                    if dedup:
+                        seen, out = set(), []
+                        for x in vals:
+                            if x not in seen:
+                                seen.add(x)
+                                out.append(x)
+                        vals = out
+                    return vals
+                out_cols[f"{p}.list"] = g.apply(coll).to_numpy()
             else:
                 raise NotImplementedError(f"fallback partial agg {fn}")
         elif mode == "final":
@@ -233,11 +264,249 @@ def _op_agg(plan: SparkPlan, part: int, nparts: int) -> pd.DataFrame:
                 s = gcol(f"{p}.sum").sum().to_numpy()
                 c = gcol(f"{p}.count").sum().to_numpy()
                 out_cols[call["name"]] = s / np.maximum(c, 1)
+            elif fn == "first":
+                has = gcol(f"{p}.has")
+                first_pos = has.apply(
+                    lambda s: s[s].index[0] if s.any() else s.index[0])
+                out_cols[call["name"]] = np.where(
+                    df.loc[first_pos, f"{p}.valid"].to_numpy(),
+                    df.loc[first_pos, f"{p}.val"].to_numpy(), None)
+            elif fn == "first_ignores_null":
+                has = gcol(f"{p}.has")
+                first_pos = has.apply(
+                    lambda s: s[s].index[0] if s.any() else s.index[0])
+                out_cols[call["name"]] = np.where(
+                    has.apply(lambda s: s.any()).to_numpy(),
+                    df.loc[first_pos, f"{p}.val"].to_numpy(), None)
+            elif fn in ("collect_list", "collect_set"):
+                def merged(s, dedup=(fn == "collect_set")):
+                    vals = [x for lst in s for x in (lst or [])]
+                    if dedup:
+                        seen, out = set(), []
+                        for x in vals:
+                            if x not in seen:
+                                seen.add(x)
+                                out.append(x)
+                        vals = out
+                    return vals
+                out_cols[call["name"]] = gcol(
+                    f"{p}.list").apply(merged).to_numpy()
             else:
                 raise NotImplementedError(f"fallback final agg {fn}")
+        elif mode == "partial_merge":
+            # merge state columns group-wise, keeping the state layout
+            def gcol(name):
+                return df[name].groupby([df[n] for n in gnames],
+                                        dropna=False, sort=True)
+            if fn in ("sum",):
+                out_cols[f"{p}.sum"] = gcol(f"{p}.sum").sum().to_numpy()
+                out_cols[f"{p}.nonempty"] = gcol(
+                    f"{p}.nonempty").any().to_numpy()
+            elif fn == "count":
+                out_cols[f"{p}.count"] = gcol(f"{p}.count").sum().to_numpy()
+            elif fn == "avg":
+                out_cols[f"{p}.sum"] = gcol(f"{p}.sum").sum().to_numpy()
+                out_cols[f"{p}.count"] = gcol(f"{p}.count").sum().to_numpy()
+            elif fn in ("min", "max"):
+                v = gcol(f"{p}.val")
+                out_cols[f"{p}.val"] = (v.min() if fn == "min"
+                                        else v.max()).to_numpy()
+                out_cols[f"{p}.has"] = gcol(f"{p}.has").any().to_numpy()
+            else:
+                raise NotImplementedError(f"fallback merge agg {fn}")
         else:
             raise NotImplementedError(f"fallback agg mode {mode}")
     return pd.DataFrame(out_cols)
+
+
+def _op_join(plan: SparkPlan, part: int, nparts: int) -> pd.DataFrame:
+    """SMJ/BHJ on the row engine (a NeverConvert join must not kill the
+    query — exactly the failure mode the bridge exists to prevent)."""
+    ldf = _execute(plan.children[0], part, nparts)
+    rdf = _execute(plan.children[1], part, nparts)
+    jt = plan.attrs["join_type"]
+    cond = plan.attrs.get("condition")
+
+    lk = [np.asarray(_eval(e, ldf)) for e in plan.attrs["left_keys"]]
+    rk = [np.asarray(_eval(e, rdf)) for e in plan.attrs["right_keys"]]
+    lt = ldf.copy()
+    rt = rdf.copy()
+    kcols = []
+    for i, (a, b) in enumerate(zip(lk, rk)):
+        lt[f"__jk{i}"] = a
+        rt[f"__jk{i}"] = b
+        kcols.append(f"__jk{i}")
+    lt["__lrow"] = np.arange(len(lt))
+    rt["__rrow"] = np.arange(len(rt))
+
+    # spark equi-join: NULL keys never match (pandas merge would pair
+    # NaN with NaN) — null-key rows drop out of the match phase and
+    # surface only through the unmatched/outer paths below
+    lvalid = ~lt[kcols].isna().any(axis=1)
+    rvalid = ~rt[kcols].isna().any(axis=1)
+    inner = lt[lvalid].merge(rt[rvalid], on=kcols, how="inner",
+                             suffixes=("", "__rdup"))
+    if cond is not None:
+        pair = pd.concat(
+            [ldf.iloc[inner["__lrow"].to_numpy()].reset_index(drop=True),
+             rdf.iloc[inner["__rrow"].to_numpy()].reset_index(drop=True)],
+            axis=1)
+        ok = pd.Series(np.asarray(_eval(cond, pair))).fillna(False).astype(
+            bool).to_numpy()
+        inner = inner[ok].reset_index(drop=True)
+
+    matched_l = set(inner["__lrow"])
+    matched_r = set(inner["__rrow"])
+
+    def pair_frame(lrows, rrows):
+        lpart = (ldf.iloc[lrows].reset_index(drop=True) if lrows is not None
+                 else pd.DataFrame(
+                     {c: [None] * n_null for c in ldf.columns}))
+        rpart = (rdf.iloc[rrows].reset_index(drop=True) if rrows is not None
+                 else pd.DataFrame(
+                     {c: [None] * n_null for c in rdf.columns}))
+        return pd.concat([lpart, rpart], axis=1)
+
+    if jt in ("left_semi", "left_anti"):
+        keep = (ldf.index.isin(matched_l) if jt == "left_semi"
+                else ~ldf.index.isin(matched_l))
+        return ldf[keep].reset_index(drop=True)
+    if jt == "existence":
+        out = ldf.copy()
+        out["exists"] = ldf.index.isin(matched_l)
+        return out.reset_index(drop=True)
+
+    frames = [pair_frame(inner["__lrow"].to_numpy(),
+                         inner["__rrow"].to_numpy())]
+    if jt in ("left", "full"):
+        lost = [i for i in range(len(ldf)) if i not in matched_l]
+        n_null = len(lost)
+        if lost:
+            frames.append(pair_frame(lost, None))
+    if jt in ("right", "full"):
+        lost = [i for i in range(len(rdf)) if i not in matched_r]
+        n_null = len(lost)
+        if lost:
+            frames.append(pair_frame(None, lost))
+    return pd.concat(frames, ignore_index=True)
+
+
+def _op_window(plan: SparkPlan, part: int, nparts: int) -> pd.DataFrame:
+    df = _execute(plan.children[0], part, nparts)
+    parts_keys = [f"__wp{i}" for i in range(len(plan.attrs["partition_by"]))]
+    tmp = df.copy()
+    for k, e in zip(parts_keys, plan.attrs["partition_by"]):
+        tmp[k] = np.asarray(_eval(e, df))
+    order = plan.attrs["order_by"]
+    okeys, sort_cols, sort_asc = [], [], []
+    for i, (e, a, nulls_first) in enumerate(order):
+        v = pd.Series(np.asarray(_eval(e, df)), index=tmp.index)
+        tmp[f"__wonull{i}"] = v.isna().astype(int)
+        tmp[f"__wo{i}"] = v
+        okeys.append(f"__wo{i}")
+        sort_cols += [f"__wonull{i}", f"__wo{i}"]
+        sort_asc += [not nulls_first, a]
+    if parts_keys or sort_cols:
+        tmp = tmp.sort_values(parts_keys + sort_cols,
+                              ascending=[True] * len(parts_keys) + sort_asc,
+                              kind="stable")
+    grouped = tmp.groupby(parts_keys, dropna=False, sort=False) \
+        if parts_keys else tmp.groupby(np.zeros(len(tmp)))
+    for call in plan.attrs["calls"]:
+        fn, name = call["fn"], call["name"]
+        if fn == "row_number":
+            tmp[name] = grouped.cumcount() + 1
+        elif fn in ("rank", "dense_rank"):
+            if not okeys:
+                tmp[name] = 1  # no ORDER BY: every row is peer rank 1
+            else:
+                # rows are already in window order; rank = position of the
+                # peer group's first row (direction-agnostic, unlike
+                # Series.rank which always ranks ascending by VALUE)
+                peer_cols = parts_keys + okeys
+                is_start = (tmp[peer_cols] !=
+                            tmp[peer_cols].shift()).any(axis=1)
+                is_start.iloc[0] = True
+                within = grouped.cumcount()
+                if fn == "rank":
+                    start_pos = within.where(is_start)
+                    part_key = (tmp[parts_keys].apply(tuple, axis=1)
+                                if parts_keys else pd.Series(
+                                    0, index=tmp.index))
+                    tmp[name] = (start_pos.groupby(
+                        part_key, sort=False).ffill() + 1).astype(int)
+                else:
+                    part_key = (tmp[parts_keys].apply(tuple, axis=1)
+                                if parts_keys else pd.Series(
+                                    0, index=tmp.index))
+                    tmp[name] = is_start.astype(int).groupby(
+                        part_key, sort=False).cumsum().astype(int)
+        else:  # running aggregate leveled to the peer group (RANGE frame)
+            arg = pd.Series(np.asarray(_eval(call["args"][0], tmp)),
+                            index=tmp.index)
+            tmp["__warg"] = arg
+            agg = {"sum": "cumsum", "count": "cumcount", "avg": None,
+                   "min": "cummin", "max": "cummax"}[fn]
+            g2 = tmp.groupby(parts_keys, dropna=False, sort=False) \
+                if parts_keys else tmp.groupby(np.zeros(len(tmp)))
+            if fn == "count":
+                run = g2["__warg"].transform(
+                    lambda s: s.notna().cumsum())
+            elif fn == "avg":
+                sums = g2["__warg"].transform(lambda s: s.fillna(0).cumsum())
+                cnts = g2["__warg"].transform(lambda s: s.notna().cumsum())
+                run = sums / cnts.clip(lower=1)
+            else:
+                run = g2["__warg"].transform(agg)
+            if okeys:
+                # level to the last row of each peer group
+                peer = parts_keys + okeys
+                run = run.groupby(
+                    [tmp[c] for c in peer], dropna=False).transform("last")
+            else:
+                run = g2["__warg"].transform(
+                    {"sum": "sum", "count": "count", "min": "min",
+                     "max": "max"}.get(fn, "sum")) if fn != "avg" else \
+                    g2["__warg"].transform("mean")
+            tmp[name] = run
+    out_names = [f.name for f in plan.schema.fields]
+    return tmp[out_names].reset_index(drop=True)
+
+
+def _op_expand(plan: SparkPlan, part: int, nparts: int) -> pd.DataFrame:
+    df = _execute(plan.children[0], part, nparts)
+    names = _names(plan)
+    frames = []
+    for proj in plan.attrs["projections"]:
+        cols = {}
+        for name, e in zip(names, proj):
+            v = _eval(e, df)
+            cols[name] = (pd.Series(v, index=df.index) if np.ndim(v)
+                          else pd.Series(np.full(len(df), v),
+                                         index=df.index))
+        frames.append(pd.DataFrame(cols))
+    return pd.concat(frames, ignore_index=True)
+
+
+def _op_generate(plan: SparkPlan, part: int, nparts: int) -> pd.DataFrame:
+    df = _execute(plan.children[0], part, nparts)
+    lists = _eval(plan.attrs["generator"], df)
+    required = plan.attrs["required_cols"]
+    out_names = plan.attrs["output_names"]
+    pos, outer = plan.attrs["pos"], plan.attrs["outer"]
+    rows = []
+    for i in range(len(df)):
+        vals = lists.iloc[i] if hasattr(lists, "iloc") else lists[i]
+        base = [df[c].iloc[i] for c in required]
+        if vals is None or (isinstance(vals, float) and pd.isna(vals)) \
+                or len(vals) == 0:
+            if outer:
+                rows.append(base + ([None, None] if pos else [None]))
+            continue
+        for j, v in enumerate(vals):
+            rows.append(base + ([j, v] if pos else [v]))
+    names = [f.name for f in plan.schema.fields]
+    return pd.DataFrame(rows, columns=names)
 
 
 _OPS: Dict[str, Callable[[SparkPlan, int, int], pd.DataFrame]] = {
@@ -252,6 +521,12 @@ _OPS: Dict[str, Callable[[SparkPlan, int, int], pd.DataFrame]] = {
     "HashAggregateExec": _op_agg,
     "SortAggregateExec": _op_agg,
     "ObjectHashAggregateExec": _op_agg,
+    "SortMergeJoinExec": _op_join,
+    "BroadcastHashJoinExec": _op_join,
+    "ShuffledHashJoinExec": _op_join,
+    "WindowExec": _op_window,
+    "ExpandExec": _op_expand,
+    "GenerateExec": _op_generate,
 }
 
 

@@ -77,7 +77,8 @@ def run_plan(root: SparkPlan, num_partitions: int = 4,
 
                     if run_mesh_shuffle_stage(
                             stage.plan, stage.stage_id,
-                            _input_tasks(stage, stages), quota=mesh_quota):
+                            _input_tasks(stage, stages), quota=mesh_quota,
+                            work_dir=work_dir):
                         continue
                 _run_shuffle_stage(stage, stages, work_dir, shuffle_outputs)
             elif stage.kind == "broadcast":

@@ -256,3 +256,31 @@ def test_bnlj_cross_and_condition(rng):
     d = louter.to_numpy()
     assert int(louter.num_rows) == 2
     assert all(v is None for v in d["rv"])
+
+
+def test_bnlj_chunked_expansion(rng):
+    """BNLJ must expand the cartesian product in bounded left chunks, not
+    one |L|x|R| batch (VERDICT r2 weak-5). With a tiny batch_size the
+    600x400 product forces many chunks; results must match pandas."""
+    import pandas as pd
+
+    from blaze_tpu.config import conf
+
+    old = conf.batch_size
+    conf.batch_size = 64  # chunk = 64*16//400 = 2 left rows per expansion
+    try:
+        left = _mk(LS, rng.integers(0, 5, 600), rng.random(600))
+        right = _mk(RS, rng.integers(0, 5, 400), rng.random(400))
+        cond = ir.Binary(ir.BinOp.LT, ir.col("lv"), ir.col("rv"))
+        j = BroadcastNestedLoopJoinExec(
+            MemorySourceExec([left], LS), MemorySourceExec([right], RS),
+            JoinType.INNER, condition=cond)
+        out = collect(j)
+        ldf, rdf = _df(left), _df(right)
+        want = ldf.merge(rdf, how="cross")
+        want = want[want.lv < want.rv]
+        assert int(out.num_rows) == len(want)
+        got_sum = float(np.sum(np.asarray(out.to_numpy()["lv"], np.float64)))
+        np.testing.assert_allclose(got_sum, want["lv"].sum(), rtol=1e-9)
+    finally:
+        conf.batch_size = old

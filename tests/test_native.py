@@ -152,3 +152,34 @@ def test_call_native_task(native, rng):
 def test_call_native_error_relay(native):
     with pytest.raises(RuntimeError):
         native.call_native(b"definitely not a protobuf")
+
+
+def test_call_native_python_exception_relay(native, rng):
+    """A Python exception raised MID-EXECUTION (inside the embedded
+    engine, not at decode) must cross the C ABI with its message intact in
+    bn_last_error (ref rt.rs error relay via setError -> rethrown,
+    BlazeCallNativeWrapper.scala:73-78; VERDICT r2 weak-12)."""
+    from blaze_tpu.columnar import serde as bserde
+    from blaze_tpu.plan import plan_pb2 as pb
+    from blaze_tpu.runtime import resources
+
+    b = _batch(rng, 10)
+
+    def exploding_provider():
+        yield bserde.serialize_batch(b)
+        raise ValueError("exploding-provider-sentinel-42")
+
+    rid = resources.register(lambda: exploding_provider())
+    node = pb.PlanNode()
+    sch = node.ipc_reader.schema
+    for name, kind in [("k", pb.TK_INT64), ("v", pb.TK_FLOAT64),
+                       ("s", pb.TK_STRING), ("b", pb.TK_BOOL)]:
+        sch.fields.add(name=name, dtype=pb.DataType(kind=kind))
+    node.ipc_reader.provider_resource_id = rid
+    td = pb.TaskDefinition(task_id="t", stage_id=9, partition_id=0,
+                           plan=node)
+    with pytest.raises(RuntimeError) as exc:
+        native.call_native(td.SerializeToString())
+    # the sentinel from the Python exception must survive the C boundary
+    assert "exploding-provider-sentinel-42" in str(exc.value)
+    resources.pop(rid)

@@ -171,3 +171,42 @@ def test_ipc_writer_reader_roundtrip(rng):
     for b in batches:
         want += _rows(b)
     assert _rows(out) == sorted(want, key=repr)
+
+
+def test_round_robin_restart_stable(rng, tmp_path):
+    """A retried round-robin map task must land every row in the same
+    partition (Spark seeds the start by partitionId; VERDICT r2 weak-6)."""
+    from blaze_tpu.ops.base import ExecContext
+    from blaze_tpu.ops.basic import MemorySourceExec
+    from blaze_tpu.ops.shuffle import (
+        Partitioning, ShuffleWriterExec, read_shuffle_partition,
+        round_robin_start,
+    )
+    from blaze_tpu.runtime.executor import execute_plan
+
+    batches = [_batch(rng, 100), _batch(rng, 60)]
+    schema = batches[0].schema
+
+    def run(attempt):
+        data = str(tmp_path / f"rr{attempt}.data")
+        index = str(tmp_path / f"rr{attempt}.index")
+        op = ShuffleWriterExec(MemorySourceExec(batches, schema),
+                               Partitioning("round_robin", 4), data, index)
+        list(execute_plan(op, ExecContext(partition=2, num_partitions=3)))
+        parts = []
+        for p in range(4):
+            rows = []
+            for b in read_shuffle_partition(data, index, p, schema):
+                d = b.to_numpy()
+                rows += list(zip(np.asarray(d["k"]),
+                                 [round(float(x), 9) for x in d["v"]]))
+            parts.append(rows)
+        return parts
+
+    first, second = run(0), run(1)
+    assert first == second, "retry must reproduce identical partitions"
+    sizes = [len(p) for p in first]
+    assert max(sizes) - min(sizes) <= 1, f"round robin must balance: {sizes}"
+    # different tasks start at different positions (task-seeded)
+    starts = {round_robin_start(t, 4) for t in range(8)}
+    assert len(starts) > 1
