@@ -157,9 +157,14 @@ class ShuffleWriterExec(Operator):
                                M.get_manager(ctx))
         keys_jit = not any(ir.contains_host_fn(e)
                            for e in self.partitioning.key_exprs)
-        rr = round_robin_start(ctx.partition,
-                               self.partitioning.num_partitions)
-        key = ("shuffle_part", keys_jit, rr, self.plan_key())
+        is_rr = self.partitioning.kind == "round_robin"
+        rr = (round_robin_start(ctx.partition,
+                                self.partitioning.num_partitions)
+              if is_rr else 0)
+        # rr keys the cache ONLY for round robin (hash/single programs
+        # ignore it — per-task keys would recompile identical programs)
+        key = ("shuffle_part", keys_jit, rr if is_rr else None,
+               self.plan_key())
         row_offset = 0
         try:
             for batch in self.children[0].execute(ctx):
@@ -300,7 +305,13 @@ class RssShuffleWriterExec(ShuffleWriterExec):
         writer: RssPartitionWriterBase = resources.get(self.rss_resource_id)
         keys_jit = not any(ir.contains_host_fn(e)
                            for e in self.partitioning.key_exprs)
-        key = ("shuffle_part", keys_jit, self.plan_key())
+        is_rr = self.partitioning.kind == "round_robin"
+        rr = (round_robin_start(ctx.partition,
+                                self.partitioning.num_partitions)
+              if is_rr else 0)
+        key = ("shuffle_part", keys_jit, rr if is_rr else None,
+               self.plan_key())
+        row_offset = 0
         for batch in self.children[0].execute(ctx):
             ctx.check_running()
             if int(batch.num_rows) == 0:
@@ -308,9 +319,12 @@ class RssShuffleWriterExec(ShuffleWriterExec):
             with self.metrics.timer():
                 fn = jit_cache.get_or_compile(
                     key + batch.shape_key(),
-                    lambda: (lambda b: partition_and_sort(
-                        b, self.partitioning, self._key_fns)))
-                sb, counts = fn(batch)
+                    lambda: (lambda b, off: partition_and_sort(
+                        b, self.partitioning, self._key_fns,
+                        row_offset=off, rr_start=rr)),
+                    jit=keys_jit)
+                sb, counts = fn(batch, jnp.asarray(row_offset, jnp.int64))
+                row_offset += int(batch.num_rows)
                 hb = serde.to_host(sb)
                 counts = np.asarray(counts)
                 offs = np.concatenate([[0], np.cumsum(counts)])

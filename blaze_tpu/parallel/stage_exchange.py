@@ -137,10 +137,15 @@ def run_mesh_shuffle_stage(stage_plan: pb.PlanNode, stage_id: int,
         full = ColumnBatch(schema, out_cols, jnp.asarray(0, jnp.int32),
                            Pn * recv_cap)
         for p in range(Pn):
-            if int(out_rows[p]) == 0:
+            nrows = int(out_rows[p])
+            if nrows == 0:
                 continue
-            idx = jnp.arange(recv_cap, dtype=jnp.int32) + p * recv_cap
-            recv_parts[p].append(full.take(idx, int(out_rows[p])))
+            # compact to the rows' own capacity bucket: retaining the full
+            # Pn*q staging capacity per slice would pin
+            # O(batches * Pn^2 * q) padded rows in HBM across the stage
+            cap_p = bucket_capacity(nrows)
+            idx = jnp.arange(cap_p, dtype=jnp.int32) + p * recv_cap
+            recv_parts[p].append(full.take(idx, nrows))
         return True
 
     def spill_batch_to_file(batch: ColumnBatch) -> None:

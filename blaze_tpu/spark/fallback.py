@@ -312,6 +312,30 @@ def _op_agg(plan: SparkPlan, part: int, nparts: int) -> pd.DataFrame:
                 out_cols[f"{p}.val"] = (v.min() if fn == "min"
                                         else v.max()).to_numpy()
                 out_cols[f"{p}.has"] = gcol(f"{p}.has").any().to_numpy()
+            elif fn in ("first", "first_ignores_null"):
+                has = gcol(f"{p}.has")
+                first_pos = has.apply(
+                    lambda s: s[s].index[0] if s.any() else s.index[0])
+                out_cols[f"{p}.val"] = df.loc[first_pos,
+                                              f"{p}.val"].to_numpy()
+                if fn == "first":
+                    out_cols[f"{p}.valid"] = df.loc[
+                        first_pos, f"{p}.valid"].to_numpy()
+                out_cols[f"{p}.has"] = has.apply(
+                    lambda s: s.any()).to_numpy()
+            elif fn in ("collect_list", "collect_set"):
+                def merged_state(s, dedup=(fn == "collect_set")):
+                    vals = [x for lst in s for x in (lst or [])]
+                    if dedup:
+                        seen, out = set(), []
+                        for x in vals:
+                            if x not in seen:
+                                seen.add(x)
+                                out.append(x)
+                        vals = out
+                    return vals
+                out_cols[f"{p}.list"] = gcol(
+                    f"{p}.list").apply(merged_state).to_numpy()
             else:
                 raise NotImplementedError(f"fallback merge agg {fn}")
         else:
@@ -424,9 +448,13 @@ def _op_window(plan: SparkPlan, part: int, nparts: int) -> pd.DataFrame:
                 # peer group's first row (direction-agnostic, unlike
                 # Series.rank which always ranks ascending by VALUE)
                 peer_cols = parts_keys + okeys
-                is_start = (tmp[peer_cols] !=
-                            tmp[peer_cols].shift()).any(axis=1)
-                is_start.iloc[0] = True
+                cur, prev = tmp[peer_cols], tmp[peer_cols].shift()
+                # null-aware change detection: NULL order values are PEERS
+                # (NaN != NaN would split them into distinct groups)
+                neq = (cur != prev) & ~(cur.isna() & prev.isna())
+                is_start = neq.any(axis=1)
+                if len(is_start):
+                    is_start.iloc[0] = True
                 within = grouped.cumcount()
                 if fn == "rank":
                     start_pos = within.where(is_start)

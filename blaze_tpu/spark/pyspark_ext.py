@@ -1,0 +1,47 @@
+"""PySpark-facing entry: capture real executed plans and run them here.
+
+The reference injects a Catalyst rule in-process
+(BlazeSparkSessionExtension.scala:40-92). A TPU engine lives OUT of the
+JVM, so this integration captures the executed physical plan's canonical
+TreeNode JSON and lowers it through plan_json -> the converters ->
+local_runner (or, in deployment, per-task protobufs shipped to
+runtime/native_entry.run_task_serialized).
+
+pyspark is not bundled with this engine; everything here import-gates so
+the module is a no-op without it. Usage with a live Spark session:
+
+    from blaze_tpu.spark.pyspark_ext import capture_plan_json, run_sql
+
+    js = capture_plan_json(spark, "SELECT ...")   # real Catalyst output
+    batch = run_sql(spark, "SELECT ...")          # executes on this engine
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def pyspark_available() -> bool:
+    try:
+        import pyspark  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def capture_plan_json(spark, sql: str) -> str:
+    """The executed physical plan of `sql`, as Spark's TreeNode JSON —
+    the exact artifact plan_json.decode_plan_json consumes."""
+    df = spark.sql(sql)
+    return df._jdf.queryExecution().executedPlan().toJSON()
+
+
+def run_sql(spark, sql: str, num_partitions: int = 4):
+    """Plan on Spark, execute on this engine; returns a ColumnBatch."""
+    from blaze_tpu.spark.local_runner import run_plan
+    from blaze_tpu.spark.plan_json import decode_plan_json
+
+    js = capture_plan_json(spark, sql)
+    plan = decode_plan_json(js)
+    return run_plan(plan, num_partitions=num_partitions)
