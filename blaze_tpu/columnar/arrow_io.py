@@ -125,11 +125,44 @@ def _varbin_to_fixed(arr: pa.Array, cap: int, min_width: int = 0):
     return out_mat, _pad1d(lengths, cap, np.int32)
 
 
+_ZC_KINDS = {
+    T.TypeKind.INT8: pa.int8(), T.TypeKind.INT16: pa.int16(),
+    T.TypeKind.INT32: pa.int32(), T.TypeKind.INT64: pa.int64(),
+    T.TypeKind.FLOAT32: pa.float32(), T.TypeKind.FLOAT64: pa.float64(),
+    T.TypeKind.DATE: pa.date32(),
+}
+
+
+def _numeric_zero_copy(arr, dtype: T.DataType, cap: int) -> Optional[Column]:
+    """No-host-copy ingest for null-free fixed-width columns (north-star
+    item, SURVEY.md §7 step 1): the Arrow data buffer is viewed in place
+    (np.frombuffer), devices-put in ONE DMA, and padded to the capacity
+    bucket ON DEVICE. The general path below pays fill_null + astype +
+    pad — three host copies — before the same DMA."""
+    at = _ZC_KINDS.get(dtype.kind)
+    if at is None or arr.type != at or arr.null_count != 0:
+        return None
+    n = len(arr)
+    buf = arr.buffers()[1]
+    if buf is None:
+        return None
+    itemsize = dtype.np_dtype().itemsize
+    view = np.frombuffer(buf, dtype.np_dtype(), count=n,
+                         offset=arr.offset * itemsize)
+    dev = jnp.asarray(view)
+    if cap > n:
+        dev = jnp.zeros((cap,), dtype.jnp_dtype()).at[:n].set(dev)
+    return Column(dtype, dev, None)
+
+
 def column_from_arrow(arr, dtype: T.DataType, cap: int) -> Column:
     if isinstance(arr, pa.ChunkedArray):
         arr = arr.combine_chunks()
     if pa.types.is_dictionary(arr.type):
         arr = arr.cast(arr.type.value_type)
+    fast = _numeric_zero_copy(arr, dtype, cap)
+    if fast is not None:
+        return fast
     n = len(arr)
     validity = _validity_np(arr)
     if dtype.kind == T.TypeKind.LIST:

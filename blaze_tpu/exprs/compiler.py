@@ -29,8 +29,54 @@ from blaze_tpu.exprs.cast import cast_column, check_overflow, _const_string, _an
 
 CompiledExpr = Callable[[ColumnBatch], Column]
 
+# ---------------------------------------------------------------------------
+# common-subexpression elimination (ref cached_exprs_evaluator.rs:38-60).
+# XLA CSEs identical subgraphs AFTER tracing; this memo removes the
+# TRACE-TIME cost (and the eager-path re-evaluation cost for unjitted
+# host-fn chains): within one cse_scope — one batch flowing through one
+# fused chain — each distinct expression key evaluates once.
+# ---------------------------------------------------------------------------
+
+import contextlib
+import threading
+
+_cse_tls = threading.local()
+
+
+@contextlib.contextmanager
+def cse_scope():
+    prev = getattr(_cse_tls, "memo", None)
+    _cse_tls.memo = {}
+    try:
+        yield
+    finally:
+        _cse_tls.memo = prev
+
 
 def compile_expr(expr: ir.Expr, schema) -> CompiledExpr:
+    """Bind + lower an expression against an input schema (with CSE when
+    evaluated inside a cse_scope)."""
+    inner = _compile_expr(expr, schema)
+    key = ("cse", expr.key())
+
+    def run(b: ColumnBatch) -> Column:
+        memo = getattr(_cse_tls, "memo", None)
+        if memo is None:
+            return inner(b)
+        # the entry RETAINS the batch: keying by id() alone would let a
+        # freed batch's address be recycled within the scope and serve a
+        # stale Column for the new object
+        bkey = (id(b),) + key
+        hit = memo.get(bkey)
+        if hit is None:
+            hit = (b, inner(b))
+            memo[bkey] = hit
+        return hit[1]
+
+    return run
+
+
+def _compile_expr(expr: ir.Expr, schema) -> CompiledExpr:
     """Bind + lower an expression against an input schema."""
     if isinstance(expr, ir.Col):
         idx = schema.index_of(expr.name)

@@ -378,16 +378,20 @@ class AggExec(Operator):
                batch.shape_key())
 
         def make():
+            from blaze_tpu.exprs.compiler import cse_scope
+
             gfns, ifns = self._group_fns, self._input_fns
 
             def run(b: ColumnBatch) -> ColumnBatch:
-                cols = [fn(b) for fn in gfns]
-                fields = list(self._group_fields)
-                for call, fns in zip(self.aggs, ifns):
-                    for j, fn in enumerate(fns):
-                        c = fn(b)
-                        cols.append(c)
-                        fields.append(Field(f"in.{call.name}.{j}", c.dtype))
+                with cse_scope():
+                    cols = [fn(b) for fn in gfns]
+                    fields = list(self._group_fields)
+                    for call, fns in zip(self.aggs, ifns):
+                        for j, fn in enumerate(fns):
+                            c = fn(b)
+                            cols.append(c)
+                            fields.append(
+                                Field(f"in.{call.name}.{j}", c.dtype))
                 return b.with_columns(Schema(fields), cols)
 
             return run

@@ -114,12 +114,15 @@ class WindowExec(Operator):
         return Schema(fields), part_idx, in_idx, nin
 
     def _make_work(self, b: ColumnBatch, work_schema: Schema) -> ColumnBatch:
-        cols = list(b.columns)
-        for fn in self._part_fns:
-            cols.append(fn(b))
-        for fns in self._input_fns:
-            for fn in fns:
+        from blaze_tpu.exprs.compiler import cse_scope
+
+        with cse_scope():
+            cols = list(b.columns)
+            for fn in self._part_fns:
                 cols.append(fn(b))
+            for fns in self._input_fns:
+                for fn in fns:
+                    cols.append(fn(b))
         return b.with_columns(work_schema, cols)
 
     def execute(self, ctx: ExecContext) -> BatchStream:

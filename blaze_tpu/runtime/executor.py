@@ -39,11 +39,16 @@ def execute_fused(op: MapLikeOp, ctx: ExecContext) -> BatchStream:
     key = ("fused", jit, top.plan_key())
 
     def make():
+        from blaze_tpu.exprs.compiler import cse_scope
+
         fns = [c.make_batch_fn() for c in chain]
 
         def fused(batch: ColumnBatch) -> ColumnBatch:
-            for fn in fns:
-                batch = fn(batch)
+            # one CSE scope per chain invocation: shared subexpressions
+            # across the chain's operators evaluate once
+            with cse_scope():
+                for fn in fns:
+                    batch = fn(batch)
             return batch
 
         return fused

@@ -200,15 +200,18 @@ def try_run_stage(root: Operator, ctx: ExecContext
         def apply_chain(b: ColumnBatch):
             """-> (batch, mask): mask is the surviving-row predicate over
             the batch's (uncompacted) rows."""
-            mask = b.row_mask()
-            for kind, fn in steps:
-                if kind == "map":
-                    b = fn(b)
-                else:
-                    for pf in fn:
-                        c = pf(b)
-                        mask = mask & c.data.astype(jnp.bool_) & \
-                            c.valid_mask()
+            from blaze_tpu.exprs.compiler import cse_scope
+
+            with cse_scope():
+                mask = b.row_mask()
+                for kind, fn in steps:
+                    if kind == "map":
+                        b = fn(b)
+                    else:
+                        for pf in fn:
+                            c = pf(b)
+                            mask = mask & c.data.astype(jnp.bool_) & \
+                                c.valid_mask()
             return b, mask
 
         def apply_chain_probe(bb):

@@ -176,6 +176,19 @@ def _op_union(plan: SparkPlan, part: int, nparts: int) -> pd.DataFrame:
                      ignore_index=True)
 
 
+def _merge_collected(series, dedup: bool):
+    """Flatten collect_list/collect_set state lists group-wise."""
+    vals = [x for lst in series for x in (lst or [])]
+    if dedup:
+        seen, out = set(), []
+        for x in vals:
+            if x not in seen:
+                seen.add(x)
+                out.append(x)
+        vals = out
+    return vals
+
+
 def _op_agg(plan: SparkPlan, part: int, nparts: int) -> pd.DataFrame:
     """Grouped aggregation matching the native agg state contract
     (ops/agg.py state_fields) so a fallback partial agg can feed a native
@@ -279,18 +292,9 @@ def _op_agg(plan: SparkPlan, part: int, nparts: int) -> pd.DataFrame:
                     has.apply(lambda s: s.any()).to_numpy(),
                     df.loc[first_pos, f"{p}.val"].to_numpy(), None)
             elif fn in ("collect_list", "collect_set"):
-                def merged(s, dedup=(fn == "collect_set")):
-                    vals = [x for lst in s for x in (lst or [])]
-                    if dedup:
-                        seen, out = set(), []
-                        for x in vals:
-                            if x not in seen:
-                                seen.add(x)
-                                out.append(x)
-                        vals = out
-                    return vals
-                out_cols[call["name"]] = gcol(
-                    f"{p}.list").apply(merged).to_numpy()
+                dd = fn == "collect_set"
+                out_cols[call["name"]] = gcol(f"{p}.list").apply(
+                    lambda s, dd=dd: _merge_collected(s, dd)).to_numpy()
             else:
                 raise NotImplementedError(f"fallback final agg {fn}")
         elif mode == "partial_merge":
@@ -321,21 +325,11 @@ def _op_agg(plan: SparkPlan, part: int, nparts: int) -> pd.DataFrame:
                 if fn == "first":
                     out_cols[f"{p}.valid"] = df.loc[
                         first_pos, f"{p}.valid"].to_numpy()
-                out_cols[f"{p}.has"] = has.apply(
-                    lambda s: s.any()).to_numpy()
+                out_cols[f"{p}.has"] = has.any().to_numpy()
             elif fn in ("collect_list", "collect_set"):
-                def merged_state(s, dedup=(fn == "collect_set")):
-                    vals = [x for lst in s for x in (lst or [])]
-                    if dedup:
-                        seen, out = set(), []
-                        for x in vals:
-                            if x not in seen:
-                                seen.add(x)
-                                out.append(x)
-                        vals = out
-                    return vals
-                out_cols[f"{p}.list"] = gcol(
-                    f"{p}.list").apply(merged_state).to_numpy()
+                dd = fn == "collect_set"
+                out_cols[f"{p}.list"] = gcol(f"{p}.list").apply(
+                    lambda s, dd=dd: _merge_collected(s, dd)).to_numpy()
             else:
                 raise NotImplementedError(f"fallback merge agg {fn}")
         else:

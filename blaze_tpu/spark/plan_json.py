@@ -303,6 +303,52 @@ def _guess_dtype(e: ir.Expr) -> T.DataType:
     return T.STRING
 
 
+_CMP_OPS = {ir.BinOp.EQ, ir.BinOp.NEQ, ir.BinOp.LT, ir.BinOp.LE,
+            ir.BinOp.GT, ir.BinOp.GE, ir.BinOp.EQ_NULLSAFE,
+            ir.BinOp.AND, ir.BinOp.OR}
+
+_NUM_RANK = [T.INT8, T.INT16, T.INT32, T.INT64, T.FLOAT32, T.FLOAT64]
+
+
+def _infer_dtype(e: ir.Expr, schema: T.Schema) -> T.DataType:
+    """Result dtype of a decoded expression against its input schema —
+    Alias TreeNode JSON carries no dataType, so computed projections must
+    infer (defaulting to STRING would corrupt shuffle-frame decode)."""
+    if isinstance(e, ir.Col):
+        try:
+            return schema.fields[schema.index_of(e.name)].dtype
+        except KeyError:
+            return T.STRING
+    if isinstance(e, ir.Literal):
+        return e.dtype
+    if isinstance(e, ir.Cast):
+        return e.dtype
+    if isinstance(e, (ir.Not, ir.IsNull, ir.IsNotNull, ir.StringPredicate,
+                      ir.Like, ir.InList)):
+        return T.BOOLEAN
+    if isinstance(e, ir.Negate):
+        return _infer_dtype(e.child, schema)
+    if isinstance(e, ir.Binary):
+        if e.op in _CMP_OPS:
+            return T.BOOLEAN
+        if e.op == ir.BinOp.DIV:
+            lt = _infer_dtype(e.left, schema)
+            return lt if lt.kind == T.TypeKind.DECIMAL else T.FLOAT64
+        lt = _infer_dtype(e.left, schema)
+        rt = _infer_dtype(e.right, schema)
+        for cand in reversed(_NUM_RANK):
+            if lt == cand or rt == cand:
+                return cand
+        return lt
+    if isinstance(e, ir.If):
+        return _infer_dtype(e.then, schema)
+    if isinstance(e, ir.CaseWhen) and e.branches:
+        return _infer_dtype(e.branches[0][1], schema)
+    if isinstance(e, ir.NamedStruct):
+        return e.result_type
+    return _guess_dtype(e)
+
+
 def _attr_field(a: dict) -> T.Field:
     return T.Field(_attr_name(a.get("exprId")),
                    decode_datatype(a.get("dataType")),
@@ -395,25 +441,21 @@ def _decode_node(node: dict) -> SparkPlan:
             tree = _expr_tree(item)
             e = decode_expr(tree)
             exprs.append(e)
+            names.append(_attr_name(tree.get("exprId")))
             if _cls(tree) == "Alias":
-                names.append(_attr_name(tree.get("exprId")))
-                fields.append(T.Field(
-                    names[-1], _alias_dtype(tree, e), True))
+                dt = tree.get("dataType")
+                dtype = (decode_datatype(dt) if dt is not None
+                         else _infer_dtype(e, child.schema))
+                fields.append(T.Field(names[-1], dtype, True))
             else:
-                names.append(_attr_name(tree.get("exprId")))
                 fields.append(_attr_field(tree))
         return SparkPlan("ProjectExec", T.Schema(fields), [child],
                          {"exprs": exprs, "names": names})
     if cls == "SortExec":
         child = _decode_node(ch[0])
-        orders = []
-        for item in node.get("sortOrder", []):
-            so = _expr_tree(item)
-            orders.append((decode_expr(so["children"][0]),
-                           so.get("direction") != "Descending",
-                           "First" in str(so.get("nullOrdering", ""))))
         return SparkPlan("SortExec", child.schema, [child],
-                         {"orders": orders, "fetch": None})
+                         {"orders": _decode_sort_orders(node),
+                          "fetch": None})
     if cls in ("SortMergeJoinExec", "ShuffledHashJoinExec"):
         left, right = _decode_node(ch[0]), _decode_node(ch[1])
         jt = _JOIN_TYPES.get(str(node.get("joinType")), None)
@@ -453,12 +495,27 @@ def _decode_node(node: dict) -> SparkPlan:
     if cls == "ShuffleExchangeExec":
         child = _decode_node(ch[0])
         part = _expr_tree(node.get("outputPartitioning"))
-        keys, nparts = [], 4
+        keys, nparts, kind = [], 4, None
         if part is not None:
+            pcls = _cls(part)
             nparts = int(part.get("numPartitions", 4))
-            keys = [decode_expr(c) for c in part["children"]]
+            if pcls == "HashPartitioning":
+                keys = [decode_expr(c) for c in part["children"]]
+            elif pcls == "RoundRobinPartitioning":
+                kind = "round_robin"
+            elif pcls == "RangePartitioning":
+                # content-preserving stand-in: rows spread round-robin;
+                # the ordering a range exchange served is re-established
+                # by the SortExec Spark always places above it (and the
+                # runner's ordered collect for root sorts)
+                kind = "round_robin"
+            elif pcls == "SinglePartition":
+                nparts = 1
+            else:
+                raise PlanJsonError(f"partitioning {pcls}")
         return SparkPlan("ShuffleExchangeExec", child.schema, [child],
-                         {"keys": keys, "num_partitions": nparts})
+                         {"keys": keys, "num_partitions": nparts,
+                          "kind": kind})
     if cls == "BroadcastExchangeExec":
         child = _decode_node(ch[0])
         return SparkPlan("BroadcastExchangeExec", child.schema, [child], {})
@@ -471,14 +528,8 @@ def _decode_node(node: dict) -> SparkPlan:
         return SparkPlan("UnionExec", children[0].schema, children, {})
     if cls == "TakeOrderedAndProjectExec":
         child = _decode_node(ch[0])
-        orders = []
-        for item in node.get("sortOrder", []):
-            so = _expr_tree(item)
-            orders.append((decode_expr(so["children"][0]),
-                           so.get("direction") != "Descending",
-                           "First" in str(so.get("nullOrdering", ""))))
         srt = SparkPlan("SortExec", child.schema, [child],
-                        {"orders": orders,
+                        {"orders": _decode_sort_orders(node),
                          "fetch": int(node.get("limit", 0))})
         return SparkPlan("GlobalLimitExec", child.schema, [srt],
                          {"limit": int(node.get("limit", 0))})
@@ -507,6 +558,16 @@ def _join_schema(left: SparkPlan, right: SparkPlan, jt: str) -> T.Schema:
     if jt in ("left_semi", "left_anti"):
         return left.schema
     return T.Schema(list(left.schema.fields) + list(right.schema.fields))
+
+
+def _decode_sort_orders(node: dict) -> List[tuple]:
+    orders = []
+    for item in node.get("sortOrder", []):
+        so = _expr_tree(item)
+        orders.append((decode_expr(so["children"][0]),
+                       so.get("direction") != "Descending",
+                       "First" in str(so.get("nullOrdering", ""))))
+    return orders
 
 
 def _decode_agg(cls: str, node: dict) -> SparkPlan:

@@ -49,7 +49,10 @@ def plan_stages(root: SparkPlan, default_partitions: int = 1) -> List[Stage]:
             part = plan.attrs.get("keys", [])
             w.partitioning.num_partitions = plan.attrs.get(
                 "num_partitions", default_partitions)
-            if part:
+            kind = plan.attrs.get("kind")
+            if kind == "round_robin":
+                w.partitioning.kind = pb.HashRepartition.ROUND_ROBIN
+            elif part:
                 w.partitioning.kind = pb.HashRepartition.HASH
                 for k in part:
                     w.partitioning.keys.add().CopyFrom(encode_expr(k))

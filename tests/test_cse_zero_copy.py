@@ -1,0 +1,87 @@
+"""CSE in the expression evaluator + zero-copy Arrow ingest.
+
+Ref: common/cached_exprs_evaluator.rs:38-60 (CSE is a measured TPC-DS win
+in the reference) and the SURVEY §7 step-1 north star (Arrow buffers into
+device arrays without host-side copies).
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from blaze_tpu.columnar import types as T
+from blaze_tpu.columnar.arrow_io import batch_from_arrow, column_from_arrow
+from blaze_tpu.columnar.batch import ColumnBatch
+from blaze_tpu.exprs import ir
+from blaze_tpu.exprs.ir import BinOp, col
+from blaze_tpu.ops.basic import MemorySourceExec, ProjectExec
+from blaze_tpu.runtime import resources
+from blaze_tpu.runtime.executor import collect
+
+
+def test_cse_shared_subtree_evaluates_once():
+    """Two projection outputs share a host-evaluated subtree (UDF wrapper);
+    inside one fused chain the shared subtree must run ONCE per batch."""
+    calls = {"n": 0}
+
+    def udf(vals, valid, n):
+        calls["n"] += 1
+        return vals * 2, None
+
+    rid = resources.register(udf)
+    schema = T.Schema([T.Field("x", T.INT64)])
+    batch = ColumnBatch.from_numpy(
+        {"x": np.arange(100, dtype=np.int64)}, schema)
+    shared = ir.UdfWrapper(rid, T.INT64, False, (col("x"),))
+    proj = ProjectExec(
+        MemorySourceExec([batch], schema),
+        [ir.Binary(BinOp.ADD, shared, ir.Literal(T.INT64, 1)),
+         ir.Binary(BinOp.MUL, shared, ir.Literal(T.INT64, 3))],
+        ["a", "b"])
+    out = collect(proj).to_numpy()
+    assert calls["n"] == 1, "shared subtree must evaluate once per batch"
+    np.testing.assert_array_equal(np.asarray(out["a"]),
+                                  np.arange(100) * 2 + 1)
+    np.testing.assert_array_equal(np.asarray(out["b"]),
+                                  np.arange(100) * 2 * 3)
+    resources.pop(rid)
+
+
+def test_zero_copy_numeric_ingest():
+    """Null-free fixed-width Arrow columns take the no-host-copy path and
+    round-trip exactly (incl. sliced arrays with offsets)."""
+    arr = pa.array(np.arange(1000, dtype=np.int64))
+    col_ = column_from_arrow(arr, T.INT64, 1024)
+    assert col_.validity is None
+    np.testing.assert_array_equal(np.asarray(col_.data)[:1000],
+                                  np.arange(1000))
+    # sliced array: offset handling
+    sl = arr.slice(100, 50)
+    col2 = column_from_arrow(sl, T.INT64, 64)
+    np.testing.assert_array_equal(np.asarray(col2.data)[:50],
+                                  np.arange(100, 150))
+    # floats
+    f = pa.array(np.linspace(0, 1, 333))
+    col3 = column_from_arrow(f, T.FLOAT64, 512)
+    np.testing.assert_allclose(np.asarray(col3.data)[:333],
+                               np.linspace(0, 1, 333), rtol=0)
+
+
+def test_nullable_columns_skip_fast_path():
+    arr = pa.array([1, None, 3], pa.int64())
+    col_ = column_from_arrow(arr, T.INT64, 16)
+    assert col_.validity is not None
+    v = np.asarray(col_.validity)[:3]
+    np.testing.assert_array_equal(v, [True, False, True])
+
+
+def test_record_batch_roundtrip_with_fast_path(rng):
+    rb = pa.RecordBatch.from_pydict({
+        "a": pa.array(rng.integers(0, 100, 500)),
+        "b": pa.array(rng.random(500)),
+    })
+    cb = batch_from_arrow(rb)
+    d = cb.to_numpy()
+    np.testing.assert_array_equal(np.asarray(d["a"]),
+                                  rb.column(0).to_numpy())
+    np.testing.assert_allclose(np.asarray(d["b"]), rb.column(1).to_numpy())
