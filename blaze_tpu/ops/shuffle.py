@@ -153,8 +153,8 @@ class ShuffleWriterExec(Operator):
     def execute(self, ctx: ExecContext) -> BatchStream:
         from blaze_tpu.runtime import memory as M
 
-        state = _WriterBuffers(self.partitioning.num_partitions,
-                               M.get_manager(ctx))
+        state = _make_writer_state(self.partitioning.num_partitions,
+                                   M.get_manager(ctx))
         keys_jit = not any(ir.contains_host_fn(e)
                            for e in self.partitioning.key_exprs)
         is_rr = self.partitioning.kind == "round_robin"
@@ -189,26 +189,70 @@ class ShuffleWriterExec(Operator):
                             state.push(p, serde.serialize_slice(
                                 hb, int(offs[p]), int(offs[p + 1])))
             with self.metrics.timer():
-                lengths = self._commit(state)
+                os.makedirs(os.path.dirname(self.data_path) or ".",
+                            exist_ok=True)
+                lengths = state.commit(self.data_path, self.index_path)
             self.metrics.add("shuffle_bytes_written", int(sum(lengths)))
             self.metrics.add("spill_count", state.spill_chunks)
         finally:
             state.close()
         return iter(())
 
-    def _commit(self, state: "_WriterBuffers") -> List[int]:
-        lengths = []
-        os.makedirs(os.path.dirname(self.data_path) or ".", exist_ok=True)
-        with open(self.data_path, "wb") as f:
-            for p in range(self.partitioning.num_partitions):
-                start = f.tell()
-                for chunk in state.drain(p):
-                    f.write(chunk)
-                lengths.append(f.tell() - start)
-        offsets = np.concatenate([[0], np.cumsum(lengths)]).astype("<u8")
-        with open(self.index_path, "wb") as f:
-            f.write(offsets.tobytes())
-        return lengths
+
+def _make_writer_state(num_partitions: int, manager):
+    """Choose the map-output writer backend: the C++ bn_shuffle_* writer
+    (budgeted buffers, spill, native .data/.index commit — one Python loop
+    fewer on the hot path) when the native library is loaded, else the
+    Python buffers. Both honor the MemConsumer protocol and produce
+    byte-identical files."""
+    from blaze_tpu import native
+
+    if native.available():
+        try:
+            return _NativeWriterState(num_partitions, manager)
+        except Exception:  # noqa: BLE001 — never fail a query over this
+            pass
+    return _WriterBuffers(num_partitions, manager)
+
+
+class _NativeWriterState:
+    """MemConsumer adapter over native.NativeShuffleWriter (bn_shuffle_*)."""
+
+    name = "shuffle_writer"
+
+    def __init__(self, num_partitions: int, manager) -> None:
+        from blaze_tpu import native
+        from blaze_tpu.config import conf as _conf
+
+        os.makedirs(_conf.spill_dir, exist_ok=True)
+        self._w = native.NativeShuffleWriter(
+            num_partitions, spill_dir=_conf.spill_dir,
+            mem_budget=1 << 62)  # the MemManager drives spilling, not C++
+        self.manager = manager
+        self.spill_chunks = 0
+        manager.register(self)
+
+    def mem_used(self) -> int:
+        return int(self._w.mem_used())
+
+    def spill(self) -> int:
+        before = self.mem_used()
+        if before == 0:
+            return 0
+        self._w.spill()
+        self.spill_chunks += 1
+        return before - self.mem_used()
+
+    def push(self, p: int, frame: bytes) -> None:
+        self._w.push(p, frame)
+        self.manager.update_mem_used(self)
+
+    def commit(self, data_path: str, index_path: str) -> List[int]:
+        return list(self._w.commit(data_path, index_path))
+
+    def close(self) -> None:
+        self.manager.unregister(self)
+        self._w.close()
 
 
 class _WriterBuffers:
@@ -269,6 +313,19 @@ class _WriterBuffers:
             yield self._spill_fp.read(ln)
         for chunk in self.buffers[p]:
             yield chunk
+
+    def commit(self, data_path: str, index_path: str) -> List[int]:
+        lengths = []
+        with open(data_path, "wb") as f:
+            for p in range(self.P):
+                start = f.tell()
+                for chunk in self.drain(p):
+                    f.write(chunk)
+                lengths.append(f.tell() - start)
+        offsets = np.concatenate([[0], np.cumsum(lengths)]).astype("<u8")
+        with open(index_path, "wb") as f:
+            f.write(offsets.tobytes())
+        return lengths
 
     def close(self) -> None:
         self.manager.unregister(self)
@@ -371,7 +428,17 @@ class IpcReaderExec(Operator):
 
     def execute(self, ctx: ExecContext) -> BatchStream:
         def gen():
-            source = _call_provider(resources.get(self.resource_id), ctx)
+            # the node's num_partitions is authoritative: it is the count
+            # the stream was WRITTEN with (providers that fan work out by
+            # partition — e.g. the fallback scan split — must see it even
+            # when the local ctx defaults to 1)
+            eff_ctx = ctx
+            if self.num_partitions and \
+                    self.num_partitions != ctx.num_partitions:
+                eff_ctx = dataclasses.replace(
+                    ctx, num_partitions=self.num_partitions)
+            source = _call_provider(resources.get(self.resource_id),
+                                    eff_ctx)
             for seg in source:
                 ctx.check_running()
                 if isinstance(seg, ColumnBatch):

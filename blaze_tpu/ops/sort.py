@@ -138,6 +138,12 @@ class ExternalSorter:
             r.close()
 
     # -- k-way merge of sorted runs --
+    # The reference merges with a per-ROW LoserTree over run cursors
+    # (loser_tree.rs, sort_exec.rs:419-475) because its cursors step one
+    # row at a time. This merge works at BATCH granularity — the head-min
+    # scan below is O(k) per pooled batch, amortized over thousands of
+    # rows, so a tournament tree would shave an already-negligible cost;
+    # the per-row work happens on device in _split_leq.
     def _head_key(self, batch: ColumnBatch, row: int) -> tuple:
         import numpy as np
 

@@ -44,10 +44,12 @@ def execute_fused(op: MapLikeOp, ctx: ExecContext) -> BatchStream:
         fns = [c.make_batch_fn() for c in chain]
 
         def fused(batch: ColumnBatch) -> ColumnBatch:
-            # one CSE scope per chain invocation: shared subexpressions
-            # across the chain's operators evaluate once
-            with cse_scope():
-                for fn in fns:
+            # one CSE scope PER OP: shared subexpressions within an op
+            # evaluate once; a chain-wide scope would retain every
+            # intermediate batch in the memo until the chain ends (ops
+            # build fresh batches, so cross-op hits can't happen anyway)
+            for fn in fns:
+                with cse_scope():
                     batch = fn(batch)
             return batch
 

@@ -202,9 +202,11 @@ def try_run_stage(root: Operator, ctx: ExecContext
             the batch's (uncompacted) rows."""
             from blaze_tpu.exprs.compiler import cse_scope
 
-            with cse_scope():
-                mask = b.row_mask()
-                for kind, fn in steps:
+            mask = b.row_mask()
+            for kind, fn in steps:
+                # scope per step: dedups within one op's expressions
+                # without retaining superseded intermediate batches
+                with cse_scope():
                     if kind == "map":
                         b = fn(b)
                     else:

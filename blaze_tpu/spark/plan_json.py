@@ -307,7 +307,22 @@ _CMP_OPS = {ir.BinOp.EQ, ir.BinOp.NEQ, ir.BinOp.LT, ir.BinOp.LE,
             ir.BinOp.GT, ir.BinOp.GE, ir.BinOp.EQ_NULLSAFE,
             ir.BinOp.AND, ir.BinOp.OR}
 
-_NUM_RANK = [T.INT8, T.INT16, T.INT32, T.INT64, T.FLOAT32, T.FLOAT64]
+
+def _promote(lt: T.DataType, rt: T.DataType) -> T.DataType:
+    """MIRROR the runtime's arithmetic dtype (exprs/compiler._arith uses
+    jnp.promote_types): int+float -> FLOAT64, not the wider operand. A
+    declared dtype that disagrees with the executed column corrupts
+    shuffle-frame decode at the next stage boundary."""
+    import numpy as np
+
+    try:
+        got = np.promote_types(lt.np_dtype(), rt.np_dtype())
+    except TypeError:
+        return lt
+    for cand in (T.INT8, T.INT16, T.INT32, T.INT64, T.FLOAT32, T.FLOAT64):
+        if np.dtype(cand.np_dtype()) == got:
+            return cand
+    return lt
 
 
 def _infer_dtype(e: ir.Expr, schema: T.Schema) -> T.DataType:
@@ -336,10 +351,7 @@ def _infer_dtype(e: ir.Expr, schema: T.Schema) -> T.DataType:
             return lt if lt.kind == T.TypeKind.DECIMAL else T.FLOAT64
         lt = _infer_dtype(e.left, schema)
         rt = _infer_dtype(e.right, schema)
-        for cand in reversed(_NUM_RANK):
-            if lt == cand or rt == cand:
-                return cand
-        return lt
+        return _promote(lt, rt)
     if isinstance(e, ir.If):
         return _infer_dtype(e.then, schema)
     if isinstance(e, ir.CaseWhen) and e.branches:
@@ -443,10 +455,8 @@ def _decode_node(node: dict) -> SparkPlan:
             exprs.append(e)
             names.append(_attr_name(tree.get("exprId")))
             if _cls(tree) == "Alias":
-                dt = tree.get("dataType")
-                dtype = (decode_datatype(dt) if dt is not None
-                         else _infer_dtype(e, child.schema))
-                fields.append(T.Field(names[-1], dtype, True))
+                fields.append(T.Field(
+                    names[-1], _alias_dtype(tree, e, child.schema), True))
             else:
                 fields.append(_attr_field(tree))
         return SparkPlan("ProjectExec", T.Schema(fields), [child],
@@ -536,13 +546,18 @@ def _decode_node(node: dict) -> SparkPlan:
     raise PlanJsonError(f"plan node {cls} not supported")
 
 
-def _alias_dtype(tree: dict, e: ir.Expr) -> T.DataType:
+def _alias_dtype(tree: dict, e: ir.Expr,
+                 schema: Optional[T.Schema] = None) -> T.DataType:
+    """Declared dataType when decodable, else inference against the child
+    schema, else the expression's own carried dtype."""
     dt = tree.get("dataType")
     if dt is not None:
         try:
             return decode_datatype(dt)
         except PlanJsonError:
             pass
+    if schema is not None:
+        return _infer_dtype(e, schema)
     return _guess_dtype(e)
 
 
