@@ -47,6 +47,10 @@ class BlazeConf:
     # dense grouped-agg key range for the MXU one-hot path (<= 2^16:
     # 256x256 byte decomposition); stages whose keys exceed it fall back
     dense_agg_range: int = 1 << 16
+    # AQE dynamic join selection: a planned SMJ whose shuffled input came
+    # in under this many bytes becomes a broadcast join (Spark's
+    # autoBroadcastJoinThreshold analog; 0 disables)
+    aqe_broadcast_threshold: int = 10 << 20
     # per-operator enable flags (tier b, spark.blaze.enable.<op>)
     enable_ops: Dict[str, bool] = dataclasses.field(default_factory=dict)
 

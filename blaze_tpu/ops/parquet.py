@@ -222,15 +222,29 @@ class ParquetSinkExec(Operator):
     def plan_key(self) -> tuple:
         return ("parquet_sink", self.path, self.children[0].plan_key())
 
+    def _task_path(self, ctx: ExecContext) -> str:
+        """Per-task part file (ref: Hive-compatible part files,
+        parquet_sink_exec.rs): a multi-task stage writing ONE path would
+        have every task truncate the previous tasks' rows. With one task
+        the path is used as-is (single-file output)."""
+        if ctx.num_partitions <= 1:
+            return self.path
+        import os as _os
+
+        _os.makedirs(self.path, exist_ok=True)
+        return _os.path.join(self.path,
+                             f"part-{ctx.partition:05d}.parquet")
+
     def execute(self, ctx: ExecContext) -> BatchStream:
         def gen():
             child = self.children[0]
             arrow_schema = schema_to_arrow(child.schema)
-            sink = self.path
+            out_path = self._task_path(ctx)
+            sink = out_path
             if self.fs_resource_id:
                 fs = resources.get(self.fs_resource_id)
-                sink = fs(self.path) if callable(fs) else fs.open(self.path,
-                                                                  "wb")
+                sink = fs(out_path) if callable(fs) else fs.open(out_path,
+                                                                 "wb")
             compression = self.props.get("compression", "zstd")
             writer = pq.ParquetWriter(sink, arrow_schema,
                                       compression=compression)
@@ -250,12 +264,12 @@ class ParquetSinkExec(Operator):
                     sink.close()
             import os
 
-            nbytes = (os.path.getsize(self.path)
-                      if not self.fs_resource_id and os.path.exists(self.path)
+            nbytes = (os.path.getsize(out_path)
+                      if not self.fs_resource_id and os.path.exists(out_path)
                       else 0)
             self.metrics.add("output_rows_written", rows)
             yield ColumnBatch.from_numpy(
-                {"path": [self.path], "num_rows": np.array([rows], np.int64),
+                {"path": [out_path], "num_rows": np.array([rows], np.int64),
                  "num_bytes": np.array([nbytes], np.int64)},
                 self.STATS_SCHEMA)
 

@@ -57,7 +57,8 @@ def mesh_key_indices(writer: pb.ShuffleWriterNode,
 
 def run_mesh_shuffle_stage(stage_plan: pb.PlanNode, stage_id: int,
                            ntasks: int, quota: Optional[int] = None,
-                           work_dir: Optional[str] = None) -> bool:
+                           work_dir: Optional[str] = None,
+                           stats: Optional[dict] = None) -> bool:
     """Execute one shuffle_map stage's exchange over the device mesh.
 
     STREAMS: each map-output batch is exchanged as it is produced — the
@@ -179,5 +180,20 @@ def run_mesh_shuffle_stage(stage_plan: pb.PlanNode, stage_id: int,
         for data, index in file_outputs:
             yield from read_shuffle_partition(data, index, partition, schema)
 
+    if stats is not None:
+        import os as _os
+
+        from blaze_tpu.runtime.memory import batch_nbytes
+
+        # live-row-scaled logical bytes: batch_nbytes counts the padded
+        # capacity bucket, which would bias the AQE threshold vs the file
+        # path's on-disk measure
+        total = 0
+        for parts in recv_parts:
+            for b in parts:
+                cap = max(b.capacity, 1)
+                total += batch_nbytes(b) * int(b.num_rows) // cap
+        total += sum(_os.path.getsize(d) for d, _ in file_outputs)
+        stats["bytes"] = int(total)
     resources.put(f"shuffle:{stage_id}", provider)
     return True

@@ -34,6 +34,11 @@ def get(key: str) -> Any:
         return _resources[key]
 
 
+def try_get(key: str) -> Any:
+    with _lock:
+        return _resources.get(key)
+
+
 def pop(key: str) -> Any:
     with _lock:
         return _resources.pop(key, None)

@@ -322,9 +322,68 @@ def _convert_bhj(plan: SparkPlan) -> pb.PlanNode:
     j.build_is_left = plan.attrs.get("build_side", "right") == "left"
     cond = plan.attrs.get("condition")
     if cond is not None:
-        if plan.attrs["join_type"] != "inner":
-            raise ConversionError("BHJ filter on outer join not supported")
+        # non-inner residual filters run natively (_join_batch_filtered)
+        # behind the same conf gate as SMJ (ref BlazeConf.java:35)
+        if plan.attrs["join_type"] != "inner" \
+                and not conf.enable_smj_inequality_join:
+            raise ConversionError(
+                "join condition on non-inner BHJ disabled "
+                "(spark.blaze.enable.smjInequalityJoin)")
         j.join_filter.CopyFrom(encode_expr(cond))
+    return node
+
+
+def _is_broadcast_child(child: SparkPlan) -> bool:
+    if child.kind == "BroadcastExchangeExec":
+        return True
+    rid = child.attrs.get("resource_id", "")
+    return child.kind == "__IpcReader" and rid.startswith("broadcast:")
+
+
+def _convert_bnlj(plan: SparkPlan) -> pb.PlanNode:
+    """Ref convertBroadcastNestedLoopJoinExec (BlazeConverters.scala:470).
+
+    A broadcast child on the join's PRESERVED side cannot convert: every
+    task sees the whole broadcast relation, so per-task unmatched emission
+    would duplicate its rows across tasks. cross == inner with no keys."""
+    jt = plan.attrs["join_type"]
+    lcast = _is_broadcast_child(plan.children[0])
+    rcast = _is_broadcast_child(plan.children[1])
+    if jt in ("left", "left_semi", "left_anti", "existence") and lcast:
+        raise ConversionError("broadcast LEFT side of a left-preserving "
+                              "BNLJ would duplicate per task")
+    if jt == "right" and rcast:
+        raise ConversionError("broadcast RIGHT side of a right-preserving "
+                              "BNLJ would duplicate per task")
+    if jt == "full" and (lcast or rcast):
+        raise ConversionError("FULL BNLJ preserves both sides")
+    node = pb.PlanNode()
+    j = node.broadcast_nested_loop_join
+    j.left.CopyFrom(_child(plan, 0))
+    j.right.CopyFrom(_child(plan, 1))
+    j.join_type = _JOIN_TYPE["inner" if jt == "cross" else jt]
+    cond = plan.attrs.get("condition")
+    if cond is not None:
+        j.condition.CopyFrom(encode_expr(cond))
+    return node
+
+
+def _convert_parquet_insert(plan: SparkPlan) -> pb.PlanNode:
+    """Ref convertDataWritingCommandExec (BlazeConverters.scala:774 — Hive
+    parquet insert only)."""
+    if plan.attrs.get("format", "parquet") != "parquet":
+        raise ConversionError("only parquet writes convert (ref :774)")
+    node = pb.PlanNode()
+    sk = node.parquet_sink
+    sk.input.CopyFrom(_child(plan))
+    sk.path = plan.attrs["path"]
+    if plan.attrs.get("fs_resource_id"):
+        sk.fs_resource_id = plan.attrs["fs_resource_id"]
+    if plan.attrs.get("row_group_rows"):
+        sk.row_group_rows = plan.attrs["row_group_rows"]
+    for k, v in (plan.attrs.get("props") or {}).items():
+        kv = sk.props.add()
+        kv.key, kv.value = str(k), str(v)
     return node
 
 
@@ -438,4 +497,7 @@ _CONVERTERS: Dict[str, Callable[[SparkPlan], pb.PlanNode]] = {
     "UnionExec": _convert_union,
     "ExpandExec": _convert_expand,
     "GenerateExec": _convert_generate,
+    "BroadcastNestedLoopJoinExec": _convert_bnlj,
+    "DataWritingCommandExec": _convert_parquet_insert,
+    "InsertIntoHadoopFsRelationCommand": _convert_parquet_insert,
 }

@@ -66,21 +66,43 @@ def run_plan(root: SparkPlan, num_partitions: int = 4,
 
     # stage -> map outputs [(data, index)] for shuffle; frames for broadcast
     shuffle_outputs: Dict[int, List[tuple]] = {}
+    # AQE statistics: completed shuffles' total bytes + partition counts
+    shuffle_bytes: Dict[int, int] = {}
+    shuffle_parts: Dict[int, int] = {}
+
+    from blaze_tpu.spark.aqe import apply_dynamic_join_selection
 
     try:
         for stage in stages:
+            # re-optimize THIS stage with the statistics of completed
+            # shuffles before running it (ref: AQE per-stage re-entry)
+            if shuffle_bytes:
+                n = apply_dynamic_join_selection(stage.plan, shuffle_bytes,
+                                                 shuffle_parts)
+                if n:
+                    import logging
+
+                    logging.getLogger(__name__).info(
+                        "AQE: converted %d SMJ(s) to broadcast join "
+                        "(stage %d)", n, stage.stage_id)
             if stage.kind == "shuffle_map":
+                shuffle_parts[stage.stage_id] = stage.num_partitions
                 if mesh_exchange == "auto":
                     from blaze_tpu.parallel.stage_exchange import (
                         run_mesh_shuffle_stage,
                     )
 
+                    stats: Dict[str, int] = {}
                     if run_mesh_shuffle_stage(
                             stage.plan, stage.stage_id,
                             _input_tasks(stage, stages), quota=mesh_quota,
-                            work_dir=work_dir):
+                            work_dir=work_dir, stats=stats):
+                        shuffle_bytes[stage.stage_id] = stats.get("bytes", 0)
                         continue
                 _run_shuffle_stage(stage, stages, work_dir, shuffle_outputs)
+                shuffle_bytes[stage.stage_id] = sum(
+                    os.path.getsize(d) for d, _ in
+                    shuffle_outputs.get(stage.stage_id, []))
             elif stage.kind == "broadcast":
                 _run_broadcast_stage(stage)
             else:
@@ -97,6 +119,7 @@ def run_plan(root: SparkPlan, num_partitions: int = 4,
             resources.pop(rid)
         for stage in stages:
             for key in (f"shuffle:{stage.stage_id}",
+                        f"shuffle:{stage.stage_id}:all",
                         f"broadcast:{stage.stage_id}",
                         f"broadcast_sink:{stage.stage_id}"):
                 resources.pop(key)

@@ -101,6 +101,19 @@ def bhj(left: SparkPlan, right: SparkPlan, left_keys, right_keys,
                       "condition": condition})
 
 
+def bnlj(left: SparkPlan, right: SparkPlan, join_type: str,
+         schema: Schema, condition: Optional[ir.Expr] = None) -> SparkPlan:
+    return SparkPlan("BroadcastNestedLoopJoinExec", schema, [left, right],
+                     {"join_type": join_type, "condition": condition})
+
+
+def parquet_insert(child: SparkPlan, path: str,
+                   props: Optional[dict] = None) -> SparkPlan:
+    return SparkPlan("DataWritingCommandExec", child.schema, [child],
+                     {"format": "parquet", "path": path,
+                      "props": props or {}})
+
+
 def hash_agg(child: SparkPlan, mode: str, grouping: Sequence[ir.Expr],
              grouping_names: Sequence[str], aggs: Sequence[dict],
              schema: Schema) -> SparkPlan:

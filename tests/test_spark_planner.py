@@ -328,3 +328,61 @@ def test_fallback_join_and_window_execute(tables):
     assert set(got) == set(int(k) for k in want.index)
     for k, v in want.items():
         np.testing.assert_allclose(got[int(k)], v, rtol=1e-9)
+
+
+def test_bnlj_and_parquet_insert_convert(tables, tmp_path):
+    """BNLJ and parquet-insert converters lower natively (coverage rows
+    VERDICT r2 #3): cross join with condition + write-back to parquet."""
+    import pyarrow.parquet as pq2
+
+    ss, dd, ss_path, dd_path = tables
+    ss_scan = P.scan(SS_SCHEMA, [(ss_path, [])])
+    dd_scan = P.scan(DD_SCHEMA, [(dd_path, [])])
+    dd_small = P.filter_(dd_scan, ir.Binary(ir.BinOp.LE, ir.col("d_date_sk"),
+                                            ir.lit(2)))
+    jschema = T.Schema(list(SS_SCHEMA.fields) + list(DD_SCHEMA.fields))
+    j = P.bnlj(ss_scan, P.broadcast_exchange(dd_small), "inner", jschema,
+               condition=ir.Binary(ir.BinOp.EQ, ir.col("ss_sold_date_sk"),
+                                   ir.col("d_date_sk")))
+    out_path = str(tmp_path / "out.parquet")
+    sink = P.parquet_insert(j, out_path)
+    from blaze_tpu.spark.convert_strategy import apply_strategy
+    apply_strategy(sink)
+    assert sink.convertible and j.convertible
+    run_plan(sink, num_partitions=1)
+
+    written = pq2.read_table(out_path).to_pandas()
+    ssd, ddd = ss.to_pandas(), dd.to_pandas()
+    want = ssd.merge(ddd[ddd.d_date_sk <= 2], how="cross")
+    want = want[want.ss_sold_date_sk == want.d_date_sk]
+    assert len(written) == len(want)
+
+
+def test_parquet_insert_multi_task_part_files(tables, tmp_path):
+    """A sink fed by a 4-way shuffle writes per-task part files (one path
+    would be truncated by each task); reading the directory returns every
+    partition's rows."""
+    import pyarrow.parquet as pq2
+
+    ss, dd, ss_path, dd_path = tables
+    sc = P.scan(SS_SCHEMA, [(ss_path, [])])
+    partial = P.hash_agg(sc, "partial", [ir.col("ss_item_sk")], ["item"],
+                         [{"fn": "sum", "args": [ir.col("ss_ext_sales_price")],
+                           "dtype": T.FLOAT64, "name": "s"}],
+                         T.Schema([T.Field("item", T.INT64)]))
+    x = P.shuffle_exchange(partial, [ir.col("item")], 4)
+    final = P.hash_agg(x, "final", [ir.col("ss_item_sk")], ["item"],
+                       [{"fn": "sum", "args": [ir.col("ss_ext_sales_price")],
+                         "dtype": T.FLOAT64, "name": "s"}],
+                       T.Schema([T.Field("item", T.INT64),
+                                 T.Field("s", T.FLOAT64)]))
+    out_dir = str(tmp_path / "agg_out")
+    sink = P.parquet_insert(final, out_dir)
+    run_plan(sink, num_partitions=4)
+
+    written = pq2.read_table(out_dir).to_pandas()
+    want = ss.to_pandas().groupby("ss_item_sk")["ss_ext_sales_price"].sum()
+    assert len(written) == len(want)
+    got = dict(zip(written["item"], written["s"]))
+    for k, v in want.items():
+        np.testing.assert_allclose(got[int(k)], v, rtol=1e-9)
