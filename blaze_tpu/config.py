@@ -51,6 +51,8 @@ class BlazeConf:
     # in under this many bytes becomes a broadcast join (Spark's
     # autoBroadcastJoinThreshold analog; 0 disables)
     aqe_broadcast_threshold: int = 10 << 20
+    # JAX profiler trace output dir ("" disables) — runtime/tracing.py
+    profiler_dir: str = os.environ.get("BLAZE_TPU_PROFILE_DIR", "")
     # per-operator enable flags (tier b, spark.blaze.enable.<op>)
     enable_ops: Dict[str, bool] = dataclasses.field(default_factory=dict)
 

@@ -657,6 +657,8 @@ class BroadcastNestedLoopJoinExec(Operator):
         rf = list(right.schema.fields)
         if join_type in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI):
             fields = lf
+        elif join_type == JoinType.EXISTENCE:
+            fields = lf + [Field("exists", T.BOOLEAN, nullable=False)]
         else:
             def nullable(fs):
                 return [Field(f.name, f.dtype, True) for f in fs]
@@ -697,6 +699,12 @@ class BroadcastNestedLoopJoinExec(Operator):
                     yield self._one_side_nulls(rs, ls.schema, left_side=False)
                 if jt == JoinType.LEFT_ANTI and nl > 0:
                     yield ls.with_columns(self._schema, ls.columns)
+                if jt == JoinType.EXISTENCE and nl > 0:
+                    cols = ls.columns + [Column(
+                        T.BOOLEAN, jnp.zeros((ls.capacity,), jnp.bool_),
+                        None)]
+                    yield ColumnBatch(self._schema, cols, ls.num_rows,
+                                      ls.capacity)
                 return
 
             # every left row matches all right rows — expand the cartesian
@@ -720,6 +728,12 @@ class BroadcastNestedLoopJoinExec(Operator):
                                            lc.columns).compact(keep)
                     if int(part.num_rows):
                         yield part
+                    continue
+                if jt == JoinType.EXISTENCE:
+                    cols = lc.columns + [Column(
+                        T.BOOLEAN, lmatched & lc.row_mask(), None)]
+                    yield ColumnBatch(self._schema, cols, lc.num_rows,
+                                      lc.capacity)
                     continue
                 if out is not None and int(out.num_rows):
                     yield out
@@ -762,7 +776,8 @@ class BroadcastNestedLoopJoinExec(Operator):
         else:
             lmatched = ls.row_mask()
             rmatched = rs.row_mask()
-        if self.join_type in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI):
+        if self.join_type in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI,
+                              JoinType.EXISTENCE):
             return None, lmatched, rmatched
         return (out.with_columns(self._schema, out.columns), lmatched,
                 rmatched)

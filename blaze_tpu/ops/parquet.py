@@ -226,12 +226,27 @@ class ParquetSinkExec(Operator):
         """Per-task part file (ref: Hive-compatible part files,
         parquet_sink_exec.rs): a multi-task stage writing ONE path would
         have every task truncate the previous tasks' rows. With one task
-        the path is used as-is (single-file output)."""
-        if ctx.num_partitions <= 1:
-            return self.path
+        the path is used as-is unless it already IS a part directory.
+
+        Overwrite semantics: task 0 of a local multi-task write clears
+        stale part files (re-running into the same path must not leave a
+        previous run's higher-numbered parts behind). In deployment the
+        embedding layer's output-commit protocol owns this — the
+        reference leans on Hive temp+move semantics the same way
+        (NativeParquetInsertIntoHiveTableBase)."""
+        import glob as _glob
         import os as _os
 
-        _os.makedirs(self.path, exist_ok=True)
+        remote = bool(self.fs_resource_id)
+        if ctx.num_partitions <= 1 and not (
+                not remote and _os.path.isdir(self.path)):
+            return self.path
+        if not remote:
+            _os.makedirs(self.path, exist_ok=True)
+            if ctx.partition == 0:
+                for stale in _glob.glob(
+                        _os.path.join(self.path, "part-*.parquet")):
+                    _os.remove(stale)
         return _os.path.join(self.path,
                              f"part-{ctx.partition:05d}.parquet")
 

@@ -1,0 +1,51 @@
+"""Tracing / profiling hooks (SURVEY §5.4).
+
+The reference's profiling story is per-operator timing metrics surfaced in
+the Spark UI plus DebugExecNode batch logging (debug_exec.rs); it has no
+dedicated tracer. This engine additionally hooks the JAX profiler: set
+`conf.profiler_dir` and every `profiled_scope` (the local runner wraps each
+query; the executor can wrap stages) captures an XLA/TPU trace viewable in
+TensorBoard/Perfetto — device kernel timelines, the thing a CPU engine
+cannot give you.
+
+`metric_report` renders the per-operator metric tree (MetricNode) after a
+run — the textual analog of the reference's metric push into the Spark UI
+(blaze/src/metrics.rs:21-50).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import List
+
+from blaze_tpu.config import conf
+
+
+@contextlib.contextmanager
+def profiled_scope(name: str = "query"):
+    """JAX profiler trace when conf.profiler_dir is set; no-op otherwise."""
+    if not conf.profiler_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(conf.profiler_dir):
+        with jax.profiler.TraceAnnotation(name):
+            yield
+
+
+def metric_report(root) -> str:
+    """Operator tree with its metrics, one line per op (post-run)."""
+    lines: List[str] = []
+
+    def walk(op, depth: int) -> None:
+        vals = {k: v for k, v in op.metrics.values.items() if v}
+        shown = ", ".join(
+            f"{k}={v / 1e6:.1f}ms" if k.endswith("_ns") else f"{k}={v}"
+            for k, v in sorted(vals.items()))
+        lines.append("  " * depth + f"{op.name()}: {shown}")
+        for c in op.children:
+            walk(c, depth + 1)
+
+    walk(root, 0)
+    return "\n".join(lines)

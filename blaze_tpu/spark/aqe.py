@@ -57,9 +57,16 @@ def _all_partitions_resource(rid: str, nparts: int) -> str:
 
 
 def _rewrite_reader(node: pb.PlanNode, all_rid: str) -> None:
+    """Point the build-side subtree at the all-partitions resource AND
+    strip any Sort wrapper — the broadcast join sorts its build side
+    itself, so a retained Sort would re-sort the whole relation once per
+    task for nothing."""
     which = node.WhichOneof("node")
     if which == "sort":
-        _rewrite_reader(node.sort.input, all_rid)
+        inner = pb.PlanNode()
+        inner.CopyFrom(node.sort.input)
+        node.CopyFrom(inner)
+        _rewrite_reader(node, all_rid)
         return
     node.ipc_reader.provider_resource_id = all_rid
 

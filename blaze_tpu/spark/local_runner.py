@@ -48,6 +48,16 @@ def run_plan(root: SparkPlan, num_partitions: int = 4,
     caps the per-device-per-partition staging rows (None = safe default,
     no overflow possible).
     """
+    from blaze_tpu.runtime.tracing import profiled_scope
+
+    with profiled_scope("run_plan"):
+        return _run_plan_inner(root, num_partitions, work_dir,
+                               mesh_exchange, mesh_quota)
+
+
+def _run_plan_inner(root: SparkPlan, num_partitions: int,
+                    work_dir: Optional[str], mesh_exchange: str,
+                    mesh_quota: Optional[int]) -> ColumnBatch:
     apply_strategy(root)
     from blaze_tpu.spark import converters, fallback
 
@@ -99,10 +109,11 @@ def run_plan(root: SparkPlan, num_partitions: int = 4,
                             work_dir=work_dir, stats=stats):
                         shuffle_bytes[stage.stage_id] = stats.get("bytes", 0)
                         continue
-                _run_shuffle_stage(stage, stages, work_dir, shuffle_outputs)
-                shuffle_bytes[stage.stage_id] = sum(
-                    os.path.getsize(d) for d, _ in
-                    shuffle_outputs.get(stage.stage_id, []))
+                logical = _run_shuffle_stage(stage, stages, work_dir,
+                                             shuffle_outputs)
+                # logical (uncompressed) bytes: the mesh path reports the
+                # same unit, so the AQE threshold is transport-independent
+                shuffle_bytes[stage.stage_id] = logical
             elif stage.kind == "broadcast":
                 _run_broadcast_stage(stage)
             else:
@@ -174,9 +185,12 @@ def _register_shuffle_reader(sid: int, outputs: List[tuple], schema) -> None:
 
 
 def _run_shuffle_stage(stage: Stage, stages: List[Stage], work_dir: str,
-                       shuffle_outputs: Dict[int, List[tuple]]) -> None:
+                       shuffle_outputs: Dict[int, List[tuple]]) -> int:
+    """Runs the map tasks; returns the stage's total LOGICAL output bytes
+    (uncompressed, live rows only — the AQE statistic)."""
     ntasks = _input_tasks(stage, stages)
     outputs = []
+    logical = 0
     for task in range(ntasks):
         node = pb.PlanNode()
         node.CopyFrom(stage.plan)
@@ -189,6 +203,7 @@ def _run_shuffle_stage(stage: Stage, stages: List[Stage], work_dir: str,
         op = decode_plan(node)
         list(execute_plan(op, ExecContext(partition=task,
                                           num_partitions=ntasks)))
+        logical += op.metrics.values.get("shuffle_logical_bytes", 0)
         outputs.append((data, index))
     shuffle_outputs[stage.stage_id] = outputs
 
@@ -198,6 +213,7 @@ def _run_shuffle_stage(stage: Stage, stages: List[Stage], work_dir: str,
     # the reader schema is the writer's input schema
     reader_schema = decode_plan(stage.plan.shuffle_writer.input).schema
     _register_shuffle_reader(stage.stage_id, outputs, reader_schema)
+    return logical
 
 
 def _run_broadcast_stage(stage: Stage) -> None:

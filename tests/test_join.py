@@ -284,3 +284,23 @@ def test_bnlj_chunked_expansion(rng):
         np.testing.assert_allclose(got_sum, want["lv"].sum(), rtol=1e-9)
     finally:
         conf.batch_size = old
+
+
+def test_bnlj_existence(rng):
+    """BNLJ EXISTENCE: left rows + exists flag from condition matches."""
+    left = _mk(LS, [1, 2, 3], [0.1, 0.9, 0.5])
+    right = _mk(RS, [7, 8], [0.45, 0.2])
+    cond = ir.Binary(ir.BinOp.LT, ir.col("lv"), ir.col("rv"))
+    j = BroadcastNestedLoopJoinExec(
+        MemorySourceExec([left], LS), MemorySourceExec([right], RS),
+        JoinType.EXISTENCE, condition=cond)
+    out = collect(j).to_numpy()
+    # lv=0.1 < 0.45 -> True; 0.9 -> False; 0.5 -> False (0.45, 0.2 both <=)
+    by = dict(zip(np.asarray(out["lk"]), np.asarray(out["exists"])))
+    assert by == {1: True, 2: False, 3: False}
+    # empty right side: all False
+    j2 = BroadcastNestedLoopJoinExec(
+        MemorySourceExec([left], LS), MemorySourceExec([], RS),
+        JoinType.EXISTENCE, condition=cond)
+    out2 = collect(j2).to_numpy()
+    assert list(np.asarray(out2["exists"])) == [False, False, False]
