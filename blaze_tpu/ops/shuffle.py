@@ -181,12 +181,10 @@ class ShuffleWriterExec(Operator):
                     sb, counts = fn(batch, jnp.asarray(row_offset,
                                                        jnp.int64))
                     row_offset += int(batch.num_rows)
-                    from blaze_tpu.runtime.memory import batch_nbytes
-
                     cap = max(batch.capacity, 1)
                     self.metrics.add(
                         "shuffle_logical_bytes",
-                        batch_nbytes(batch) * int(batch.num_rows) // cap)
+                        M.batch_nbytes(batch) * int(batch.num_rows) // cap)
                     hb = serde.to_host(sb)
                     counts = np.asarray(counts)
                     offs = np.concatenate([[0], np.cumsum(counts)])

@@ -62,8 +62,8 @@ def _match(root: Operator):
     if (len(final.group_exprs) != len(partial.group_exprs)
             or [c.fn for c in final.aggs] != [c.fn for c in partial.aggs]):
         return None
-    if len(partial.group_exprs) != 1:
-        return None
+    if not (1 <= len(partial.group_exprs) <= 4):
+        return None  # composite keys pack into one dense range (below)
     for call in partial.aggs:
         if call.fn not in _AGG_FNS or len(call.inputs) != 1:
             return None
@@ -99,8 +99,8 @@ def try_run_stage(root: Operator, ctx: ExecContext
         return None
     final, partial, chain, source = m
 
-    gdtype = partial._group_fields[0].dtype
-    if gdtype.kind not in _GROUP_KINDS:
+    gdtypes = [f.dtype for f in partial._group_fields]
+    if any(dt.kind not in _GROUP_KINDS for dt in gdtypes):
         return None
 
     batches = list(source.execute(ctx))
@@ -117,10 +117,13 @@ def try_run_stage(root: Operator, ctx: ExecContext
 
     max_R = int(conf.dense_agg_range)
 
+    nkeys = len(partial.group_exprs)
+
     def make_probe():
-        """Pass 1: key min/max + null check (cheap, no matmuls). Its own
-        dispatch so the accumulation program can be compiled for the
-        SMALLEST dense range bucket that fits the observed keys."""
+        """Pass 1: per-key min/max + null check (cheap, no matmuls). Its
+        own dispatch so the accumulation program can be compiled for the
+        SMALLEST dense range that fits the observed keys (composite keys
+        pack into one index: k = sum_i (k_i - min_i) * stride_i)."""
         from blaze_tpu.ops.basic import FilterExec
 
         steps = []
@@ -129,11 +132,11 @@ def try_run_stage(root: Operator, ctx: ExecContext
                 steps.append(("mask", list(op._fns)))
             else:
                 steps.append(("map", op.make_batch_fn()))
-        group_fn = partial._group_fns[0]
+        group_fns = list(partial._group_fns)
 
         def run(stacked):
             def min_step(carry, b):
-                kmin, kmax, bad = carry
+                kmins, kmaxs, bad = carry
                 mask = b.row_mask()
                 for kind, fn in steps:
                     if kind == "map":
@@ -143,21 +146,24 @@ def try_run_stage(root: Operator, ctx: ExecContext
                             c = pf(b)
                             mask = mask & c.data.astype(jnp.bool_) & \
                                 c.valid_mask()
-                g = group_fn(b)
-                bad = bad | jnp.any(mask & ~g.valid_mask())
-                k = g.data.astype(jnp.int64)
-                ok = mask & g.valid_mask()
-                klo = jnp.where(ok, k, jnp.int64(2 ** 62))
-                khi = jnp.where(ok, k, jnp.int64(-2 ** 62))
-                return (jnp.minimum(kmin, jnp.min(klo)),
-                        jnp.maximum(kmax, jnp.max(khi)), bad), None
+                nmins, nmaxs = [], []
+                for i, gfn in enumerate(group_fns):
+                    g = gfn(b)
+                    bad = bad | jnp.any(mask & ~g.valid_mask())
+                    k = g.data.astype(jnp.int64)
+                    ok = mask & g.valid_mask()
+                    klo = jnp.where(ok, k, jnp.int64(2 ** 62))
+                    khi = jnp.where(ok, k, jnp.int64(-2 ** 62))
+                    nmins.append(jnp.minimum(kmins[i], jnp.min(klo)))
+                    nmaxs.append(jnp.maximum(kmaxs[i], jnp.max(khi)))
+                return (nmins, nmaxs, bad), None
 
-            (kmin, kmax, bad), _ = jax.lax.scan(
-                min_step, (jnp.int64(2 ** 62), jnp.int64(-2 ** 62),
-                           jnp.array(False)), stacked)
-            kmin = jnp.where(kmin == 2 ** 62, 0, kmin)
-            kmax = jnp.where(kmax == -2 ** 62, 0, kmax)
-            return kmin, kmax, bad
+            init = ([jnp.int64(2 ** 62)] * nkeys,
+                    [jnp.int64(-2 ** 62)] * nkeys, jnp.array(False))
+            (kmins, kmaxs, bad), _ = jax.lax.scan(min_step, init, stacked)
+            kmins = [jnp.where(m == 2 ** 62, 0, m) for m in kmins]
+            kmaxs = [jnp.where(m == -2 ** 62, 0, m) for m in kmaxs]
+            return jnp.stack(kmins), jnp.stack(kmaxs), bad
 
         return run
 
@@ -167,20 +173,39 @@ def try_run_stage(root: Operator, ctx: ExecContext
     # and the in-program oob flag catches data drifting past the memoized
     # R, triggering a re-probe).
     memo_key = ("stage_R", root.plan_key(), shape0)
-    R = _R_MEMO.get(memo_key)
-    if R is None:
+
+    def probe_spans():
+        import numpy as np
+
         probe = jit_cache.get_or_compile(
             ("stage_probe", root.plan_key(), shape0, len(batches)),
             make_probe)
-        kmin_v, kmax_v, bad_v = probe(stacked)
-        kmin_host, kmax_host = int(kmin_v), int(kmax_v)
-        if bool(bad_v) or (kmax_host - kmin_host + 1) > max_R:
-            return _fallback(root, batches, source, ctx)
-        R = 512
-        while R < kmax_host - kmin_host + 1:
-            R <<= 1
-        _R_MEMO[memo_key] = R
-    key = ("stage", root.plan_key(), shape0, len(batches), R)
+        kmins_v, kmaxs_v, bad_v = probe(stacked)
+        if bool(bad_v):
+            return None  # null grouping keys: dense slots can't hold them
+        spans = []
+        for lo, hi in zip(np.asarray(kmins_v), np.asarray(kmaxs_v)):
+            # power-of-two headroom per key: exact spans would invalidate
+            # the memo on ANY later dataset with one new key value (the
+            # padding only wastes dense slots; packing and unpacking use
+            # the same spans so correctness is unaffected)
+            span, bucket = max(int(hi) - int(lo) + 1, 1), 8
+            while bucket < span:
+                bucket <<= 1
+            spans.append(bucket)
+        total = 1
+        for sp in spans:
+            total *= sp
+        # keep the TOTAL dense range at >= 512 by widening the last span:
+        # tiny observed ranges would otherwise memoize tiny buckets and pay
+        # a wasted dispatch + re-probe + recompile every time later data
+        # crosses a bucket (the old single-key floor)
+        while total < 512:
+            spans[-1] <<= 1
+            total <<= 1
+        if total > max_R:
+            return None
+        return tuple(spans)
 
     def make():
         from blaze_tpu.ops.basic import FilterExec
@@ -192,7 +217,7 @@ def try_run_stage(root: Operator, ctx: ExecContext
                 steps.append(("mask", list(op._fns)))
             else:
                 steps.append(("map", op.make_batch_fn()))
-        group_fn = partial._group_fns[0]
+        group_fns = list(partial._group_fns)
         input_fns = [fns[0] for fns in partial._input_fns]
         calls = partial.aggs
         out_mode_final = final is not None
@@ -230,20 +255,25 @@ def try_run_stage(root: Operator, ctx: ExecContext
             sum_is_float.append(jnp.issubdtype(shp.data.dtype, jnp.floating))
 
         def run(stacked: ColumnBatch):
-            # in-program pass 1: key minimum + null check (elementwise;
-            # cheap next to the matmuls)
+            # in-program pass 1: per-key minimums + null check
+            # (elementwise; cheap next to the matmuls)
             def min_step(carry, b):
-                kmin, bad = carry
+                kmins, bad = carry
                 b, live = apply_chain(b)
-                g = group_fn(b)
-                bad = bad | jnp.any(live & ~g.valid_mask())
-                k = jnp.where(live & g.valid_mask(),
-                              g.data.astype(jnp.int64), jnp.int64(2 ** 62))
-                return (jnp.minimum(kmin, jnp.min(k)), bad), None
+                nmins = []
+                for i, gfn in enumerate(group_fns):
+                    g = gfn(b)
+                    bad = bad | jnp.any(live & ~g.valid_mask())
+                    k = jnp.where(live & g.valid_mask(),
+                                  g.data.astype(jnp.int64),
+                                  jnp.int64(2 ** 62))
+                    nmins.append(jnp.minimum(kmins[i], jnp.min(k)))
+                return (nmins, bad), None
 
-            (kmin, bad0), _ = jax.lax.scan(
-                min_step, (jnp.int64(2 ** 62), jnp.array(False)), stacked)
-            kmin = jnp.where(kmin == 2 ** 62, 0, kmin)
+            (kmins, bad0), _ = jax.lax.scan(
+                min_step, ([jnp.int64(2 ** 62)] * len(group_fns),
+                           jnp.array(False)), stacked)
+            kmins = [jnp.where(m == 2 ** 62, 0, m) for m in kmins]
 
             # pass 2: dense MXU accumulation (oob set when the memoized R
             # no longer covers the data, or keys go null)
@@ -258,12 +288,20 @@ def try_run_stage(root: Operator, ctx: ExecContext
 
             def step(carry, b):
                 b, live = apply_chain(b)
-                g = group_fn(b)
-                k64 = g.data.astype(jnp.int64) - kmin
-                inb = live & g.valid_mask() & (k64 >= 0) & (k64 < R)
-                carry["oob"] = carry["oob"] | jnp.any(
-                    live & g.valid_mask() & ~inb)
-                k = jnp.clip(k64, 0, R - 1).astype(jnp.int32)
+                # composite keys pack into one dense index
+                packed = jnp.zeros((b.capacity,), jnp.int64)
+                inb = live
+                keys_valid = live
+                for i, gfn in enumerate(group_fns):
+                    g = gfn(b)
+                    keys_valid = keys_valid & g.valid_mask()
+                    off = g.data.astype(jnp.int64) - kmins[i]
+                    inb = inb & g.valid_mask() & (off >= 0) & \
+                        (off < spans[i])
+                    packed = packed + jnp.clip(
+                        off, 0, spans[i] - 1) * strides[i]
+                carry["oob"] = carry["oob"] | jnp.any(keys_valid & ~inb)
+                k = jnp.clip(packed, 0, R - 1).astype(jnp.int32)
                 # every aggregate plane rides ONE matmul (mxu_agg
                 # .grouped_multi); non-nullable inputs reuse the presence
                 # plane for their counts (validity is a trace-time
@@ -304,10 +342,14 @@ def try_run_stage(root: Operator, ctx: ExecContext
             # assemble output rows (dense slots -> compacted groups)
             cap = bucket_capacity(R)
             present = carry["presence"] > 0
-            keys_out = (jnp.arange(R, dtype=jnp.int64) + kmin)
             schema = (final or partial)._schema
-            cols = [Column(gdtype, _pad(keys_out.astype(
-                gdtype.jnp_dtype()), cap), None)]
+            slot = jnp.arange(R, dtype=jnp.int64)
+            cols = []
+            for i, gdtype in enumerate(gdtypes):
+                ki = (slot // strides[i]) % spans[i] + kmins[i]
+                cols.append(Column(gdtype,
+                                   _pad(ki.astype(gdtype.jnp_dtype()), cap),
+                                   None))
             for i, call in enumerate(calls):
                 cnt = carry["counts"][i]
                 if call.fn == "count":
@@ -331,13 +373,34 @@ def try_run_stage(root: Operator, ctx: ExecContext
 
         return run
 
-    fn = jit_cache.get_or_compile(key, make)
-    out, oob = fn(stacked)
-    if bool(oob):
-        # data drifted past the memoized range (or null keys appeared):
-        # drop the memo so the next run re-probes, and take the general
-        # path for this one
+    out = oob = None
+    for attempt in (0, 1):
+        spans = _R_MEMO.get(memo_key)
+        if spans is None:
+            spans = probe_spans()
+            if spans is None:  # null keys or range beyond max_R
+                return _fallback(root, batches, source, ctx)
+            _R_MEMO[memo_key] = spans
+        R = 1
+        for sp in spans:
+            R *= sp
+        strides = []
+        acc = 1
+        for sp in reversed(spans):
+            strides.append(acc)
+            acc *= sp
+        strides = list(reversed(strides))
+        key = ("stage", root.plan_key(), shape0, len(batches), spans)
+        fn = jit_cache.get_or_compile(key, make)
+        out, oob = fn(stacked)
+        if not bool(oob):
+            break
+        # data drifted past the memoized range: re-probe once with the
+        # captured batches, then (attempt 2 failing means a race or null
+        # keys) take the general path
         _R_MEMO.pop(memo_key, None)
+        out = None
+    if out is None:
         return _fallback(root, batches, source, ctx)
     for op in (final, partial, *chain):
         op.metrics.add("output_batches", 1)
