@@ -47,6 +47,62 @@ _AGG_FNS = ("sum", "count", "avg")
 _R_MEMO: dict = {}
 
 
+def _walk_chain(node: Operator):
+    """Longest row-aligned map chain below `node` (filters fold as masks —
+    only mask-producing/row-aligned ops may ride a compiled stage).
+    Returns (chain top-down, source below it); chain may be empty."""
+    from blaze_tpu.ops.basic import FilterExec, ProjectExec, RenameColumnsExec
+
+    chain: List[MapLikeOp] = []
+    n = node
+    while isinstance(n, MapLikeOp):
+        if not n.jit_safe() or not isinstance(
+                n, (FilterExec, ProjectExec, RenameColumnsExec)):
+            return None
+        chain.append(n)
+        n = n.child
+    return list(reversed(chain)), n
+
+
+def _build_steps(chain: List[MapLikeOp]):
+    """("mask", predicate fns) | ("map", batch fn) per chain op."""
+    from blaze_tpu.ops.basic import FilterExec
+
+    steps = []
+    for op in chain:
+        if isinstance(op, FilterExec):
+            steps.append(("mask", list(op._fns)))
+        else:
+            steps.append(("map", op.make_batch_fn()))
+    return steps
+
+
+def _apply_steps(steps, b: ColumnBatch):
+    """-> (batch, mask): run the chain with filters folded as a row mask
+    over the (uncompacted) rows; one CSE scope per step."""
+    from blaze_tpu.exprs.compiler import cse_scope
+
+    mask = b.row_mask()
+    for kind, fn in steps:
+        with cse_scope():
+            if kind == "map":
+                b = fn(b)
+            else:
+                for pf in fn:
+                    c = pf(b)
+                    mask = mask & c.data.astype(jnp.bool_) & c.valid_mask()
+    return b, mask
+
+
+def _match_chain(root: Operator):
+    """Agg-less stage: a pure row-aligned map chain over a uniform source.
+    Returns (chain top-down, source) or None."""
+    m = _walk_chain(root)
+    if m is None or not m[0]:
+        return None
+    return m
+
+
 def _match(root: Operator):
     """(final, partial, chain(list, top-down), source) or None."""
     final = None
@@ -71,21 +127,11 @@ def _match(root: Operator):
             return None  # decimal finalize (avg floor-div) not wired yet
     if not getattr(partial, "_work_jit", True):
         return None
-    from blaze_tpu.ops.basic import FilterExec, ProjectExec, RenameColumnsExec
-
-    chain: List[MapLikeOp] = []
-    n = partial.children[0]
-    while isinstance(n, MapLikeOp):
-        if not n.jit_safe():
-            return None
-        # filters are folded as row MASKS (a compaction inside the scanned
-        # program is a 2M-row cumsum per step — vmem-hostile); only
-        # row-aligned ops may ride the chain
-        if not isinstance(n, (FilterExec, ProjectExec, RenameColumnsExec)):
-            return None
-        chain.append(n)
-        n = n.child
-    return final, partial, list(reversed(chain)), n
+    m = _walk_chain(partial.children[0])
+    if m is None:
+        return None
+    chain, n = m
+    return final, partial, chain, n
 
 
 def try_run_stage(root: Operator, ctx: ExecContext
@@ -96,7 +142,10 @@ def try_run_stage(root: Operator, ctx: ExecContext
         return None
     m = _match(root)
     if m is None:
-        return None
+        mc = _match_chain(root)
+        if mc is None:
+            return None
+        return _run_chain_stage(root, mc[0], mc[1], ctx)
     final, partial, chain, source = m
 
     gdtypes = [f.dtype for f in partial._group_fields]
@@ -124,28 +173,13 @@ def try_run_stage(root: Operator, ctx: ExecContext
         own dispatch so the accumulation program can be compiled for the
         SMALLEST dense range that fits the observed keys (composite keys
         pack into one index: k = sum_i (k_i - min_i) * stride_i)."""
-        from blaze_tpu.ops.basic import FilterExec
-
-        steps = []
-        for op in chain:
-            if isinstance(op, FilterExec):
-                steps.append(("mask", list(op._fns)))
-            else:
-                steps.append(("map", op.make_batch_fn()))
+        steps = _build_steps(chain)
         group_fns = list(partial._group_fns)
 
         def run(stacked):
             def min_step(carry, b):
                 kmins, kmaxs, bad = carry
-                mask = b.row_mask()
-                for kind, fn in steps:
-                    if kind == "map":
-                        b = fn(b)
-                    else:
-                        for pf in fn:
-                            c = pf(b)
-                            mask = mask & c.data.astype(jnp.bool_) & \
-                                c.valid_mask()
+                b, mask = _apply_steps(steps, b)
                 nmins, nmaxs = [], []
                 for i, gfn in enumerate(group_fns):
                     g = gfn(b)
@@ -208,38 +242,15 @@ def try_run_stage(root: Operator, ctx: ExecContext
         return tuple(spans)
 
     def make():
-        from blaze_tpu.ops.basic import FilterExec
-
         # filters fold into a row mask instead of compacting (see _match)
-        steps = []
-        for op in chain:
-            if isinstance(op, FilterExec):
-                steps.append(("mask", list(op._fns)))
-            else:
-                steps.append(("map", op.make_batch_fn()))
+        steps = _build_steps(chain)
         group_fns = list(partial._group_fns)
         input_fns = [fns[0] for fns in partial._input_fns]
         calls = partial.aggs
         out_mode_final = final is not None
 
         def apply_chain(b: ColumnBatch):
-            """-> (batch, mask): mask is the surviving-row predicate over
-            the batch's (uncompacted) rows."""
-            from blaze_tpu.exprs.compiler import cse_scope
-
-            mask = b.row_mask()
-            for kind, fn in steps:
-                # scope per step: dedups within one op's expressions
-                # without retaining superseded intermediate batches
-                with cse_scope():
-                    if kind == "map":
-                        b = fn(b)
-                    else:
-                        for pf in fn:
-                            c = pf(b)
-                            mask = mask & c.data.astype(jnp.bool_) & \
-                                c.valid_mask()
-            return b, mask
+            return _apply_steps(steps, b)
 
         def apply_chain_probe(bb):
             return apply_chain(bb)[0]
@@ -414,6 +425,57 @@ def _pad(a: jax.Array, cap: int) -> jax.Array:
         return a
     return jnp.concatenate(
         [a, jnp.zeros((cap - a.shape[0],), a.dtype)])
+
+
+def _run_chain_stage(root: Operator, chain: List[MapLikeOp],
+                     source: Operator, ctx: ExecContext
+                     ) -> Optional[ColumnBatch]:
+    """Agg-less scan→filter→project stage in one dispatch: the chain runs
+    over the stacked batches with filters as masks, all surviving rows
+    flatten-compact into ONE output batch. Output size is the stage's
+    result size, which a collect materializes anyway."""
+    if any(f.dtype.is_nested for f in root.schema.fields):
+        return None  # flatten-compact over stacked list storage: not yet
+        # (checked BEFORE draining the source — a post-drain None would
+        # make the caller re-execute the whole scan)
+
+    batches = list(source.execute(ctx))
+    if not batches:
+        return None
+    shape0 = batches[0].shape_key()
+    if any(b.shape_key() != shape0 for b in batches[1:]):
+        return _fallback(root, batches, source, ctx)
+
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *batches)
+    key = ("stage_chain", root.plan_key(), shape0, len(batches))
+
+    def make():
+        steps = _build_steps(chain)
+
+        def run(stacked: ColumnBatch):
+            def step(_, b):
+                b, mask = _apply_steps(steps, b)
+                return None, (b, mask)
+
+            _, (outs, masks) = jax.lax.scan(step, None, stacked)
+            # flatten (NB, cap) -> (NB*cap) and compact the survivors
+            flat_cols = jax.tree_util.tree_map(
+                lambda x: x.reshape((-1,) + x.shape[2:]), outs.columns)
+            nb, cap = masks.shape
+            flat = ColumnBatch(root.schema, flat_cols,
+                               jnp.asarray(nb * cap, jnp.int32), nb * cap)
+            return flat.compact(masks.reshape(-1))
+
+        return run
+
+    fn = jit_cache.get_or_compile(key, make)
+    out = fn(stacked)
+    for op in chain:
+        op.metrics.add("output_batches", 1)
+    root.metrics.add("output_rows", int(out.num_rows))
+    root.metrics.add("stage_compiled", 1)
+    return out
 
 
 def _fallback(root, batches, source, ctx) -> ColumnBatch:

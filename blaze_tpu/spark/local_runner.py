@@ -230,11 +230,17 @@ def _run_result_stage(stage: Stage, parts: int) -> ColumnBatch:
     NOT the global default: an 8-way repartition read with 4 tasks would
     silently drop half the shuffle partitions."""
     op = decode_plan(stage.plan)
+    from blaze_tpu.runtime.stage_compiler import try_run_stage
+
     batches: List[ColumnBatch] = []
     for p in range(parts):
         op_p = decode_plan(stage.plan)  # fresh operator state per task
-        batches.extend(execute_plan(
-            op_p, ExecContext(partition=p, num_partitions=parts)))
+        task_ctx = ExecContext(partition=p, num_partitions=parts)
+        staged = try_run_stage(op_p, task_ctx)
+        if staged is not None:
+            batches.append(staged)
+            continue
+        batches.extend(execute_plan(op_p, task_ctx))
     if not batches:
         return ColumnBatch.empty(op.schema)
     out = concat_batches(batches, op.schema)

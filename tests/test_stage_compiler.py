@@ -206,3 +206,60 @@ def test_multi_key_grouping(rng):
     for key, (s, c) in got.items():
         np.testing.assert_allclose(s, want.loc[key, "sum"], rtol=1e-9)
         assert c == want.loc[key, "count"]
+
+
+def test_chain_stage_single_dispatch(rng):
+    """Agg-less scan->filter->project runs in one dispatch and matches the
+    streaming executor row-for-row."""
+    batches = _batches(rng, 4, 600)
+    proj_exprs = [col("k"),
+                  ir.Binary(BinOp.MUL, col("v"), ir.Literal(T.FLOAT64, 2.0))]
+    plan = ProjectExec(
+        FilterExec(MemorySourceExec(batches, SCHEMA),
+                   [ir.Binary(BinOp.GE, col("v"),
+                              ir.Literal(T.FLOAT64, 0.0))]),
+        proj_exprs, ["k", "v2"])
+    out = collect(plan)
+    assert plan.metrics["stage_compiled"] == 1
+    got = out.to_numpy()
+
+    plan2 = ProjectExec(
+        FilterExec(MemorySourceExec(batches, SCHEMA),
+                   [ir.Binary(BinOp.GE, col("v"),
+                              ir.Literal(T.FLOAT64, 0.0))]),
+        proj_exprs, ["k", "v2"])
+    conf.enable_stage_compiler = False
+    try:
+        want = collect(plan2).to_numpy()
+    finally:
+        conf.enable_stage_compiler = True
+    np.testing.assert_array_equal(np.asarray(got["k"]),
+                                  np.asarray(want["k"]))
+    np.testing.assert_allclose([float(x) for x in got["v2"]],
+                               [float(x) for x in want["v2"]], rtol=0)
+
+
+def test_chain_stage_string_columns(rng):
+    """String columns flatten-compact correctly through the chain stage."""
+    schema = T.Schema([T.Field("k", T.INT64), T.Field("s", T.STRING)])
+    bs = []
+    for _ in range(3):
+        n = 300
+        bs.append(ColumnBatch.from_numpy({
+            "k": rng.integers(0, 100, n).astype(np.int64),
+            "s": [f"val{i}" for i in rng.integers(0, 50, n)],
+        }, schema))
+    plan = FilterExec(MemorySourceExec(bs, schema),
+                      [ir.Binary(BinOp.LT, col("k"),
+                                 ir.Literal(T.INT64, 50))])
+    out = collect(plan)
+    assert plan.metrics["stage_compiled"] == 1
+    d = out.to_numpy()
+    want_rows = []
+    for b in bs:
+        bd = b.to_numpy()
+        for k, sv in zip(np.asarray(bd["k"]), bd["s"]):
+            if k < 50:
+                want_rows.append((int(k), sv))
+    got_rows = list(zip((int(x) for x in np.asarray(d["k"])), d["s"]))
+    assert sorted(got_rows) == sorted(want_rows)
