@@ -44,6 +44,12 @@ def is_supported(name: str) -> bool:
     return name.lower() in _REGISTRY
 
 
+def registered_names():
+    """All native scalar-fn names (fallback coverage is tested against
+    this, tests/test_fallback_fns.py)."""
+    return sorted(_REGISTRY)
+
+
 # functions evaluated on the host (hostfns.py) — their operators run
 # unjitted (see ir.contains_host_fn / Operator.jit_safe)
 HOST_EVAL_FNS = frozenset({

@@ -38,6 +38,347 @@ def register_python_fn(name: str, fn: Callable[..., np.ndarray]) -> None:
     PYTHON_FNS[name.lower()] = fn
 
 
+# -- default implementations -------------------------------------------------
+# The interpreter must never die on a scalar fn the ENGINE would have
+# handled natively: a NeverConvert parent (e.g. an inconvertible join
+# sibling) drags convertible expressions onto this path with it, so every
+# registry fn (exprs/functions.py) gets a numpy/pandas body here. Spark
+# null semantics: null in -> null out unless noted (concat_ws, coalesce).
+
+
+def _rows(*args):
+    """Broadcast scalars; yield per-row tuples over object arrays."""
+    n = max((len(a) for a in args if isinstance(a, np.ndarray) and a.ndim),
+            default=1)
+    cols = []
+    for a in args:
+        if isinstance(a, np.ndarray) and a.ndim and len(a) == n:
+            cols.append(a)
+        elif isinstance(a, np.ndarray) and a.ndim == 1 and len(a) == 1:
+            cols.append(np.full(n, a[0], object))
+        else:
+            cols.append(np.full(n, a, object))
+    return n, cols
+
+
+def _rowfn(fn):
+    """Lift a per-row python fn to arrays; None/NaN args -> null row."""
+    def wrapped(*args):
+        n, cols = _rows(*args)
+        out = np.empty(n, object)
+        for i in range(n):
+            vals = [c[i] for c in cols]
+            if any(pd.isna(v) for v in vals):
+                out[i] = None
+            else:
+                try:
+                    out[i] = fn(*vals)
+                except Exception:  # noqa: BLE001 - Spark: expr errors -> null
+                    out[i] = None
+        return out
+    return wrapped
+
+
+def _s(v) -> str:
+    return v if isinstance(v, str) else str(v)
+
+
+def _register_default_fns() -> None:
+    import hashlib
+    import zlib
+
+    from blaze_tpu.exprs import hostfns
+
+    reg = register_python_fn
+    for name, np_fn in [
+            ("abs", np.abs), ("sqrt", np.sqrt), ("exp", np.exp),
+            ("sin", np.sin), ("cos", np.cos), ("tan", np.tan),
+            ("asin", np.arcsin), ("acos", np.arccos), ("atan", np.arctan),
+            ("atan2", np.arctan2), ("ln", np.log), ("log", np.log),
+            ("log10", np.log10),
+            ("log2", np.log2), ("signum", np.sign), ("isnan", np.isnan),
+            ("pow", np.power), ("power", np.power)]:
+        reg(name, np_fn)
+    import math
+
+    reg("ceil", _rowfn(lambda a: int(math.ceil(a))))
+    reg("floor", _rowfn(lambda a: int(math.floor(a))))
+    # Spark HALF_UP rounding (numpy rounds half-even)
+    reg("round", lambda a, d=None: _round_half_up(a, d))
+    reg("trunc", _rowfn(lambda a: float(math.trunc(a))))  # numeric, as
+    # the native registry's trunc (exprs/functions.py jnp.trunc)
+    reg("nanvl", lambda a, b: np.where(np.isnan(
+        np.asarray(a, np.float64)), b, a))
+
+    def _coalesce(*args):
+        n, cols = _rows(*args)
+        out = np.full(n, None, object)
+        for c in cols:
+            mask = pd.isna(out)
+            if not mask.any():
+                break
+            out[mask] = np.asarray(c, object)[mask]
+        return out
+    reg("coalesce", _coalesce)
+    reg("nullif", _rowfn(lambda a, b: None if a == b else a))
+    for nm in ("nullifzero", "null_if_zero"):
+        reg(nm, _rowfn(lambda a: None if a == 0 else a))
+
+    # strings (Spark 1-based indexing where applicable)
+    reg("lower", _rowfn(lambda s: _s(s).lower()))
+    reg("upper", _rowfn(lambda s: _s(s).upper()))
+    reg("trim", _rowfn(lambda s: _s(s).strip()))
+    reg("btrim", _rowfn(lambda s, t=None: _s(s).strip(
+        None if t is None else _s(t))))
+    reg("ltrim", _rowfn(lambda s: _s(s).lstrip()))
+    reg("rtrim", _rowfn(lambda s: _s(s).rstrip()))
+    reg("reverse", _rowfn(lambda s: _s(s)[::-1]))
+    reg("initcap", _rowfn(lambda s: " ".join(
+        w[:1].upper() + w[1:].lower() if w else w
+        for w in _s(s).split(" "))))
+    for nm in ("length", "char_length", "character_length"):
+        reg(nm, _rowfn(lambda s: len(_s(s))))
+    reg("bit_length", _rowfn(lambda s: 8 * len(_s(s).encode())))
+    reg("octet_length", _rowfn(lambda s: len(_s(s).encode())))
+    reg("ascii", _rowfn(lambda s: ord(_s(s)[0]) if _s(s) else 0))
+    reg("chr", _rowfn(lambda c: chr(int(c) % 256) if int(c) >= 0 else ""))
+    reg("repeat", _rowfn(lambda s, n: _s(s) * max(int(n), 0)))
+    reg("replace", _rowfn(lambda s, a, b="": _s(s).replace(_s(a), _s(b))))
+    reg("translate", _rowfn(lambda s, frm, to: _s(s).translate(
+        {ord(f): (to[i] if i < len(to) else None)
+         for i, f in enumerate(_s(frm))})))
+    reg("left", _rowfn(lambda s, n: _s(s)[:max(int(n), 0)]))
+    reg("right", _rowfn(lambda s, n: _s(s)[-int(n):] if int(n) > 0 else ""))
+    reg("lpad", _rowfn(lambda s, n, p=" ": _lpad(_s(s), int(n), _s(p))))
+    reg("rpad", _rowfn(lambda s, n, p=" ": _rpad(_s(s), int(n), _s(p))))
+    reg("string_space", _rowfn(lambda n: " " * max(int(n), 0)))
+    reg("substr", _rowfn(lambda s, pos, ln=None: _substr(
+        _s(s), int(pos), None if ln is None else int(ln))))
+    reg("substring", PYTHON_FNS["substr"])
+    for nm in ("strpos", "position", "instr"):
+        reg(nm, _rowfn(lambda s, sub: _s(s).find(_s(sub)) + 1))
+    reg("split_part", _rowfn(lambda s, d, n: _split_part(
+        _s(s), _s(d), int(n))))
+    reg("concat", _rowfn(lambda *parts: "".join(_s(p) for p in parts)))
+
+    def _concat_ws(sep, *args):
+        n, cols = _rows(sep, *args)
+        out = np.empty(n, object)
+        for i in range(n):
+            sp = cols[0][i]
+            if pd.isna(sp):
+                out[i] = None
+                continue
+            parts = [_s(c[i]) for c in cols[1:] if not pd.isna(c[i])]
+            out[i] = _s(sp).join(parts)
+        return out
+    reg("concat_ws", _concat_ws)
+    reg("hex", _rowfn(_hex_value))
+    reg("to_hex", PYTHON_FNS["hex"])
+
+    # digests (hostfns.DIGESTS is the engine-side table)
+    for nm, (_, fn) in hostfns.DIGESTS.items():
+        reg(nm, _rowfn(lambda s, fn=fn: fn(
+            s if isinstance(s, bytes) else _s(s).encode()).decode()))
+    reg("sha2", _rowfn(lambda s, bits: hashlib.new(
+        f"sha{int(bits) if int(bits) else 256}",
+        s if isinstance(s, bytes) else _s(s).encode()).hexdigest()))
+    reg("crc32", _rowfn(lambda s: zlib.crc32(
+        s if isinstance(s, bytes) else _s(s).encode()) & 0xFFFFFFFF))
+
+    # JSON (hostfns implements the Spark path semantics)
+    reg("get_json_object", _rowfn(lambda s, p: _json_path(s, p)))
+    reg("get_parsed_json_object", PYTHON_FNS["get_json_object"])
+    reg("parse_json", _rowfn(lambda s: _validate_json(s)))
+
+    # collections
+    def _make_array(*args):
+        n, cols = _rows(*args)
+        out = np.empty(n, object)
+        for i in range(n):
+            out[i] = [c[i] for c in cols]
+        return out
+    reg("make_array", _make_array)
+
+    # dates (fallback frames carry datetime64/date objects)
+    reg("year", _rowfn(lambda d: pd.Timestamp(d).year))
+    reg("month", _rowfn(lambda d: pd.Timestamp(d).month))
+    for nm in ("day", "dayofmonth"):
+        reg(nm, _rowfn(lambda d: pd.Timestamp(d).day))
+    reg("dayofweek", _rowfn(lambda d: (pd.Timestamp(d).dayofweek + 1) % 7
+                            + 1))
+    reg("date_add", _rowfn(lambda d, n: (pd.Timestamp(d)
+                                         + pd.Timedelta(days=int(n))).date()))
+    reg("date_sub", _rowfn(lambda d, n: (pd.Timestamp(d)
+                                         - pd.Timedelta(days=int(n))).date()))
+    reg("datediff", _rowfn(lambda a, b: (pd.Timestamp(a)
+                                         - pd.Timestamp(b)).days))
+
+    # hashes (Spark murmur3, seed 42, per-column fold — exprs/hash.py is
+    # the device twin; golden values shared via tests/test_hash.py)
+    def _hash_one(v, dt, h: int) -> int:
+        if dt is not None and dt.kind in "iu" and dt.itemsize <= 4:
+            narrow_int = True
+        else:
+            narrow_int = isinstance(v, (np.int8, np.int16, np.int32))
+        if isinstance(v, np.float32) or (dt is not None and dt == np.float32):
+            f = np.float32(0.0) if v == 0.0 else np.float32(v)
+            return _mm3_int(int(f.view(np.int32)), h)
+        if isinstance(v, (float, np.floating)):
+            f = np.float64(0.0) if v == 0.0 else np.float64(v)
+            return _mm3_long(int(f.view(np.int64)), h)
+        if isinstance(v, (bool, np.bool_)):
+            return _mm3_int(int(v), h)
+        if isinstance(v, (int, np.integer)):
+            return _mm3_int(int(v), h) if narrow_int \
+                else _mm3_long(int(v), h)
+        return _mm3_bytes(v if isinstance(v, bytes) else _s(v).encode(), h)
+
+    def _murmur3(*args):
+        n, cols = _rows(*args)
+        dts = [a.dtype if isinstance(a, np.ndarray)
+               and a.dtype != object else None for a in args]
+        dts += [None] * (len(cols) - len(dts))
+        out = np.empty(n, np.int32)
+        for i in range(n):
+            h = 42
+            for c, dt in zip(cols, dts):
+                v = c[i]
+                if not pd.isna(v):
+                    h = _hash_one(v, dt, h)
+            out[i] = np.int32(np.uint32(h & 0xFFFFFFFF))
+        return out
+    for nm in ("hash", "murmur3_hash"):
+        reg(nm, _murmur3)
+
+
+_M = 0xFFFFFFFF
+
+
+def _mm3_mix_k1(k1: int) -> int:
+    k1 = (k1 * 0xCC9E2D51) & _M
+    k1 = ((k1 << 15) | (k1 >> 17)) & _M
+    return (k1 * 0x1B873593) & _M
+
+
+def _mm3_mix_h1(h1: int, k1: int) -> int:
+    h1 ^= k1
+    h1 = ((h1 << 13) | (h1 >> 19)) & _M
+    return (h1 * 5 + 0xE6546B64) & _M
+
+
+def _mm3_fmix(h1: int, length: int) -> int:
+    h1 ^= length
+    h1 ^= h1 >> 16
+    h1 = (h1 * 0x85EBCA6B) & _M
+    h1 ^= h1 >> 13
+    h1 = (h1 * 0xC2B2AE35) & _M
+    return h1 ^ (h1 >> 16)
+
+
+def _mm3_int(v: int, seed: int) -> int:
+    return _mm3_fmix(_mm3_mix_h1(seed & _M, _mm3_mix_k1(v & _M)), 4)
+
+
+def _mm3_long(v: int, seed: int) -> int:
+    h1 = _mm3_mix_h1(seed & _M, _mm3_mix_k1(v & _M))
+    h1 = _mm3_mix_h1(h1, _mm3_mix_k1((v >> 32) & _M))
+    return _mm3_fmix(h1, 8)
+
+
+def _mm3_bytes(b: bytes, seed: int) -> int:
+    """Spark hashUnsafeBytes: 4-byte little-endian words, then per-byte
+    tail as SIGNED ints (matches exprs/hash.py hash_bytes)."""
+    h1 = seed & _M
+    n4 = len(b) // 4 * 4
+    for i in range(0, n4, 4):
+        w = int.from_bytes(b[i:i + 4], "little")
+        h1 = _mm3_mix_h1(h1, _mm3_mix_k1(w))
+    for i in range(n4, len(b)):
+        sb = b[i] - 256 if b[i] >= 128 else b[i]
+        h1 = _mm3_mix_h1(h1, _mm3_mix_k1(sb & _M))
+    return _mm3_fmix(h1, len(b))
+
+
+def _round_half_up(a, d):
+    av = np.asarray(a, np.float64)
+    scale = 10.0 ** int(np.asarray(d).reshape(-1)[0]) if d is not None else 1.0
+    return np.sign(av) * np.floor(np.abs(av) * scale + 0.5) / scale
+
+
+def _lpad(s: str, n: int, p: str) -> str:
+    if n <= 0:
+        return ""
+    if n <= len(s):
+        return s[:n]
+    if not p:
+        return s
+    pad = (p * ((n - len(s)) // len(p) + 1))[: n - len(s)]
+    return pad + s
+
+
+def _rpad(s: str, n: int, p: str) -> str:
+    if n <= 0:
+        return ""
+    if n <= len(s):
+        return s[:n]
+    if not p:
+        return s
+    pad = (p * ((n - len(s)) // len(p) + 1))[: n - len(s)]
+    return s + pad
+
+
+def _substr(s: str, pos: int, ln) -> str:
+    """Spark substringSQL: virtual positions before the string consume
+    the length (substr('hello', -10, 3) == '')."""
+    if pos > 0:
+        start = pos - 1
+    elif pos < 0:
+        start = len(s) + pos
+    else:
+        start = 0
+    end = len(s) if ln is None else start + max(ln, 0)
+    return s[max(start, 0):max(end, 0)]
+
+
+def _split_part(s: str, d: str, n: int):
+    if not d:
+        return None
+    parts = s.split(d)
+    if n == 0 or abs(n) > len(parts):
+        return ""
+    return parts[n - 1] if n > 0 else parts[n]
+
+
+def _hex_value(v):
+    if isinstance(v, (int, np.integer)):
+        return format(int(v) & 0xFFFFFFFFFFFFFFFF, "X")
+    b = v if isinstance(v, bytes) else _s(v).encode()
+    return b.hex().upper()
+
+
+def _json_path(s, p):
+    from blaze_tpu.exprs import hostfns
+
+    steps = hostfns.parse_json_path(_s(p))
+    if steps is None:
+        return None
+    out = hostfns.get_json_object_row(
+        s if isinstance(s, bytes) else _s(s).encode(), steps)
+    return None if out is None else out.decode()
+
+
+def _validate_json(s):
+    from blaze_tpu.exprs import hostfns
+
+    out = hostfns.validate_json_row(
+        s if isinstance(s, bytes) else _s(s).encode())
+    return None if out is None else out.decode()
+
+
+_register_default_fns()
+
+
 def export_iterator(plan: SparkPlan, partition: int,
                     num_partitions: int) -> Iterator[pa.RecordBatch]:
     """Execute the subtree for one task partition; yield Arrow batches

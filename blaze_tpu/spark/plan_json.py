@@ -543,7 +543,152 @@ def _decode_node(node: dict) -> SparkPlan:
                          "fetch": int(node.get("limit", 0))})
         return SparkPlan("GlobalLimitExec", child.schema, [srt],
                          {"limit": int(node.get("limit", 0))})
+    if cls == "WindowExec":
+        return _decode_window(node)
+    if cls == "ExpandExec":
+        child = _decode_node(node["children"][0])
+        projections = [[decode_expr(t) for t in _expr_list(proj)]
+                       for proj in node.get("projections", [])]
+        return SparkPlan("ExpandExec", _output_schema(node), [child],
+                         {"projections": projections})
+    if cls == "GenerateExec":
+        return _decode_generate(node)
+    if cls == "BroadcastNestedLoopJoinExec":
+        left = _decode_node(ch[0])
+        right = _decode_node(ch[1])
+        jt_raw = str(node.get("joinType"))
+        jt = ("cross" if jt_raw == "Cross"
+              else _JOIN_TYPES.get(jt_raw))
+        if jt is None:
+            raise PlanJsonError(f"BNLJ join type {jt_raw}")
+        cond = (decode_expr(_expr_tree(node.get("condition")))
+                if node.get("condition") else None)
+        return SparkPlan(
+            "BroadcastNestedLoopJoinExec",
+            _join_schema(left, right, jt), [left, right],
+            {"join_type": jt, "condition": cond})
     raise PlanJsonError(f"plan node {cls} not supported")
+
+
+_WINDOW_BUILTINS = {"RowNumber": "row_number", "Rank": "rank",
+                    "DenseRank": "dense_rank"}
+
+
+def _decode_window(node: dict) -> SparkPlan:
+    """WindowExec: windowExpression (Alias over WindowExpression),
+    partitionSpec, orderSpec. Only default frames convert (the engine's
+    rank trio + whole-partition aggregate windows, ops/window.py); an
+    explicit non-default frame falls back."""
+    child = _decode_node(node["children"][0])
+    calls, wfields = [], []
+    for item in node.get("windowExpression", []):
+        tree = _expr_tree(item)
+        if tree is None or _cls(tree) != "Alias":
+            raise PlanJsonError("window expression without Alias")
+        name = _attr_name(tree.get("exprId"))
+        we = tree["children"][0]
+        if _cls(we) != "WindowExpression":
+            raise PlanJsonError(f"window alias over {_cls(we)}")
+        _check_window_frame(we)
+        fn_tree = we["children"][0]
+        fn_cls = _cls(fn_tree)
+        if fn_cls in _WINDOW_BUILTINS:
+            fn = _WINDOW_BUILTINS[fn_cls]
+            calls.append({"fn": fn, "args": [], "dtype": T.INT32,
+                          "name": name})
+            wfields.append(T.Field(name, T.INT32, False))
+            continue
+        if fn_cls != "AggregateExpression":
+            raise PlanJsonError(f"window function {fn_cls}")
+        agg_tree = fn_tree["children"][0]
+        agg_cls = _cls(agg_tree)
+        fn = _AGG_FN.get(agg_cls)
+        if fn is None or fn in ("collect_list", "collect_set"):
+            raise PlanJsonError(f"window aggregate {agg_cls}")
+        args = [decode_expr(c) for c in agg_tree["children"]]
+        if fn == "count" and not args:
+            args = [ir.Literal(T.INT32, 1)]
+        dtype = _agg_dtype(fn, agg_tree, args)
+        calls.append({"fn": fn, "args": args, "dtype": dtype, "name": name})
+        wfields.append(T.Field(name, dtype, True))
+    part_by = [decode_expr(t) for t in _expr_list(node.get("partitionSpec"))]
+    order_by = _decode_sort_orders({"sortOrder": node.get("orderSpec", [])})
+    return SparkPlan(
+        "WindowExec",
+        T.Schema(list(child.schema.fields) + wfields), [child],
+        {"calls": calls, "partition_by": part_by, "order_by": order_by})
+
+
+def _check_window_frame(we: dict) -> None:
+    """The engine computes default frames only (whole partition, or RANGE
+    unbounded-preceding..current-row with peer leveling, ops/window.py).
+    A SpecifiedWindowFrame with other bounds — or a ROWS frame ending at
+    CURRENT ROW, whose per-row running value differs from RANGE peer
+    leveling on ties — must fall back to Spark. Resolved Spark plans
+    always materialize the frame, with case-object boundaries serialized
+    as '...UnboundedPreceding$' classes."""
+    def name_of(v) -> str:
+        if isinstance(v, dict):
+            v = v.get("object") or v.get("class") or ""
+        return str(v).rsplit(".", 1)[-1].rstrip("$")
+
+    def walk(t: dict):
+        if _cls(t).rstrip("$") == "SpecifiedWindowFrame":
+            bounds = [name_of(b.get("class")) for b in t["children"]]
+            for key in ("lower", "upper"):
+                if t.get(key) is not None and not isinstance(
+                        t.get(key), int):
+                    bounds.append(name_of(t.get(key)))
+            ok_lower = "UnboundedPreceding" in bounds
+            unbounded_upper = "UnboundedFollowing" in bounds
+            ok_upper = unbounded_upper or "CurrentRow" in bounds
+            if bounds and not (ok_lower and ok_upper):
+                raise PlanJsonError(
+                    f"non-default window frame {bounds} not convertible")
+            ftype = name_of(t.get("frameType"))
+            if (bounds and not unbounded_upper
+                    and ftype not in ("", "RangeFrame")):
+                raise PlanJsonError(
+                    f"{ftype} up to CURRENT ROW differs from the engine's "
+                    "RANGE peer leveling on ties")
+        for c in t.get("children", []):
+            walk(c)
+
+    walk(we)
+
+
+def _decode_generate(node: dict) -> SparkPlan:
+    child = _decode_node(node["children"][0])
+    gen = _expr_tree(node.get("generator"))
+    if gen is None:
+        raise PlanJsonError("GenerateExec without generator")
+    gcls = _cls(gen)
+    if gcls not in ("Explode", "PosExplode"):
+        raise PlanJsonError(f"generator {gcls} not convertible")
+    gen_child = decode_expr(gen["children"][0])
+    req_fields = []
+    for item in node.get("requiredChildOutput", []):
+        tree = _expr_tree(item)
+        if tree is None or _cls(tree) != "AttributeReference":
+            raise PlanJsonError("non-attribute in requiredChildOutput")
+        req_fields.append(_attr_field(tree))
+    out_fields = []
+    for item in node.get("generatorOutput", []):
+        tree = _expr_tree(item)
+        if tree is None or _cls(tree) != "AttributeReference":
+            raise PlanJsonError("non-attribute in generatorOutput")
+        out_fields.append(_attr_field(tree))
+    child_names = child.schema.names()
+    try:
+        req_idx = [child_names.index(f.name) for f in req_fields]
+    except ValueError as e:
+        raise PlanJsonError(f"requiredChildOutput not in child: {e}")
+    return SparkPlan(
+        "GenerateExec", T.Schema(req_fields + out_fields), [child],
+        {"pos": gcls == "PosExplode", "generator": gen_child,
+         "required_cols": req_idx,
+         "output_names": [f.name for f in out_fields],
+         "outer": bool(node.get("outer", False))})
 
 
 def _alias_dtype(tree: dict, e: ir.Expr,

@@ -1,0 +1,125 @@
+"""Default Python implementations backing the fallback interpreter.
+
+The row interpreter (spark/fallback.py) must evaluate every scalar fn the
+native registry knows (exprs/functions.py), because a NeverConvert parent
+drags convertible expressions onto the fallback path. These tests pin the
+Spark semantics of the default PYTHON_FNS table and its murmur3 against
+the device twin (exprs/hash.py)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from blaze_tpu.spark.fallback import PYTHON_FNS
+
+
+def fn(name):
+    f = PYTHON_FNS.get(name)
+    assert f is not None, f"no default fallback for {name}"
+    return f
+
+
+def arr(*vals):
+    return np.array(vals, object)
+
+
+def test_registry_coverage():
+    """Every native registry fn has a fallback body."""
+    from blaze_tpu.exprs.functions import registered_names
+
+    missing = [n for n in registered_names() if n.lower() not in PYTHON_FNS]
+    assert missing == [], f"fallback missing: {missing}"
+
+
+def test_string_fns():
+    assert list(fn("lower")(arr("AbC", None))) == ["abc", None]
+    assert list(fn("initcap")(arr("hello wORLD"))) == ["Hello World"]
+    assert list(fn("lpad")(arr("hi"), arr(5), arr("xy"))) == ["xyxhi"]
+    assert list(fn("rpad")(arr("hi"), arr(1), arr("x"))) == ["h"]
+    assert list(fn("substr")(arr("hello"), arr(2), arr(3))) == ["ell"]
+    assert list(fn("substr")(arr("hello"), arr(-3), arr(2))) == ["ll"]
+    assert list(fn("split_part")(arr("a,b,c"), arr(","), arr(2))) == ["b"]
+    assert list(fn("split_part")(arr("a,b,c"), arr(","), arr(-1))) == ["c"]
+    assert list(fn("translate")(arr("abcba"), arr("ab"), arr("x"))) == \
+        ["xcx"]
+    assert list(fn("left")(arr("spark"), arr(2))) == ["sp"]
+    assert list(fn("right")(arr("spark"), arr(2))) == ["rk"]
+    assert list(fn("repeat")(arr("ab"), arr(3))) == ["ababab"]
+    assert list(fn("reverse")(arr("abc"))) == ["cba"]
+    assert list(fn("concat")(arr("a", None), arr("b", "c"))) == ["ab", None]
+    assert list(fn("concat_ws")(arr(","), arr("a", None), arr("b", "c"))) \
+        == ["a,b", "c"]
+    assert list(fn("strpos")(arr("hello"), arr("ll"))) == [3]
+    assert list(fn("length")(arr("héllo"))) == [5]
+    assert list(fn("octet_length")(arr("héllo"))) == [6]
+    assert list(fn("ascii")(arr("A"))) == [65]
+    assert list(fn("chr")(arr(66))) == ["B"]
+
+
+def test_numeric_fns():
+    assert list(fn("ceil")(np.array([1.2, -1.2]))) == [2, -1]
+    assert list(fn("floor")(np.array([1.8, -1.2]))) == [1, -2]
+    # NaN is the fallback null for doubles: must stay null, not INT64_MIN
+    assert list(fn("ceil")(np.array([1.2, np.nan]))) == [2, None]
+    assert list(fn("trunc")(np.array([1.9, -1.9]))) == [1.0, -1.0]
+    assert list(fn("substr")(arr("hello"), arr(-10), arr(3))) == [""]
+    assert list(fn("lpad")(arr("abc"), arr(-1), arr("x"))) == [""]
+    # HALF_UP, not numpy's half-even
+    got = fn("round")(np.array([2.5, 3.5, -2.5]), np.array([0]))
+    assert list(got) == [3.0, 4.0, -3.0]
+    assert list(fn("nullif")(arr(1, 2), arr(1, 3))) == [None, 2]
+    out = fn("coalesce")(arr(None, 5), arr(7, 8))
+    assert list(out) == [7, 5]
+
+
+def test_digest_and_json():
+    import hashlib
+
+    s = "blaze"
+    assert fn("md5")(arr(s))[0] == hashlib.md5(s.encode()).hexdigest()
+    assert fn("sha256")(arr(s))[0] == hashlib.sha256(s.encode()).hexdigest()
+    import zlib
+
+    assert fn("crc32")(arr(s))[0] == zlib.crc32(s.encode()) & 0xFFFFFFFF
+    doc = '{"a": {"b": [1, 2]}, "s": "x"}'
+    assert fn("get_json_object")(arr(doc), arr("$.a.b[1]"))[0] == "2"
+    assert fn("get_json_object")(arr(doc), arr("$.s"))[0] == "x"
+    assert fn("get_json_object")(arr(doc), arr("$.zz"))[0] is None
+    assert fn("parse_json")(arr("{bad"))[0] is None
+
+
+def test_make_array_and_dates():
+    out = fn("make_array")(arr(1, 2), arr(3, 4))
+    assert out[0] == [1, 3] and out[1] == [2, 4]
+    d = np.array([np.datetime64("2024-03-05")], object)
+    assert list(fn("year")(d)) == [2024]
+    assert list(fn("month")(d)) == [3]
+    assert list(fn("day")(d)) == [5]
+    assert fn("datediff")(
+        arr(np.datetime64("2024-03-05")), arr(np.datetime64("2024-03-01"))
+    )[0] == 4
+
+
+def test_murmur3_matches_device():
+    """Fallback murmur3 == device hash_columns (exprs/hash.py) across
+    int32/int64/float64/string columns with nulls."""
+    from blaze_tpu.columnar import types as T
+    from blaze_tpu.columnar.batch import ColumnBatch
+    from blaze_tpu.exprs.hash import hash_columns
+
+    schema = T.Schema([
+        T.Field("i", T.INT32), T.Field("l", T.INT64),
+        T.Field("d", T.FLOAT64), T.Field("s", T.STRING),
+    ])
+    data = {
+        "i": np.array([1, -7, 0, 2**31 - 1], np.int32),
+        "l": np.array([5, -1, 2**40, 0], np.int64),
+        "d": np.array([0.5, -0.0, 3.25e10, -17.75]),
+        "s": np.array(["", "a", "hello world", "blaze"], object),
+    }
+    b = ColumnBatch.from_numpy(data, schema)
+    want = np.asarray(hash_columns(b.columns))[:4]
+
+    got = PYTHON_FNS["hash"](
+        data["i"], data["l"], data["d"], data["s"])
+    assert list(got) == list(want)

@@ -219,6 +219,210 @@ def test_takeordered_shape(tables):
         want.ss_ext_sales_price.to_numpy(), rtol=1e-9)
 
 
+def default_frame(upper="CurrentRow$", frame_type="RangeFrame$"):
+    """Resolved plans always materialize the frame; boundary case objects
+    serialize with the Scala '$' suffix."""
+    return [{"class": f"{SPARK}.catalyst.expressions.SpecifiedWindowFrame",
+             "num-children": 2, "frameType":
+             {"object": f"{SPARK}.catalyst.expressions.{frame_type}"},
+             "lower": 0, "upper": 1},
+            {"class": f"{SPARK}.catalyst.expressions.UnboundedPreceding$",
+             "num-children": 0},
+            {"class": f"{SPARK}.catalyst.expressions.{upper}",
+             "num-children": 0}]
+
+
+def _window_call(fn_tree, eid):
+    """Alias(WindowExpression(fn, WindowSpecDefinition)) with the resolved
+    default frame (RANGE unbounded-preceding..current-row)."""
+    spec = [{"class": f"{SPARK}.catalyst.expressions.WindowSpecDefinition",
+             "num-children": 1, "partitionSpec": [], "orderSpec": [],
+             "frameSpecification": 0}] + default_frame()
+    return [{"class": f"{SPARK}.catalyst.expressions.Alias",
+             "num-children": 1, "child": 0, "name": f"w{eid}",
+             "exprId": {"product-class":
+                        f"{SPARK}.catalyst.expressions.ExprId",
+                        "id": eid, "jvmId": "x"},
+             "qualifier": []},
+            {"class": f"{SPARK}.catalyst.expressions.WindowExpression",
+             "num-children": 2, "windowFunction": 0, "windowSpec": 1}] + \
+        _flat(fn_tree) + spec
+
+
+def test_window_from_json(tables):
+    """WindowExec decoded from TreeNode JSON: row_number + sum-over-
+    partition, vs pandas."""
+    ss, dd, ss_path, dd_path = tables
+    a_item = attr("ss_item_sk", "long", 2)
+    a_price = attr("ss_ext_sales_price", "double", 3)
+
+    rn = _window_call(
+        {"class": f"{SPARK}.catalyst.expressions.RowNumber",
+         "num-children": 0}, 30)
+    sm = _window_call(
+        agg_expr("Sum", attr("ss_ext_sales_price", "double", 3),
+                 "Complete", 99, "double")[0:1] +
+        agg_expr("Sum", attr("ss_ext_sales_price", "double", 3),
+                 "Complete", 99, "double")[1:], 31)
+
+    so = [{"class": f"{SPARK}.catalyst.expressions.SortOrder",
+           "num-children": 1, "child": 0, "direction": "Ascending",
+           "nullOrdering": "NullsFirst", "sameOrderExpressions": []}] + \
+        attr("ss_ext_sales_price", "double", 3)
+    plan = [
+        {"class": f"{SPARK}.execution.window.WindowExec", "num-children": 1,
+         "windowExpression": [rn, sm],
+         "partitionSpec": [attr("ss_item_sk", "long", 2)],
+         "orderSpec": [so], "child": 0},
+        scan_node([ss_path], [a_item, a_price]),
+    ]
+    root = decode_plan_json(json.dumps(plan))
+    assert root.kind == "WindowExec"
+    assert root.schema.names() == ["#2", "#3", "#30", "#31"]
+    out = run_plan(root, num_partitions=1)
+    d = {k: np.asarray(v) for k, v in out.to_numpy().items()}
+    df = pd.DataFrame(d)
+    for g, grp in df.groupby("#2"):
+        assert sorted(grp["#30"].tolist()) == list(range(1, len(grp) + 1))
+    want = ss.groupby("ss_item_sk")["ss_ext_sales_price"].sum()
+    # running RANGE sum: max per partition equals the partition total
+    got = df.groupby("#2")["#31"].max()
+    for k, v in want.items():
+        np.testing.assert_allclose(got[k], v, rtol=1e-9)
+
+
+def test_expand_from_json(tables):
+    ss, dd, ss_path, dd_path = tables
+    a_item = attr("ss_item_sk", "long", 2)
+    plan = [
+        {"class": f"{SPARK}.execution.ExpandExec", "num-children": 1,
+         "projections": [
+             [attr("ss_item_sk", "long", 2), [lit(0, "long")]],
+             [attr("ss_item_sk", "long", 2), [lit(1, "long")]],
+         ],
+         "output": [attr("ss_item_sk", "long", 2), attr("tag", "long", 40)],
+         "child": 0},
+        scan_node([ss_path], [a_item]),
+    ]
+    root = decode_plan_json(json.dumps(plan))
+    assert root.kind == "ExpandExec"
+    out = run_plan(root, num_partitions=1)
+    d = {k: np.asarray(v) for k, v in out.to_numpy().items()}
+    assert len(d["#2"]) == 2 * len(ss)
+    assert sorted(set(int(x) for x in d["#40"])) == [0, 1]
+
+
+def test_generate_explode_from_json(tables):
+    """GenerateExec: Explode(CreateArray(price, price)) doubles the rows."""
+    ss, dd, ss_path, dd_path = tables
+    a_item = attr("ss_item_sk", "long", 2)
+    a_price = attr("ss_ext_sales_price", "double", 3)
+    gen = [{"class": f"{SPARK}.catalyst.expressions.Explode",
+            "num-children": 1, "child": 0},
+           {"class": f"{SPARK}.catalyst.expressions.CreateArray",
+            "num-children": 2, "children": [0, 1]}] + \
+        attr("ss_ext_sales_price", "double", 3) + \
+        attr("ss_ext_sales_price", "double", 3)
+    plan = [
+        {"class": f"{SPARK}.execution.GenerateExec", "num-children": 1,
+         "generator": gen,
+         "requiredChildOutput": [attr("ss_item_sk", "long", 2)],
+         "outer": False,
+         "generatorOutput": [attr("col", "double", 50)],
+         "child": 0},
+        scan_node([ss_path], [a_item, a_price]),
+    ]
+    root = decode_plan_json(json.dumps(plan))
+    assert root.kind == "GenerateExec"
+    assert root.schema.names() == ["#2", "#50"]
+    out = run_plan(root, num_partitions=1)
+    d = {k: np.asarray(v) for k, v in out.to_numpy().items()}
+    assert len(d["#2"]) == 2 * len(ss)
+    np.testing.assert_allclose(np.sort(d["#50"]),
+                               np.sort(np.repeat(
+                                   ss.ss_ext_sales_price.to_numpy(), 2)),
+                               rtol=1e-9)
+
+
+def test_bnlj_from_json(tables):
+    """Cross BNLJ with a broadcast right side and a join condition."""
+    ss, dd, ss_path, dd_path = tables
+    a_item = attr("ss_item_sk", "long", 2)
+    a_dsk = attr("d_date_sk", "long", 4)
+    cond = binop("LessThan", attr("ss_item_sk", "long", 2)[0],
+                 attr("d_date_sk", "long", 4)[0])
+    plan = [
+        {"class": f"{SPARK}.execution.joins.BroadcastNestedLoopJoinExec",
+         "num-children": 2, "left": 0, "right": 1,
+         "buildSide": {"object": f"{SPARK}.catalyst.optimizer.BuildRight$"},
+         "joinType": "Cross", "condition": cond},
+        scan_node([ss_path], [a_item]),
+        {"class": f"{SPARK}.execution.exchange.BroadcastExchangeExec",
+         "num-children": 1, "mode": {}, "child": 0},
+        scan_node([dd_path], [a_dsk]),
+    ]
+    root = decode_plan_json(json.dumps(plan))
+    assert root.kind == "BroadcastNestedLoopJoinExec"
+    out = run_plan(root, num_partitions=1)
+    d = {k: np.asarray(v) for k, v in out.to_numpy().items()}
+    want = sum(int((ss.ss_item_sk < k).sum()) for k in dd.d_date_sk)
+    assert len(d["#2"]) == want
+
+
+def test_window_nondefault_frame_falls_back(tables):
+    ss, dd, ss_path, dd_path = tables
+    a_item = attr("ss_item_sk", "long", 2)
+    frame = [{"class": f"{SPARK}.catalyst.expressions.SpecifiedWindowFrame",
+              "num-children": 2, "frameType": {}, "lower": 0, "upper": 1},
+             {"class": f"{SPARK}.catalyst.expressions.UnboundedPreceding$",
+              "num-children": 0},
+             {"class": f"{SPARK}.catalyst.expressions.Literal",
+              "num-children": 0, "value": "3", "dataType": "integer"}]
+    call = [{"class": f"{SPARK}.catalyst.expressions.Alias",
+             "num-children": 1, "child": 0, "name": "w60",
+             "exprId": {"id": 60, "jvmId": "x"}, "qualifier": []},
+            {"class": f"{SPARK}.catalyst.expressions.WindowExpression",
+             "num-children": 2, "windowFunction": 0, "windowSpec": 1},
+            {"class": f"{SPARK}.catalyst.expressions.RowNumber",
+             "num-children": 0},
+            {"class": f"{SPARK}.catalyst.expressions.WindowSpecDefinition",
+             "num-children": 1, "frameSpecification": 0}] + frame
+    plan = [
+        {"class": f"{SPARK}.execution.window.WindowExec", "num-children": 1,
+         "windowExpression": [call], "partitionSpec": [],
+         "orderSpec": [], "child": 0},
+        scan_node([ss_path], [a_item]),
+    ]
+    with pytest.raises(PlanJsonError):
+        decode_plan_json(json.dumps(plan))
+
+
+def test_window_rows_frame_falls_back(tables):
+    """ROWS up to CURRENT ROW differs from RANGE peer leveling on ties —
+    must not convert."""
+    ss, dd, ss_path, dd_path = tables
+    a_item = attr("ss_item_sk", "long", 2)
+    spec = [{"class": f"{SPARK}.catalyst.expressions.WindowSpecDefinition",
+             "num-children": 1, "partitionSpec": [], "orderSpec": [],
+             "frameSpecification": 0}] + \
+        default_frame(frame_type="RowFrame$")
+    call = [{"class": f"{SPARK}.catalyst.expressions.Alias",
+             "num-children": 1, "child": 0, "name": "w61",
+             "exprId": {"id": 61, "jvmId": "x"}, "qualifier": []},
+            {"class": f"{SPARK}.catalyst.expressions.WindowExpression",
+             "num-children": 2, "windowFunction": 0, "windowSpec": 1}] + \
+        agg_expr("Sum", attr("ss_item_sk", "long", 2),
+                 "Complete", 98, "long") + spec
+    plan = [
+        {"class": f"{SPARK}.execution.window.WindowExec", "num-children": 1,
+         "windowExpression": [call], "partitionSpec": [],
+         "orderSpec": [], "child": 0},
+        scan_node([ss_path], [a_item]),
+    ]
+    with pytest.raises(PlanJsonError):
+        decode_plan_json(json.dumps(plan))
+
+
 def test_unsupported_node_raises():
     plan = [{"class": f"{SPARK}.execution.SomeExoticExec",
              "num-children": 0}]
