@@ -28,6 +28,7 @@ from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from blaze_tpu.columnar import types as T
 from blaze_tpu.columnar.batch import Column, ColumnBatch, bucket_capacity
@@ -160,10 +161,7 @@ def try_run_stage(root: Operator, ctx: ExecContext
         # source already drained: fall back WITH the captured batches
         return _fallback(root, batches, source, ctx)
 
-    # stack on device: one (NB, ...) pytree the scan consumes
-    stacked = jax.tree_util.tree_map(
-        lambda *xs: jnp.stack(xs), *batches)
-
+    batches = tuple(batches)
     max_R = int(conf.dense_agg_range)
 
     nkeys = len(partial.group_exprs)
@@ -176,7 +174,12 @@ def try_run_stage(root: Operator, ctx: ExecContext
         steps = _build_steps(chain)
         group_fns = list(partial._group_fns)
 
-        def run(stacked):
+        def run(*batches):
+            # stacking INSIDE the program: eager jnp.stack per tree leaf
+            # costs a dispatch each on a remote-attached chip
+            stacked = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *batches)
+
             def min_step(carry, b):
                 kmins, kmaxs, bad = carry
                 b, mask = _apply_steps(steps, b)
@@ -201,23 +204,21 @@ def try_run_stage(root: Operator, ctx: ExecContext
 
         return run
 
-    # R (the dense range bucket) is the only data-dependent STATIC of the
-    # accumulation program. Probe it once per plan shape and memoize; the
-    # steady state is then a single dispatch (kmin is computed in-program,
-    # and the in-program oob flag catches data drifting past the memoized
-    # R, triggering a re-probe).
+    # (spans, kmins) are the data-dependent STATICS of the accumulation
+    # program. Probe them once per plan shape and memoize; the steady
+    # state is then a single dispatch with no in-program min pass — the
+    # in-program oob flag catches data drifting outside the memoized
+    # ranges (or going null), triggering a re-probe + recompile.
     memo_key = ("stage_R", root.plan_key(), shape0)
 
     def probe_spans():
-        import numpy as np
-
         probe = jit_cache.get_or_compile(
             ("stage_probe", root.plan_key(), shape0, len(batches)),
             make_probe)
-        kmins_v, kmaxs_v, bad_v = probe(stacked)
+        kmins_v, kmaxs_v, bad_v = probe(*batches)
         if bool(bad_v):
             return None  # null grouping keys: dense slots can't hold them
-        spans = []
+        spans, kmins = [], []
         for lo, hi in zip(np.asarray(kmins_v), np.asarray(kmaxs_v)):
             # power-of-two headroom per key: exact spans would invalidate
             # the memo on ANY later dataset with one new key value (the
@@ -227,6 +228,7 @@ def try_run_stage(root: Operator, ctx: ExecContext
             while bucket < span:
                 bucket <<= 1
             spans.append(bucket)
+            kmins.append(int(lo))
         total = 1
         for sp in spans:
             total *= sp
@@ -239,7 +241,7 @@ def try_run_stage(root: Operator, ctx: ExecContext
             total <<= 1
         if total > max_R:
             return None
-        return tuple(spans)
+        return tuple(spans), tuple(kmins)
 
     def make():
         # filters fold into a row mask instead of compacting (see _match)
@@ -265,54 +267,53 @@ def try_run_stage(root: Operator, ctx: ExecContext
                 batches[0])
             sum_is_float.append(jnp.issubdtype(shp.data.dtype, jnp.floating))
 
-        def run(stacked: ColumnBatch):
-            # in-program pass 1: per-key minimums + null check
-            # (elementwise; cheap next to the matmuls)
-            def min_step(carry, b):
-                kmins, bad = carry
-                b, live = apply_chain(b)
-                nmins = []
-                for i, gfn in enumerate(group_fns):
-                    g = gfn(b)
-                    bad = bad | jnp.any(live & ~g.valid_mask())
-                    k = jnp.where(live & g.valid_mask(),
-                                  g.data.astype(jnp.int64),
-                                  jnp.int64(2 ** 62))
-                    nmins.append(jnp.minimum(kmins[i], jnp.min(k)))
-                return (nmins, bad), None
+        # kmins are STATIC ints from the memoized probe: no in-program min
+        # pass. int32 twins for the packed-index arithmetic (wrapping is
+        # benign — see the packing comment in step()).
+        kmins32 = [np.int64(m).astype(np.int32) for m in kmins]
 
-            (kmins, bad0), _ = jax.lax.scan(
-                min_step, ([jnp.int64(2 ** 62)] * len(group_fns),
-                           jnp.array(False)), stacked)
-            kmins = [jnp.where(m == 2 ** 62, 0, m) for m in kmins]
-
-            # pass 2: dense MXU accumulation (oob set when the memoized R
-            # no longer covers the data, or keys go null)
+        def run(*batches):
+            stacked = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *batches)
+            # single pass: dense MXU accumulation (oob set when the
+            # memoized kmins/spans no longer cover the data, or keys go
+            # null — either triggers re-probe + recompile in the caller)
             nagg = len(calls)
             init = {
                 "presence": jnp.zeros((R,), jnp.int64),
                 "sums": [jnp.zeros((R,), jnp.float64 if sum_is_float[i]
                                    else jnp.int64) for i in range(nagg)],
                 "counts": [jnp.zeros((R,), jnp.int64) for _ in range(nagg)],
-                "oob": bad0,
+                "oob": jnp.array(False),
             }
 
             def step(carry, b):
                 b, live = apply_chain(b)
-                # composite keys pack into one dense index
-                packed = jnp.zeros((b.capacity,), jnp.int64)
+                # composite keys pack into one dense index. Bounds are
+                # checked exactly in int64, but the packed index itself is
+                # computed in int32: in-range offsets (< span <= R <= 2^16)
+                # are int32-exact, out-of-range rows are masked out of the
+                # one-hot by `inb` so their wrapped value is irrelevant —
+                # and an int64 producer chain feeding the pallas kernel's
+                # key input materializes through a lane-padded layout that
+                # costs ~30ms/batch (measured; see mxu_agg pallas notes)
+                packed = jnp.zeros((b.capacity,), jnp.int32)
                 inb = live
                 keys_valid = live
+                null_key = jnp.array(False)
                 for i, gfn in enumerate(group_fns):
                     g = gfn(b)
                     keys_valid = keys_valid & g.valid_mask()
-                    off = g.data.astype(jnp.int64) - kmins[i]
-                    inb = inb & g.valid_mask() & (off >= 0) & \
-                        (off < spans[i])
+                    null_key = null_key | jnp.any(live & ~g.valid_mask())
+                    off64 = g.data.astype(jnp.int64) - kmins[i]
+                    inb = inb & g.valid_mask() & (off64 >= 0) & \
+                        (off64 < spans[i])
+                    off32 = g.data.astype(jnp.int32) - kmins32[i]
                     packed = packed + jnp.clip(
-                        off, 0, spans[i] - 1) * strides[i]
-                carry["oob"] = carry["oob"] | jnp.any(keys_valid & ~inb)
-                k = jnp.clip(packed, 0, R - 1).astype(jnp.int32)
+                        off32, 0, spans[i] - 1) * jnp.int32(strides[i])
+                carry["oob"] = carry["oob"] | null_key | \
+                    jnp.any(keys_valid & ~inb)
+                k = jnp.clip(packed, 0, R - 1)
                 # every aggregate plane rides ONE matmul (mxu_agg
                 # .grouped_multi); non-nullable inputs reuse the presence
                 # plane for their counts (validity is a trace-time
@@ -380,18 +381,24 @@ def try_run_stage(root: Operator, ctx: ExecContext
             out = ColumnBatch(schema, cols, jnp.asarray(R, jnp.int32), cap)
             out = out.compact(_pad(present, cap))
             assert out_mode_final  # partial-only rejected in _match
-            return out, carry["oob"]
+            # oob + num_rows in ONE tiny array: each host pull is a
+            # ~90ms round-trip on a remote-attached chip
+            flags = jnp.stack([carry["oob"].astype(jnp.int32),
+                               out.num_rows.astype(jnp.int32)])
+            return out, flags
 
         return run
 
-    out = oob = None
+    out = None
+    nrows = 0
     for attempt in (0, 1):
-        spans = _R_MEMO.get(memo_key)
-        if spans is None:
-            spans = probe_spans()
-            if spans is None:  # null keys or range beyond max_R
+        memo = _R_MEMO.get(memo_key)
+        if memo is None:
+            memo = probe_spans()
+            if memo is None:  # null keys or range beyond max_R
                 return _fallback(root, batches, source, ctx)
-            _R_MEMO[memo_key] = spans
+            _R_MEMO[memo_key] = memo
+        spans, kmins = memo
         R = 1
         for sp in spans:
             R *= sp
@@ -401,10 +408,12 @@ def try_run_stage(root: Operator, ctx: ExecContext
             strides.append(acc)
             acc *= sp
         strides = list(reversed(strides))
-        key = ("stage", root.plan_key(), shape0, len(batches), spans)
+        key = ("stage", root.plan_key(), shape0, len(batches), spans, kmins)
         fn = jit_cache.get_or_compile(key, make)
-        out, oob = fn(stacked)
-        if not bool(oob):
+        out, flags = fn(*batches)
+        flags_np = np.asarray(flags)
+        nrows = int(flags_np[1])
+        if not bool(flags_np[0]):
             break
         # data drifted past the memoized range: re-probe once with the
         # captured batches, then (attempt 2 failing means a race or null
@@ -415,7 +424,7 @@ def try_run_stage(root: Operator, ctx: ExecContext
         return _fallback(root, batches, source, ctx)
     for op in (final, partial, *chain):
         op.metrics.add("output_batches", 1)
-    root.metrics.add("output_rows", int(out.num_rows))
+    root.metrics.add("output_rows", nrows)
     root.metrics.add("stage_compiled", 1)
     return out
 
@@ -439,21 +448,22 @@ def _run_chain_stage(root: Operator, chain: List[MapLikeOp],
         # (checked BEFORE draining the source — a post-drain None would
         # make the caller re-execute the whole scan)
 
-    batches = list(source.execute(ctx))
+    batches = tuple(source.execute(ctx))
     if not batches:
         return None
     shape0 = batches[0].shape_key()
     if any(b.shape_key() != shape0 for b in batches[1:]):
-        return _fallback(root, batches, source, ctx)
+        return _fallback(root, list(batches), source, ctx)
 
-    stacked = jax.tree_util.tree_map(
-        lambda *xs: jnp.stack(xs), *batches)
     key = ("stage_chain", root.plan_key(), shape0, len(batches))
 
     def make():
         steps = _build_steps(chain)
 
-        def run(stacked: ColumnBatch):
+        def run(*batches):
+            stacked = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *batches)
+
             def step(_, b):
                 b, mask = _apply_steps(steps, b)
                 return None, (b, mask)
@@ -470,7 +480,7 @@ def _run_chain_stage(root: Operator, chain: List[MapLikeOp],
         return run
 
     fn = jit_cache.get_or_compile(key, make)
-    out = fn(stacked)
+    out = fn(*batches)
     for op in chain:
         op.metrics.add("output_batches", 1)
     root.metrics.add("output_rows", int(out.num_rows))

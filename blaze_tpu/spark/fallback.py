@@ -144,9 +144,13 @@ def _register_default_fns() -> None:
     reg("chr", _rowfn(lambda c: chr(int(c) % 256) if int(c) >= 0 else ""))
     reg("repeat", _rowfn(lambda s, n: _s(s) * max(int(n), 0)))
     reg("replace", _rowfn(lambda s, a, b="": _s(s).replace(_s(a), _s(b))))
+    def _translate_map(frm: str, to: str) -> dict:
+        m: dict = {}
+        for i, f in enumerate(frm):
+            m.setdefault(ord(f), to[i] if i < len(to) else None)
+        return m  # Spark: FIRST occurrence of a duplicated source wins
     reg("translate", _rowfn(lambda s, frm, to: _s(s).translate(
-        {ord(f): (to[i] if i < len(to) else None)
-         for i, f in enumerate(_s(frm))})))
+        _translate_map(_s(frm), _s(to)))))
     reg("left", _rowfn(lambda s, n: _s(s)[:max(int(n), 0)]))
     reg("right", _rowfn(lambda s, n: _s(s)[-int(n):] if int(n) > 0 else ""))
     reg("lpad", _rowfn(lambda s, n, p=" ": _lpad(_s(s), int(n), _s(p))))
@@ -180,9 +184,13 @@ def _register_default_fns() -> None:
     for nm, (_, fn) in hostfns.DIGESTS.items():
         reg(nm, _rowfn(lambda s, fn=fn: fn(
             s if isinstance(s, bytes) else _s(s).encode()).decode()))
-    reg("sha2", _rowfn(lambda s, bits: hashlib.new(
-        f"sha{int(bits) if int(bits) else 256}",
-        s if isinstance(s, bytes) else _s(s).encode()).hexdigest()))
+    def _sha2(s, bits):
+        if int(bits) not in (0, 224, 256, 384, 512):
+            return None  # Spark: null for unsupported bit lengths
+        return hashlib.new(
+            f"sha{int(bits) or 256}",
+            s if isinstance(s, bytes) else _s(s).encode()).hexdigest()
+    reg("sha2", _rowfn(_sha2))
     reg("crc32", _rowfn(lambda s: zlib.crc32(
         s if isinstance(s, bytes) else _s(s).encode()) & 0xFFFFFFFF))
 

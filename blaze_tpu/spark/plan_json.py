@@ -589,10 +589,12 @@ def _decode_window(node: dict) -> SparkPlan:
         we = tree["children"][0]
         if _cls(we) != "WindowExpression":
             raise PlanJsonError(f"window alias over {_cls(we)}")
-        _check_window_frame(we)
         fn_tree = we["children"][0]
         fn_cls = _cls(fn_tree)
         if fn_cls in _WINDOW_BUILTINS:
+            # rank-like results are frame-independent — Spark resolves
+            # them with their own ROWS frame (RowNumberLike.frame), which
+            # must NOT trip the frame check below
             fn = _WINDOW_BUILTINS[fn_cls]
             calls.append({"fn": fn, "args": [], "dtype": T.INT32,
                           "name": name})
@@ -600,10 +602,13 @@ def _decode_window(node: dict) -> SparkPlan:
             continue
         if fn_cls != "AggregateExpression":
             raise PlanJsonError(f"window function {fn_cls}")
+        _check_window_frame(we)
         agg_tree = fn_tree["children"][0]
         agg_cls = _cls(agg_tree)
         fn = _AGG_FN.get(agg_cls)
-        if fn is None or fn in ("collect_list", "collect_set"):
+        if fn not in ("count", "sum", "avg", "min", "max"):
+            # the engine's window op computes these only (ops/window.py);
+            # first/collect would crash mid-query instead of falling back
             raise PlanJsonError(f"window aggregate {agg_cls}")
         args = [decode_expr(c) for c in agg_tree["children"]]
         if fn == "count" and not args:

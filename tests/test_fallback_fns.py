@@ -42,6 +42,9 @@ def test_string_fns():
     assert list(fn("split_part")(arr("a,b,c"), arr(","), arr(-1))) == ["c"]
     assert list(fn("translate")(arr("abcba"), arr("ab"), arr("x"))) == \
         ["xcx"]
+    # duplicated source char: FIRST occurrence wins (Spark semantics)
+    assert list(fn("translate")(arr("abc"), arr("aa"), arr("xy"))) == \
+        ["xbc"]
     assert list(fn("left")(arr("spark"), arr(2))) == ["sp"]
     assert list(fn("right")(arr("spark"), arr(2))) == ["rk"]
     assert list(fn("repeat")(arr("ab"), arr(3))) == ["ababab"]
@@ -78,6 +81,10 @@ def test_digest_and_json():
     s = "blaze"
     assert fn("md5")(arr(s))[0] == hashlib.md5(s.encode()).hexdigest()
     assert fn("sha256")(arr(s))[0] == hashlib.sha256(s.encode()).hexdigest()
+    assert fn("sha2")(arr(s), arr(0))[0] == \
+        hashlib.sha256(s.encode()).hexdigest()
+    # Spark: null for unsupported bit lengths (1 would name real sha1)
+    assert fn("sha2")(arr(s), arr(1))[0] is None
     import zlib
 
     assert fn("crc32")(arr(s))[0] == zlib.crc32(s.encode()) & 0xFFFFFFFF

@@ -232,12 +232,14 @@ def default_frame(upper="CurrentRow$", frame_type="RangeFrame$"):
              "num-children": 0}]
 
 
-def _window_call(fn_tree, eid):
-    """Alias(WindowExpression(fn, WindowSpecDefinition)) with the resolved
-    default frame (RANGE unbounded-preceding..current-row)."""
+def _window_call(fn_tree, eid, frame_type="RangeFrame$"):
+    """Alias(WindowExpression(fn, WindowSpecDefinition)) with a resolved
+    frame. Rank-like fns resolve with their own ROWS frame in real Spark
+    plans (RowNumberLike.frame), aggregates with the RANGE default."""
     spec = [{"class": f"{SPARK}.catalyst.expressions.WindowSpecDefinition",
              "num-children": 1, "partitionSpec": [], "orderSpec": [],
-             "frameSpecification": 0}] + default_frame()
+             "frameSpecification": 0}] + default_frame(
+                 frame_type=frame_type)
     return [{"class": f"{SPARK}.catalyst.expressions.Alias",
              "num-children": 1, "child": 0, "name": f"w{eid}",
              "exprId": {"product-class":
@@ -258,7 +260,7 @@ def test_window_from_json(tables):
 
     rn = _window_call(
         {"class": f"{SPARK}.catalyst.expressions.RowNumber",
-         "num-children": 0}, 30)
+         "num-children": 0}, 30, frame_type="RowFrame$")
     sm = _window_call(
         agg_expr("Sum", attr("ss_ext_sales_price", "double", 3),
                  "Complete", 99, "double")[0:1] +
@@ -370,6 +372,9 @@ def test_bnlj_from_json(tables):
 
 
 def test_window_nondefault_frame_falls_back(tables):
+    """An AGGREGATE window with a bounded frame must fall back; a
+    rank-like fn ignores frames entirely (Spark resolves it with its own
+    ROWS frame and the result is frame-independent)."""
     ss, dd, ss_path, dd_path = tables
     a_item = attr("ss_item_sk", "long", 2)
     frame = [{"class": f"{SPARK}.catalyst.expressions.SpecifiedWindowFrame",
@@ -378,18 +383,38 @@ def test_window_nondefault_frame_falls_back(tables):
               "num-children": 0},
              {"class": f"{SPARK}.catalyst.expressions.Literal",
               "num-children": 0, "value": "3", "dataType": "integer"}]
+    spec = [{"class": f"{SPARK}.catalyst.expressions.WindowSpecDefinition",
+             "num-children": 1, "frameSpecification": 0}] + frame
     call = [{"class": f"{SPARK}.catalyst.expressions.Alias",
              "num-children": 1, "child": 0, "name": "w60",
              "exprId": {"id": 60, "jvmId": "x"}, "qualifier": []},
             {"class": f"{SPARK}.catalyst.expressions.WindowExpression",
-             "num-children": 2, "windowFunction": 0, "windowSpec": 1},
-            {"class": f"{SPARK}.catalyst.expressions.RowNumber",
-             "num-children": 0},
-            {"class": f"{SPARK}.catalyst.expressions.WindowSpecDefinition",
-             "num-children": 1, "frameSpecification": 0}] + frame
+             "num-children": 2, "windowFunction": 0, "windowSpec": 1}] + \
+        agg_expr("Sum", attr("ss_item_sk", "long", 2),
+                 "Complete", 97, "long") + spec
     plan = [
         {"class": f"{SPARK}.execution.window.WindowExec", "num-children": 1,
          "windowExpression": [call], "partitionSpec": [],
+         "orderSpec": [], "child": 0},
+        scan_node([ss_path], [a_item]),
+    ]
+    with pytest.raises(PlanJsonError):
+        decode_plan_json(json.dumps(plan))
+
+
+def test_window_first_agg_falls_back(tables):
+    """first(x) OVER (...) is not computable by ops/window.py — must be
+    rejected at decode time, not crash mid-query."""
+    ss, dd, ss_path, dd_path = tables
+    a_item = attr("ss_item_sk", "long", 2)
+    fa = _window_call(
+        agg_expr("First", attr("ss_item_sk", "long", 2),
+                 "Complete", 96, "long")[0:1] +
+        agg_expr("First", attr("ss_item_sk", "long", 2),
+                 "Complete", 96, "long")[1:], 62)
+    plan = [
+        {"class": f"{SPARK}.execution.window.WindowExec", "num-children": 1,
+         "windowExpression": [fa], "partitionSpec": [],
          "orderSpec": [], "child": 0},
         scan_node([ss_path], [a_item]),
     ]
