@@ -30,7 +30,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from blaze_tpu.config import conf
-from blaze_tpu.columnar.types import DataType, Field, Schema, TypeKind
+from blaze_tpu.columnar.types import (
+    INT64, DataType, Field, Schema, TypeKind,
+)
 
 Array = jax.Array
 
@@ -165,6 +167,11 @@ class Column:
 
     def normalized(self) -> "Column":
         """Zero out data in invalid slots (canonical form for hash/sort/serde)."""
+        if self.dtype.wide_decimal and self.validity is not None:
+            v = self.validity
+            planes = [Column(ch.dtype, jnp.where(v, ch.data, jnp.int64(0)),
+                             None) for ch in self.data.children]
+            return Column(self.dtype, StructData(planes), v)
         if self.validity is None or self.is_list or self.is_struct:
             return self
         if self.is_string:
@@ -321,6 +328,15 @@ class ColumnBatch:
                             else None for i in range(n)]
                 out[f.name] = vals
                 continue
+            if f.dtype.wide_decimal:
+                from blaze_tpu.columnar import int128 as i128
+
+                hi = np.asarray(c.data.children[0].data)[:n]
+                lo = np.asarray(c.data.children[1].data)[:n]
+                ints = i128.ints_from_np(hi, lo)
+                out[f.name] = [ints[i] if valid[i] else None
+                               for i in range(n)]
+                continue
             if c.is_struct:
                 sub = ColumnBatch(
                     Schema([Field(sf.name, sf.dtype)
@@ -387,6 +403,10 @@ def _list_take(ld: ListData, idx: Array) -> ListData:
 def _zero_column(dtype: DataType, cap: int) -> Column:
     from blaze_tpu.columnar.types import storage_element
 
+    if dtype.wide_decimal:
+        z = jnp.zeros((cap,), jnp.int64)
+        return Column(dtype, StructData(
+            [Column(INT64, z, None), Column(INT64, z, None)]), None)
     if dtype.is_string_like:
         w = bucket_width(1)
         return Column(dtype, StringData(jnp.zeros((cap, w), jnp.uint8),
@@ -407,6 +427,31 @@ def _zero_column(dtype: DataType, cap: int) -> Column:
 def _host_to_column(dtype: DataType, raw, cap: int, validity_np: Optional[np.ndarray]) -> Column:
     from blaze_tpu.columnar.types import storage_element
 
+    if dtype.wide_decimal:
+        import decimal as _dec
+
+        from blaze_tpu.columnar import int128 as i128
+
+        vals = list(raw)
+        if validity_np is None and any(v is None for v in vals):
+            validity_np = np.array([v is not None for v in vals], bool)
+        ints = []
+        for v in vals:
+            if v is None:
+                ints.append(0)
+            elif isinstance(v, _dec.Decimal):
+                ints.append(int(v.scaleb(dtype.scale)))
+            else:
+                ints.append(int(v))  # already-unscaled int
+        n = len(ints)
+        hi_np, lo_np = i128.np_from_ints(ints)
+        hi = np.zeros((cap,), np.int64)
+        lo = np.zeros((cap,), np.int64)
+        hi[:n], lo[:n] = hi_np, lo_np
+        return Column(dtype, StructData(
+            [Column(INT64, jnp.asarray(hi), None),
+             Column(INT64, jnp.asarray(lo), None)]),
+            _pad_validity(validity_np, n, cap)).normalized()
     if dtype.kind in (TypeKind.LIST, TypeKind.MAP):
         vals = list(raw)
         if validity_np is None and any(v is None for v in vals):

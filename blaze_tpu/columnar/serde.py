@@ -222,10 +222,13 @@ def _decode_col(fp: BinaryIO, dtype, n: int, cap: int):
         offsets[n + 1:] = offsets[n]
         return Column(dtype, ListData(jnp.asarray(offsets), elems),
                       _pad_validity(validity_np, n, cap))
-    if dtype.kind == TypeKind.STRUCT:
+    if dtype.kind == TypeKind.STRUCT or dtype.wide_decimal:
         from blaze_tpu.columnar.batch import StructData
+        from blaze_tpu.columnar.types import wide_decimal_storage
 
-        children = [_decode_col(fp, f.dtype, n, cap) for f in dtype.fields]
+        fields = (wide_decimal_storage(dtype).fields
+                  if dtype.wide_decimal else dtype.fields)
+        children = [_decode_col(fp, f.dtype, n, cap) for f in fields]
         return Column(dtype, StructData(children),
                       _pad_validity(validity_np, n, cap))
     if dtype.is_string_like:

@@ -184,6 +184,15 @@ def struct_of(fields) -> DataType:
     return DataType(TypeKind.STRUCT, fields=tuple(fields))
 
 
+def wide_decimal_storage(dtype: DataType) -> DataType:
+    """Physical storage of a decimal(p>18) column: struct<hi:int64,
+    lo:int64> limb planes, value = hi * 2^64 + u64(lo) (columnar/int128.py
+    — the engine's Decimal128, ref: arrow-rs i128 unscaled storage)."""
+    assert dtype.wide_decimal
+    return struct_of([Field("hi", INT64, nullable=False),
+                      Field("lo", INT64, nullable=False)])
+
+
 def storage_element(dtype: DataType) -> DataType:
     """Element dtype of the flat storage under a LIST or MAP column.
 

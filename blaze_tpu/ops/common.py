@@ -67,12 +67,14 @@ def _concat_one(parts, idx, field, cap):
         return _concat_list_columns(parts, idx, field, cap)
     if parts[0].is_struct:
         from blaze_tpu.columnar.batch import StructData
-        from blaze_tpu.columnar.types import Field
+        from blaze_tpu.columnar.types import Field, wide_decimal_storage
 
+        fields = (wide_decimal_storage(field.dtype).fields
+                  if field.dtype.wide_decimal else field.dtype.fields)
         children = [
             _concat_one([p.data.children[fi] for p in parts], idx,
                         Field(f.name, f.dtype), cap)
-            for fi, f in enumerate(field.dtype.fields)]
+            for fi, f in enumerate(fields)]
         return Column(field.dtype, StructData(children),
                       _concat_validity(parts, idx))
     if parts[0].is_string:

@@ -274,9 +274,13 @@ def grouped_multi(keys: Array, valid: Array, specs, rng: int):
     if _use_pallas(n, gh, P * _GL):
         # fused VMEM kernel; valid is already folded into every plane
         # (count planes are where(valid&cvalid, 1, 0); sum planes zero
-        # their invalid rows), and out-of-range keys match no one-hot row
-        kc = jnp.clip(keys.astype(jnp.int32), 0, gh * _GL - 1)
-        D = jnp.where(valid[:, None], D, jnp.bfloat16(0))
+        # their invalid rows). Out-of-range keys are masked here so both
+        # backends share the contract "rows outside [0, rng) contribute
+        # nothing" (the XLA one-hot drops them by construction; clipping
+        # alone would fold them into the last slot)
+        ok = valid & (keys >= 0) & (keys < rng)
+        kc = jnp.clip(keys, 0, rng - 1).astype(jnp.int32)
+        D = jnp.where(ok[:, None], D, jnp.bfloat16(0))
         part = _pallas_accumulate(kc, D, gh)            # (nblk, gh, P*GL)
     else:
         A = (oh_l[:, None, :] * D[:, :, None]).reshape(n, P * _GL)

@@ -99,7 +99,13 @@ def encode_column(col: Column, asc: bool, nulls_first: bool,
         keys.append(flag)
 
     k = col.dtype.kind
-    if col.is_string:
+    if col.dtype.wide_decimal:
+        # limb planes: sign-flipped hi (signed order) then raw lo
+        # (already unsigned order) give the 128-bit order
+        hi = col.data.children[0].data
+        lo = col.data.children[1].data
+        vals = [bits64.i64_ordered_u64(hi), lo.astype(jnp.uint64)]
+    elif col.is_string:
         vals = string_words(col.data, max_string_words, exact_string_words)
         vals.append(col.data.lengths.astype(jnp.uint32))
     elif k == TypeKind.BOOLEAN:

@@ -977,4 +977,15 @@ def _eval(e: ir.Expr, df: pd.DataFrame):
             raise NotImplementedError(
                 f"no Python fallback for scalar fn {e.name}")
         return fn(*[np.asarray(_eval(a, df)) for a in e.args])
+    if isinstance(e, ir.UdfWrapper):
+        # a NeverConvert parent can drag a decoded UDF onto this path;
+        # evaluate through the hive_udf registry (spark/hive_udf.py)
+        from blaze_tpu.spark import hive_udf
+
+        name = e.resource_id.split(":", 1)[-1]
+        hit = hive_udf.lookup(name)
+        if hit is None:
+            raise NotImplementedError(f"no evaluator for UDF {name}")
+        return hit[0](*[np.asarray(_eval(p, df), object)
+                        for p in e.params])
     raise NotImplementedError(f"fallback eval for {type(e).__name__}")

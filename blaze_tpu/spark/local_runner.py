@@ -6,7 +6,8 @@ moves shuffle blocks; this runner executes the same per-task native plans
 resource registry exactly the way the JVM shim would:
 
   map stage    : one task per upstream partition; each commits
-                 <dir>/stage<S>_map<M>.data/.index
+                 <dir>/shuffle_<S>_<M>.data/.index through the
+                 shuffle-manager drop-in (spark/shuffle_manager.py)
   reduce reads : "shuffle:<S>" resolves to a per-partition iterator over
                  all map outputs' partition-p segments (the MapStatus fetch)
   broadcast    : one collect task; "broadcast:<S>" replays its frames
@@ -31,7 +32,6 @@ from blaze_tpu.runtime.executor import execute_plan
 from blaze_tpu.spark.convert_strategy import apply_strategy
 from blaze_tpu.spark.plan_model import SparkPlan
 from blaze_tpu.spark.stages import Stage, plan_stages
-from blaze_tpu.ops.shuffle import read_shuffle_partition
 
 
 def run_plan(root: SparkPlan, num_partitions: int = 4,
@@ -74,8 +74,12 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
     work_dir = work_dir or tempfile.mkdtemp(prefix="blaze_tpu_stages_")
     os.makedirs(work_dir, exist_ok=True)
 
-    # stage -> map outputs [(data, index)] for shuffle; frames for broadcast
-    shuffle_outputs: Dict[int, List[tuple]] = {}
+    # the shuffle-manager drop-in tracks map outputs (MapStatus) and
+    # serves reduce-side readers — the role BlazeShuffleManager plays as
+    # spark.shuffle.manager in deployment
+    from blaze_tpu.spark.shuffle_manager import BlazeShuffleManager
+
+    shuffle_mgr = BlazeShuffleManager(work_dir)
     # AQE statistics: completed shuffles' total bytes + partition counts
     shuffle_bytes: Dict[int, int] = {}
     shuffle_parts: Dict[int, int] = {}
@@ -109,8 +113,7 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
                             work_dir=work_dir, stats=stats):
                         shuffle_bytes[stage.stage_id] = stats.get("bytes", 0)
                         continue
-                logical = _run_shuffle_stage(stage, stages, work_dir,
-                                             shuffle_outputs)
+                logical = _run_shuffle_stage(stage, stages, shuffle_mgr)
                 # logical (uncompressed) bytes: the mesh path reports the
                 # same unit, so the AQE threshold is transport-independent
                 shuffle_bytes[stage.stage_id] = logical
@@ -134,6 +137,7 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
                         f"broadcast:{stage.stage_id}",
                         f"broadcast_sink:{stage.stage_id}"):
                 resources.pop(key)
+            shuffle_mgr.unregister_shuffle(stage.stage_id)
 
 
 def _merge_fallback_root_sort(root: SparkPlan, out: ColumnBatch,
@@ -173,46 +177,32 @@ def _schema_of_reader(node: pb.PlanNode):
     return decode_schema(node.ipc_reader.schema)
 
 
-def _register_shuffle_reader(sid: int, outputs: List[tuple], schema) -> None:
-    def provider(partition: int):
-        def gen():
-            for data_path, index_path in outputs:
-                yield from read_shuffle_partition(data_path, index_path,
-                                                  partition, schema)
-        return gen()
-
-    resources.put(f"shuffle:{sid}", provider)
-
-
-def _run_shuffle_stage(stage: Stage, stages: List[Stage], work_dir: str,
-                       shuffle_outputs: Dict[int, List[tuple]]) -> int:
-    """Runs the map tasks; returns the stage's total LOGICAL output bytes
+def _run_shuffle_stage(stage: Stage, stages: List[Stage],
+                       shuffle_mgr) -> int:
+    """Runs the map tasks through the shuffle manager (register ->
+    per-task writer slot -> commit MapStatus -> reduce-side reader
+    resource); returns the stage's total LOGICAL output bytes
     (uncompressed, live rows only — the AQE statistic)."""
     ntasks = _input_tasks(stage, stages)
-    outputs = []
+    # the reader schema is the writer's input schema
+    reader_schema = decode_plan(stage.plan.shuffle_writer.input).schema
+    handle = shuffle_mgr.register_shuffle(
+        stage.stage_id, stage.num_partitions, reader_schema)
     logical = 0
     for task in range(ntasks):
         node = pb.PlanNode()
         node.CopyFrom(stage.plan)
-        data = os.path.join(work_dir,
-                            f"stage{stage.stage_id}_map{task}.data")
-        index = os.path.join(work_dir,
-                             f"stage{stage.stage_id}_map{task}.index")
-        node.shuffle_writer.data_file = data
-        node.shuffle_writer.index_file = index
+        slot = shuffle_mgr.get_writer(handle, task)
+        node.shuffle_writer.data_file = slot.data_path
+        node.shuffle_writer.index_file = slot.index_path
         op = decode_plan(node)
         list(execute_plan(op, ExecContext(partition=task,
                                           num_partitions=ntasks)))
         logical += op.metrics.values.get("shuffle_logical_bytes", 0)
-        outputs.append((data, index))
-    shuffle_outputs[stage.stage_id] = outputs
+        slot.commit()
 
-    # expose to downstream readers
-    from blaze_tpu.plan.from_proto import decode_schema
-
-    # the reader schema is the writer's input schema
-    reader_schema = decode_plan(stage.plan.shuffle_writer.input).schema
-    _register_shuffle_reader(stage.stage_id, outputs, reader_schema)
+    resources.put(f"shuffle:{stage.stage_id}",
+                  lambda partition: shuffle_mgr.get_reader(handle, partition))
     return logical
 
 

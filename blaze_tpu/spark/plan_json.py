@@ -181,6 +181,9 @@ _AGG_FN = {
     "CollectSet": "collect_set",
 }
 
+# engine-external function expressions (single source of truth there)
+from blaze_tpu.spark.hive_udf import UDF_CLASSES as _UDF_CLASSES  # noqa: E402
+
 
 def decode_expr(node: dict) -> ir.Expr:
     cls = _cls(node)
@@ -279,6 +282,10 @@ def decode_expr(node: dict) -> ir.Expr:
         return ir.ScalarFn(_FN[cls], tuple(decode_expr(c) for c in ch))
     if cls == "ScalarSubquery":
         raise PlanJsonError("scalar subquery needs the JVM wrapper")
+    if cls in _UDF_CLASSES:
+        from blaze_tpu.spark.hive_udf import decode_json_udf
+
+        return decode_json_udf(node, decode_expr)
     raise PlanJsonError(f"expression {cls} not convertible")
 
 

@@ -1,0 +1,161 @@
+"""Drop-in shuffle-manager surface over the engine's .data/.index format.
+
+Ref: the reference ships `BlazeShuffleManager` as a `spark.shuffle.manager`
+drop-in (shims `shuffle/*.scala`): `registerShuffle` returns a handle,
+`getWriter` gives a map task a writer that commits Spark-format shuffle
+files through `IndexShuffleBlockResolver`, `getReader` gives a reduce task
+an iterator over the fetched blocks, and MapStatus (the per-partition
+lengths parsed from the `.index` file, BlazeShuffleWriterBase.scala:84-96)
+is what the driver tracks for fetch planning.
+
+This module is that API over the TPU engine's identical file format
+(ops/shuffle.py writes concatenated per-partition zstd frame streams +
+a little-endian u64 offsets index). The local runner drives it for every
+file-path exchange — same call sequence a JVM BlazeShuffleManager shim
+would make — and a deployment embeds it by delegating those four calls.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional
+
+import numpy as np
+
+from blaze_tpu.columnar.batch import ColumnBatch
+from blaze_tpu.columnar.types import Schema
+from blaze_tpu.ops.shuffle import read_shuffle_partition
+
+
+@dataclass(frozen=True)
+class ShuffleHandle:
+    """What registerShuffle hands back (ref: BaseShuffleHandle)."""
+    shuffle_id: int
+    num_partitions: int
+    schema: Schema
+
+
+@dataclass(frozen=True)
+class MapStatus:
+    """One map task's committed output (ref: Spark MapStatus — location +
+    per-reduce-partition lengths, parsed from the .index file)."""
+    map_id: int
+    data_path: str
+    index_path: str
+    partition_lengths: tuple
+
+    @property
+    def total_bytes(self) -> int:
+        return int(sum(self.partition_lengths))
+
+
+class ShuffleWriteSlot:
+    """getWriter result: where a map task must commit, plus the commit
+    handshake (parse .index -> MapStatus -> register with the manager),
+    mirroring BlazeShuffleWriterBase.nativeShuffleWrite + Shims.commit."""
+
+    def __init__(self, manager: "BlazeShuffleManager",
+                 handle: ShuffleHandle, map_id: int) -> None:
+        self._manager = manager
+        self.handle = handle
+        self.map_id = map_id
+        base = os.path.join(manager.work_dir,
+                            f"shuffle_{handle.shuffle_id}_{map_id}")
+        self.data_path = base + ".data"
+        self.index_path = base + ".index"
+
+    def commit(self) -> MapStatus:
+        """Parse the committed .index into partition lengths and register
+        the MapStatus (ref: BlazeShuffleWriterBase.scala:84-109)."""
+        offsets = np.frombuffer(open(self.index_path, "rb").read(), "<u8")
+        expected = self.handle.num_partitions + 1
+        if len(offsets) != expected:
+            raise ValueError(
+                f".index has {len(offsets)} offsets, expected {expected}")
+        lengths = tuple(int(offsets[i + 1] - offsets[i])
+                        for i in range(self.handle.num_partitions))
+        status = MapStatus(self.map_id, self.data_path, self.index_path,
+                           lengths)
+        self._manager._register_map_output(self.handle.shuffle_id, status)
+        return status
+
+
+class BlazeShuffleManager:
+    """registerShuffle / getWriter / getReader / unregisterShuffle over
+    .data/.index files (ref: BlazeShuffleManager in the shims)."""
+
+    def __init__(self, work_dir: str) -> None:
+        self.work_dir = work_dir
+        os.makedirs(work_dir, exist_ok=True)
+        self._handles: Dict[int, ShuffleHandle] = {}
+        self._map_outputs: Dict[int, List[MapStatus]] = {}
+
+    # -- driver side --------------------------------------------------
+
+    def register_shuffle(self, shuffle_id: int, num_partitions: int,
+                         schema: Schema) -> ShuffleHandle:
+        if shuffle_id in self._handles:
+            raise ValueError(f"shuffle {shuffle_id} already registered")
+        handle = ShuffleHandle(shuffle_id, num_partitions, schema)
+        self._handles[shuffle_id] = handle
+        self._map_outputs[shuffle_id] = []
+        return handle
+
+    def unregister_shuffle(self, shuffle_id: int,
+                           delete_files: bool = True) -> None:
+        self._handles.pop(shuffle_id, None)
+        outputs = self._map_outputs.pop(shuffle_id, [])
+        if delete_files:
+            for st in outputs:
+                for p in (st.data_path, st.index_path):
+                    try:
+                        os.remove(p)
+                    except OSError:
+                        pass
+
+    # -- map side -----------------------------------------------------
+
+    def get_writer(self, handle: ShuffleHandle, map_id: int
+                   ) -> ShuffleWriteSlot:
+        return ShuffleWriteSlot(self, handle, map_id)
+
+    def _register_map_output(self, shuffle_id: int,
+                             status: MapStatus) -> None:
+        self._map_outputs[shuffle_id].append(status)
+
+    # -- reduce side ----------------------------------------------------
+
+    def map_statuses(self, shuffle_id: int) -> List[MapStatus]:
+        return list(self._map_outputs.get(shuffle_id, []))
+
+    def total_bytes(self, shuffle_id: int) -> int:
+        return sum(st.total_bytes for st in self.map_statuses(shuffle_id))
+
+    def get_reader(self, handle: ShuffleHandle, partition: int,
+                   ) -> Iterator[ColumnBatch]:
+        """All map outputs' segment `partition` (the MapStatus-tracked
+        fetch; local FileSegment zero-copy path of
+        BlazeBlockStoreShuffleReaderBase.readIpc)."""
+        statuses = self._map_outputs.get(handle.shuffle_id)
+        if statuses is None:
+            raise KeyError(f"shuffle {handle.shuffle_id} not registered")
+
+        def gen():
+            for st in statuses:
+                if st.partition_lengths[partition] == 0:
+                    continue  # MapStatus says empty: skip the fetch
+                yield from read_shuffle_partition(
+                    st.data_path, st.index_path, partition, handle.schema)
+        return gen()
+
+    def get_all_partitions_reader(self, handle: ShuffleHandle
+                                  ) -> Iterator[ColumnBatch]:
+        """Every partition of every map output — Spark's local-shuffle-
+        reader shape that AQE's SMJ->BHJ conversion reads build sides
+        with (spark/aqe.py)."""
+        def gen():
+            for p in range(handle.num_partitions):
+                yield from self.get_reader(handle, p)
+        return gen()

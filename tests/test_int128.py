@@ -1,0 +1,128 @@
+"""128-bit limb arithmetic (columnar/int128.py) vs Python-int oracle."""
+
+import numpy as np
+import pytest
+
+from blaze_tpu.columnar import int128 as i128
+
+I128_MIN = -(1 << 127)
+I128_MAX = (1 << 127) - 1
+
+
+def rand_i128(rng, n, bits=126):
+    out = []
+    for _ in range(n):
+        b = int(rng.integers(1, bits))
+        v = int(rng.integers(0, 1 << 30)) | (int(rng.integers(0, 2)) << b)
+        v = v * (1 if rng.integers(0, 2) else -1)
+        out.append(v)
+    out += [0, 1, -1, (1 << 64) - 1, 1 << 64, -(1 << 64),
+            10 ** 38 - 1, -(10 ** 38 - 1)]
+    return out
+
+
+def planes(vals):
+    hi, lo = i128.np_from_ints(vals)
+    import jax.numpy as jnp
+
+    return jnp.asarray(hi), jnp.asarray(lo)
+
+
+def back(h, l):
+    return i128.ints_from_np(np.asarray(h), np.asarray(l))
+
+
+def wrap128(v):
+    u = v & ((1 << 128) - 1)
+    return u - (1 << 128) if u >= (1 << 127) else u
+
+
+def test_roundtrip(rng):
+    vals = rand_i128(rng, 50)
+    h, l = planes(vals)
+    assert back(h, l) == vals
+
+
+def test_add_sub_neg(rng):
+    a = rand_i128(rng, 60)
+    b = rand_i128(rng, 60)
+    ah, al = planes(a)
+    bh, bl = planes(b)
+    assert back(*i128.add(ah, al, bh, bl)) == \
+        [wrap128(x + y) for x, y in zip(a, b)]
+    assert back(*i128.sub(ah, al, bh, bl)) == \
+        [wrap128(x - y) for x, y in zip(a, b)]
+    assert back(*i128.neg(ah, al)) == [wrap128(-x) for x in a]
+    assert back(*i128.abs_(ah, al)) == [wrap128(abs(x)) for x in a]
+
+
+def test_cmp(rng):
+    a = rand_i128(rng, 60)
+    b = rand_i128(rng, 60)
+    b[:10] = a[:10]  # force equals
+    ah, al = planes(a)
+    bh, bl = planes(b)
+    got = list(np.asarray(i128.cmp(ah, al, bh, bl)))
+    want = [(x > y) - (x < y) for x, y in zip(a, b)]
+    assert got == want
+    assert list(np.asarray(i128.eq(ah, al, bh, bl))) == \
+        [x == y for x, y in zip(a, b)]
+
+
+def test_mul_i64(rng):
+    a = [int(x) for x in rng.integers(-2**62, 2**62, 80)] + \
+        [2**63 - 1, -(2**63), 0, -1]
+    b = [int(x) for x in rng.integers(-2**62, 2**62, 80)] + \
+        [2**63 - 1, -(2**63), 7, -(2**63)]
+    import jax.numpy as jnp
+
+    aj = jnp.asarray(np.array(a, np.int64))
+    bj = jnp.asarray(np.array(b, np.int64))
+    got = back(*i128.mul_i64(aj, bj))
+    assert got == [wrap128(x * y) for x, y in zip(a, b)]
+
+
+def test_mul_small_and_rescale(rng):
+    vals = rand_i128(rng, 40, bits=90)
+    h, l = planes(vals)
+    assert back(*i128.mul_small(h, l, 10 ** 9)) == \
+        [wrap128(v * 10 ** 9) for v in vals]
+    # upscale by 10^12
+    assert back(*i128.rescale(h, l, 12)) == \
+        [wrap128(v * 10 ** 12) for v in vals]
+    # downscale with HALF_UP
+    got = back(*i128.rescale(h, l, -7))
+    for g, v in zip(got, vals):
+        q, r = divmod(abs(v), 10 ** 7)
+        w = q + (1 if 2 * r >= 10 ** 7 else 0)
+        assert g == (w if v >= 0 else -w)
+
+
+def test_divmod_small(rng):
+    vals = rand_i128(rng, 40, bits=120)
+    h, l = planes(vals)
+    qh, ql, rem = i128.divmod_small(h, l, 999_999_937)
+    got_q = back(qh, ql)
+    got_r = list(np.asarray(rem))
+    for gq, gr, v in zip(got_q, got_r, vals):
+        assert gq == abs(v) // 999_999_937
+        assert gr == abs(v) % 999_999_937
+
+
+def test_to_i64_and_precision(rng):
+    vals = [0, 5, -5, 2**63 - 1, -(2**63), 2**63, -(2**63) - 1,
+            10**19, -(10**19), 10**37]
+    h, l = planes(vals)
+    v64, fits = i128.to_i64_checked(h, l)
+    for x, f in zip(vals, np.asarray(fits)):
+        assert bool(f) == (-(2**63) <= x < 2**63)
+    inp = list(np.asarray(i128.in_precision(h, l, 19)))
+    for x, f in zip(vals, inp):
+        assert bool(f) == (abs(x) < 10 ** 19)
+
+
+def test_from_i64():
+    import jax.numpy as jnp
+
+    x = jnp.asarray(np.array([5, -5, 2**63 - 1, -(2**63)], np.int64))
+    assert back(*i128.from_i64(x)) == [5, -5, 2**63 - 1, -(2**63)]
