@@ -191,14 +191,23 @@ def column_from_arrow(arr, dtype: T.DataType, cap: int) -> Column:
 
         return _zero_column(dtype, cap)
     if dtype.is_decimal:
-        if dtype.wide_decimal:
-            raise TypeError(f"decimal precision {dtype.precision} > 18 not device-native")
         d = arr.cast(pa.decimal128(dtype.precision, dtype.scale)).fill_null(0)
-        # decimal128 buffer = 16-byte LE two's complement; p<=18 fits in the
-        # low 8 bytes, so the low int64 word IS the unscaled value
+        # decimal128 buffer = 16-byte LE two's complement; the low int64
+        # word is the unscaled value for p<=18, and (lo, hi) word pairs
+        # are exactly the engine's wide-decimal limb planes
         buf = d.buffers()[1]
-        np_vals = np.frombuffer(buf, np.int64, count=2 * n,
-                                offset=d.offset * 16)[0::2].copy()
+        words = np.frombuffer(buf, np.int64, count=2 * n,
+                              offset=d.offset * 16)
+        if dtype.wide_decimal:
+            from blaze_tpu.columnar.batch import StructData
+
+            lo = _pad1d(words[0::2].copy(), cap, np.int64)
+            hi = _pad1d(words[1::2].copy(), cap, np.int64)
+            return Column(dtype, StructData(
+                [Column(T.INT64, jnp.asarray(hi), None),
+                 Column(T.INT64, jnp.asarray(lo), None)]),
+                _pad_validity(validity, n, cap)).normalized()
+        np_vals = words[0::2].copy()
     elif dtype.kind == T.TypeKind.TIMESTAMP:
         np_vals = np.asarray(arr.cast(pa.timestamp("us")).fill_null(0), np.int64)
     elif dtype.kind == T.TypeKind.BOOLEAN:
@@ -239,6 +248,18 @@ def batch_to_arrow(batch: ColumnBatch) -> pa.RecordBatch:
             else:
                 py = [v if valid[i] else None for i, v in enumerate(vals)]
                 arrays.append(pa.array(py, pa.binary()))
+            continue
+        if f.dtype.wide_decimal:
+            from decimal import Decimal
+
+            from blaze_tpu.columnar import int128 as i128
+
+            hi = np.asarray(c.data.children[0].data)[:n]
+            lo = np.asarray(c.data.children[1].data)[:n]
+            ints = i128.ints_from_np(hi, lo)
+            py = [Decimal(ints[i]).scaleb(-f.dtype.scale) if valid[i]
+                  else None for i in range(n)]
+            arrays.append(pa.array(py, dtype_to_arrow(f.dtype)))
             continue
         d = np.asarray(c.data)[:n]
         at = dtype_to_arrow(f.dtype)

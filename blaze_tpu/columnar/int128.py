@@ -18,11 +18,16 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 Array = jax.Array
 
-_I64_MIN = jnp.int64(-0x8000000000000000)
-_MASK32 = jnp.int64(0xFFFFFFFF)
+# numpy scalars: module-level jnp constants are concrete device
+# arrays that jit LIFTS into scalar-i64 buffer arguments in some
+# flows — the axon backend cannot execute those (InvalidArgument);
+# np scalars always fold into program literals
+_I64_MIN = np.int64(-0x8000000000000000)
+_MASK32 = np.int64(0xFFFFFFFF)
 
 
 def _u_lt(a: Array, b: Array) -> Array:
@@ -126,12 +131,15 @@ def mul_small(h: Array, l: Array, m: int) -> Tuple[Array, Array]:
     return jnp.where(sign, nh, hi), jnp.where(sign, nl, ml)
 
 
-def divmod_small(h: Array, l: Array, d: int) -> Tuple[Array, Array, Array]:
-    """magnitude divmod by a small positive int (< 2^31):
+def divmod_small(h: Array, l: Array, d) -> Tuple[Array, Array, Array]:
+    """magnitude divmod by a small positive divisor (< 2^31):
     (qh, ql, rem) on the MAGNITUDE; caller handles sign/rounding.
-    Long division over four 32-bit limbs."""
-    assert 0 < d < (1 << 31)
-    dd = jnp.int64(d)
+    Long division over four 32-bit limbs. `d` may be a python int or an
+    int64 Array of per-row divisors — the < 2^31 bound is the CALLER's
+    contract for arrays (values beyond it overflow the per-limb step)."""
+    if not isinstance(d, jax.Array):
+        assert 0 < d < (1 << 31)
+    dd = jnp.asarray(d, jnp.int64)
     ah, al = abs_(h, l)
     limbs = [(ah >> 32) & _MASK32, ah & _MASK32,
              (al >> 32) & _MASK32, al & _MASK32]
@@ -144,6 +152,22 @@ def divmod_small(h: Array, l: Array, d: int) -> Tuple[Array, Array, Array]:
     qh = (q[0] << 32) | q[1]
     ql = (q[2] << 32) | q[3]
     return qh, ql, rem
+
+
+def rescale_checked(h: Array, l: Array, delta: int, half_up: bool = True
+                    ) -> Tuple[Array, Array, Array]:
+    """rescale plus a per-row ok flag: upscaling by 10^delta WRAPS mod
+    2^128 when |v| >= 2^127 / 10^delta — wrapped residues can alias back
+    into valid ranges and defeat downstream in_precision checks, so
+    callers must null (or saturate) rows with ok=False. Downscaling
+    cannot overflow (ok all-true)."""
+    if delta > 0:
+        # |v| < 10^(38-delta) guarantees |v * 10^delta| < 10^38 < 2^127
+        ok = in_precision(h, l, max(38 - delta, 0))
+    else:
+        ok = jnp.ones(h.shape, jnp.bool_)
+    hh, ll = rescale(h, l, delta, half_up)
+    return hh, ll, ok
 
 
 def rescale(h: Array, l: Array, delta: int, half_up: bool = True

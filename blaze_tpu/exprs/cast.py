@@ -37,6 +37,13 @@ def cast_column(col: Column, target: DataType) -> Column:
     if target.is_string_like:
         return _to_string(col, target)
 
+    if src.wide_decimal or target.wide_decimal:
+        from blaze_tpu.exprs import wide_decimal as W
+
+        if target.wide_decimal:
+            return W.cast_to_wide(col, target)
+        return W.cast_from_wide(col, target)
+
     k, tk = src.kind, target.kind
     valid = col.validity
     data = col.data
@@ -155,9 +162,14 @@ def _decimal_rescale(data: Array, valid, src: DataType, target: DataType) -> Col
 
 def check_overflow(col: Column, precision: int, scale: int) -> Column:
     """Ref proto CheckOverflow: null out values exceeding precision."""
+    target = DataType(TypeKind.DECIMAL, precision=precision, scale=scale)
+    if col.dtype.wide_decimal or target.wide_decimal:
+        from blaze_tpu.exprs import wide_decimal as W
+
+        return W.check_overflow(col, precision, scale, target)
     bound = 10 ** min(precision, 18)
     ok = jnp.abs(col.data) < bound
-    return Column(DataType(TypeKind.DECIMAL, precision=precision, scale=scale),
+    return Column(target,
                   jnp.where(ok, col.data, 0), _and_valid(col.validity, ok))
 
 

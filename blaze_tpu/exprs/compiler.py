@@ -93,7 +93,16 @@ def _compile_expr(expr: ir.Expr, schema) -> CompiledExpr:
         return lambda b: _map_col(c(b), BOOLEAN, lambda d: ~d)
     if isinstance(expr, ir.Negate):
         c = compile_expr(expr.child, schema)
-        return lambda b: (lambda col: Column(col.dtype, -col.data, col.validity))(c(b))
+
+        def run_neg(b):
+            col = c(b)
+            if col.dtype.wide_decimal:
+                from blaze_tpu.exprs import wide_decimal as W
+
+                return W.negate(col)
+            return Column(col.dtype, -col.data, col.validity)
+
+        return run_neg
     if isinstance(expr, ir.IsNull):
         c = compile_expr(expr.child, schema)
         return lambda b: Column(BOOLEAN, ~c(b).valid_mask(), None)
@@ -338,6 +347,13 @@ def _compile_literal(expr: ir.Literal) -> CompiledExpr:
             return Column(dt, _const_string(raw, cap), None)
         if dt.kind == TypeKind.BOOLEAN:
             return Column(dt, jnp.full((cap,), bool(v)), None)
+        if dt.wide_decimal:
+            from blaze_tpu.columnar import int128 as i128
+            from blaze_tpu.exprs import wide_decimal as W
+
+            hi, lo = i128.np_from_ints([int(v)])
+            return W.build(dt, jnp.full((cap,), hi[0], jnp.int64),
+                           jnp.full((cap,), lo[0], jnp.int64), None)
         return Column(dt, jnp.full((cap,), v, dt.jnp_dtype()), None)
 
     return run
@@ -371,7 +387,11 @@ def _compile_binary(expr: ir.Binary, schema) -> CompiledExpr:
 
 
 def _compare(lc: Column, rc: Column, op: ir.BinOp) -> Column:
-    if lc.is_string or rc.is_string:
+    if lc.dtype.wide_decimal or rc.dtype.wide_decimal:
+        from blaze_tpu.exprs import wide_decimal as W
+
+        lt, eq, gt = W.compare(lc, rc)
+    elif lc.is_string or rc.is_string:
         lt, eq = S.compare(lc.data, rc.data)
         gt = ~lt & ~eq
     else:
@@ -470,6 +490,14 @@ def _decimal_arith(lc: Column, rc: Column, op: ir.BinOp,
                    result_type: Optional[DataType], validity) -> Column:
     """Unscaled int64 decimal arithmetic (ref NativeConverters.scala:599-676
     decimal special cases; plan supplies the result precision/scale)."""
+    if (lc.dtype.wide_decimal or rc.dtype.wide_decimal
+            or (result_type is not None and result_type.wide_decimal)):
+        from blaze_tpu.exprs import wide_decimal as W
+
+        if result_type is None or not result_type.is_decimal:
+            raise NotImplementedError(
+                "wide decimal arithmetic needs a planned result type")
+        return W.arith(lc, rc, op, result_type, validity)
     ls = lc.dtype.scale if lc.dtype.is_decimal else 0
     rs = rc.dtype.scale if rc.dtype.is_decimal else 0
     ld = lc.data.astype(jnp.int64)

@@ -20,6 +20,7 @@ from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from blaze_tpu.columnar import bits64
 from blaze_tpu.columnar.batch import Column, StringData
@@ -27,9 +28,14 @@ from blaze_tpu.columnar.types import TypeKind
 
 Array = jax.Array
 
-_C1 = jnp.uint32(0xCC9E2D51)
-_C2 = jnp.uint32(0x1B873593)
-_M5 = jnp.uint32(0xE6546B64)
+# numpy scalars, NOT jnp: module-level jnp constants are concrete device
+# arrays that jit lifts into scalar buffer arguments in some trace
+# contexts — the axon backend cannot execute scalar-int buffer args, and
+# the varying lifted-const count corrupts cached-executable reuse
+# (runtime/jit_cache._with_stale_exec_retry is the backstop)
+_C1 = np.uint32(0xCC9E2D51)
+_C2 = np.uint32(0x1B873593)
+_M5 = np.uint32(0xE6546B64)
 
 SPARK_SHUFFLE_SEED = 42
 

@@ -453,6 +453,25 @@ class AggExec(Operator):
         valid = x.valid_mask()
         if fn == "sum":
             sd = _sum_state_dtype(call.dtype)
+            if sd.wide_decimal:
+                from blaze_tpu.columnar import int128 as i128
+                from blaze_tpu.exprs import wide_decimal as W
+
+                live = valid & layout.row_mask
+                h, l = W.planes(x)
+                # Spark sums keep the input scale; rescale defensively if
+                # the planned result scale differs (delta 0 is a no-op).
+                # A row that WRAPS during the upscale poisons its group
+                # (Spark: overflow -> null) — wrapped residues would
+                # otherwise defeat the sum's overflow shadow.
+                h, l, rok = i128.rescale_checked(h, l,
+                                                 sd.scale - x.dtype.scale)
+                sh, sl, ok = W.seg_sum_wide(h, l, live, layout, seg)
+                ok = ok & ~_seg_any(live & ~rok, layout)
+                nonempty = seg.seg_sum(valid.astype(jnp.int64), layout,
+                                       jnp.ones_like(valid)) > 0
+                return [W.build(sd, sh, sl, ok),
+                        Column(T.BOOLEAN, nonempty, None)]
             data = x.data.astype(sd.jnp_dtype())
             s = seg.seg_sum(jnp.where(valid, data, 0), layout, valid)
             nonempty = seg.seg_sum(valid.astype(jnp.int64), layout,
@@ -461,15 +480,38 @@ class AggExec(Operator):
         if fn == "avg":
             sd = (call.dtype if call.dtype.kind == TypeKind.DECIMAL
                   else T.FLOAT64)
-            data = x.data.astype(sd.jnp_dtype())
-            s = seg.seg_sum(jnp.where(valid, data, 0), layout, valid)
             cnt = seg.seg_sum(valid.astype(jnp.int64), layout,
                               jnp.ones_like(valid))
+            if sd.wide_decimal:
+                from blaze_tpu.columnar import int128 as i128
+                from blaze_tpu.exprs import wide_decimal as W
+
+                live = valid & layout.row_mask
+                # state at the RESULT scale so finalize only divides;
+                # rows wrapping during the upscale poison their group
+                h, l = W.planes(x)
+                h, l, rok = i128.rescale_checked(h, l,
+                                                 sd.scale - x.dtype.scale)
+                sh, sl, ok = W.seg_sum_wide(h, l, live, layout, seg)
+                ok = ok & ~_seg_any(live & ~rok, layout)
+                return [W.build(sd, sh, sl, ok),
+                        Column(T.INT64, cnt, None)]
+            data = x.data.astype(sd.jnp_dtype())
+            s = seg.seg_sum(jnp.where(valid, data, 0), layout, valid)
             return [Column(sd, s, None), Column(T.INT64, cnt, None)]
         if fn in ("min", "max"):
             red = seg.seg_min if fn == "min" else seg.seg_max
             if x.is_string:
                 return self._minmax_string(call, x, layout, fn)
+            if call.dtype.wide_decimal:
+                from blaze_tpu.exprs import wide_decimal as W
+
+                h, l = W.planes(x)
+                mh, ml, has = W.seg_minmax_wide(
+                    h, l, valid & layout.row_mask, layout, seg,
+                    fn == "min")
+                return [W.build(call.dtype, mh, ml, None),
+                        Column(T.BOOLEAN, has, None)]
             val, has = red(x.data, layout, valid)
             return [Column(call.dtype, val, None),
                     Column(T.BOOLEAN, has, None)]
@@ -600,12 +642,24 @@ class AggExec(Operator):
                 cnt = seg.seg_sum(cols[0].data, layout, ones)
                 out.append(Column(T.INT64, cnt, None))
             elif fn == "sum":
+                if cols[0].dtype.wide_decimal:
+                    out += self._merge_sum_wide(cols, layout, ones)
+                    continue
                 s = seg.seg_sum(jnp.where(cols[1].data, cols[0].data, 0),
                                 layout, ones)
                 ne = _seg_any(cols[1].data, layout)
                 out += [Column(cols[0].dtype, s, None),
                         Column(T.BOOLEAN, ne, None)]
             elif fn == "avg":
+                if cols[0].dtype.wide_decimal:
+                    scol, _ = self._merge_sum_wide(
+                        [cols[0], Column(T.BOOLEAN,
+                                         jnp.ones((sb.capacity,),
+                                                  jnp.bool_), None)],
+                        layout, ones)
+                    cnt = seg.seg_sum(cols[1].data, layout, ones)
+                    out += [scol, Column(T.INT64, cnt, None)]
+                    continue
                 s = seg.seg_sum(cols[0].data, layout, ones)
                 cnt = seg.seg_sum(cols[1].data, layout, ones)
                 out += [Column(cols[0].dtype, s, None),
@@ -615,6 +669,15 @@ class AggExec(Operator):
                     masked = Column(cols[0].dtype, cols[0].data,
                                     cols[1].data)
                     out.extend(self._minmax_string(call, masked, layout, fn))
+                elif cols[0].dtype.wide_decimal:
+                    from blaze_tpu.exprs import wide_decimal as W
+
+                    h, l = W.planes(cols[0])
+                    mh, ml, has = W.seg_minmax_wide(
+                        h, l, cols[1].data & layout.row_mask, layout, seg,
+                        fn == "min")
+                    out += [W.build(cols[0].dtype, mh, ml, None),
+                            Column(T.BOOLEAN, has, None)]
                 else:
                     red = seg.seg_min if fn == "min" else seg.seg_max
                     val, has = red(cols[0].data, layout, cols[1].data)
@@ -636,6 +699,24 @@ class AggExec(Operator):
             else:
                 raise NotImplementedError(fn)
         return out
+
+    def _merge_sum_wide(self, cols, layout, ones):
+        """Re-sum wide-decimal partial sums (limb planes); empty partials
+        contribute nothing, an overflowed contributing partial poisons
+        its group (validity False -> null result)."""
+        from blaze_tpu.exprs import wide_decimal as W
+
+        state, ne_col = cols[0], cols[1]
+        ne = ne_col.data & layout.row_mask
+        h, l = W.planes(state)
+        h = jnp.where(ne, h, jnp.int64(0))
+        l = jnp.where(ne, l, jnp.int64(0))
+        sh, sl, ok = W.seg_sum_wide(h, l, ne, layout, seg)
+        ok_in = state.valid_mask() | ~ne
+        group_ok = ~_seg_any(~ok_in, layout)
+        ne_out = _seg_any(ne, layout)
+        return [W.build(state.dtype, sh, sl, ok & group_ok),
+                Column(T.BOOLEAN, ne_out, None)]
 
     # ---- finalize ----
     def _finalize_jit(self, state: ColumnBatch) -> ColumnBatch:
@@ -662,8 +743,26 @@ class AggExec(Operator):
         if fn == "count":
             return scols[0]
         if fn == "sum":
+            if scols[0].dtype.wide_decimal:
+                from blaze_tpu.columnar import int128 as i128
+                from blaze_tpu.exprs import wide_decimal as W
+
+                # Spark nulls sums exceeding the result precision; the
+                # seg shadow only catches magnitudes past 1.5e38
+                h, l = W.planes(scols[0])
+                inp = i128.in_precision(h, l, call.dtype.precision)
+                v = scols[1].data & scols[0].valid_mask() & inp
+                return Column(call.dtype, scols[0].data, v)
             return Column(scols[0].dtype, scols[0].data, scols[1].data)
         if fn == "avg":
+            if call.dtype.wide_decimal:
+                from blaze_tpu.exprs import wide_decimal as W
+
+                h, l = W.planes(scols[0])
+                cnt = scols[1].data
+                qh, ql, ok_div = W.div_by_count(h, l, cnt, call.dtype, 0)
+                ok = (cnt > 0) & ok_div & scols[0].valid_mask()
+                return W.build(call.dtype, qh, ql, ok)
             s, cnt = scols[0].data, scols[1].data
             ok = cnt > 0
             if call.dtype.kind == TypeKind.DECIMAL:

@@ -97,7 +97,11 @@ def staged_all_to_all(batch: ColumnBatch, pid: Array, axis_name: str,
         if isinstance(c.data, StringData):
             data = StringData(exchange(c.data.bytes), exchange(c.data.lengths))
         else:
-            data = exchange(c.data)
+            # row-aligned storages (dense arrays, wide-decimal limb-plane
+            # structs) exchange per pytree leaf; LIST storage cannot ride
+            # the mesh path (element storage isn't row-aligned) and is
+            # screened out by run_mesh_shuffle_stage's shape checks
+            data = jax.tree_util.tree_map(exchange, c.data)
         validity = exchange(c.validity) if c.validity is not None else None
         cols.append(Column(c.dtype, data, validity))
 

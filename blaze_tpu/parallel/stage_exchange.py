@@ -172,11 +172,26 @@ def run_mesh_shuffle_stage(stage_plan: pb.PlanNode, stage_id: int,
             if not exchange_batch(batch):
                 spill_batch_to_file(batch)
 
+    def _unshard(x):
+        # Batches sliced out of the shard_map output stay committed
+        # across the mesh devices. Downstream task programs are
+        # single-device: feeding them multi-device pytrees trips XLA
+        # buffer mismatches (and a fresh compile against them can wait on
+        # collectives that never run). Round-trip through host to an
+        # UNCOMMITTED default-device array — committed placement would
+        # break a later mesh stage's shard_map instead. Single-device
+        # leaves (the real-chip case) pass through untouched.
+        import numpy as np
+
+        if hasattr(x, "devices") and len(x.devices()) > 1:
+            return jnp.asarray(np.asarray(x))
+        return x
+
     def provider(partition: int):
         # defaulted extra args would miscount as task-context params in
         # _call_provider's arity dispatch — close over state instead
         for b in recv_parts[partition]:
-            yield b
+            yield jax.tree_util.tree_map(_unshard, b)
         for data, index in file_outputs:
             yield from read_shuffle_partition(data, index, partition, schema)
 

@@ -81,6 +81,10 @@ def decode_scalar(p: pb.ScalarValue) -> ir.Literal:
     v = getattr(p, which)
     if which == "binary_value":
         v = bytes(v)
+    if which == "decimal_unscaled" and dt.wide_decimal:
+        u = ((p.decimal_unscaled_hi & ((1 << 64) - 1)) << 64) | \
+            (int(v) & ((1 << 64) - 1))
+        v = u - (1 << 128) if u >= (1 << 127) else u
     return ir.Literal(dt, v)
 
 

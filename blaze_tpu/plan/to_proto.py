@@ -89,7 +89,16 @@ def encode_literal(lit: ir.Literal) -> pb.ScalarValue:
                T.TypeKind.INT64, T.TypeKind.DATE, T.TypeKind.TIMESTAMP):
         out.int_value = int(v)
     elif k == T.TypeKind.DECIMAL:
-        out.decimal_unscaled = int(v)
+        u = int(v)
+        if lit.dtype.wide_decimal:
+            lo_u = u & 0xFFFFFFFFFFFFFFFF
+            hi_u = (u >> 64) & 0xFFFFFFFFFFFFFFFF
+            out.decimal_unscaled = (lo_u - (1 << 64)
+                                    if lo_u >= (1 << 63) else lo_u)
+            out.decimal_unscaled_hi = (hi_u - (1 << 64)
+                                       if hi_u >= (1 << 63) else hi_u)
+        else:
+            out.decimal_unscaled = u
     elif k in (T.TypeKind.FLOAT32, T.TypeKind.FLOAT64):
         out.float_value = float(v)
     elif k == T.TypeKind.STRING:

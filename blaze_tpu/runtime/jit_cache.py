@@ -35,8 +35,44 @@ def get_or_compile(key: Hashable, make_fn: Callable[[], Callable],
             return fn
         _stats["misses"] += 1
     built = jax.jit(make_fn(), **jit_kwargs) if jit else make_fn()
+    if jit:
+        built = _with_stale_exec_retry(key, built, make_fn, jit_kwargs)
     with _lock:
         return _cache.setdefault(key, built)
+
+
+def _with_stale_exec_retry(key, fn, make_fn, jit_kwargs):
+    """Self-healing wrapper for a rare XLA dispatch inconsistency.
+
+    Re-executing a cached jitted fn on inputs with identical pytree /
+    avals / shardings can fail with `INVALID_ARGUMENT: Execution supplied
+    N buffers but compiled program expected M buffers` (observed on the
+    forced-multi-device CPU backend with struct-backed columns; the
+    executable's captured-constant accounting goes stale). A fresh trace
+    of the same program always succeeds, so on that specific error we
+    evict, rebuild once, and re-dispatch — correctness is unaffected and
+    steady-state cost is zero."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        holder = _retry.setdefault(key, [fn])
+        try:
+            return holder[0](*args, **kwargs)
+        # raised as ValueError on some paths and as XlaRuntimeError (a
+        # RuntimeError subclass) on others — match by message
+        except (ValueError, RuntimeError) as e:
+            if "buffers but compiled program expected" not in str(e):
+                raise
+            _stats["stale_exec_rebuilds"] = \
+                _stats.get("stale_exec_rebuilds", 0) + 1
+            holder[0] = jax.jit(make_fn(), **jit_kwargs)
+            return holder[0](*args, **kwargs)
+
+    return wrapped
+
+
+_retry: Dict[Hashable, list] = {}
 
 
 def stats() -> Dict[str, int]:
@@ -46,4 +82,5 @@ def stats() -> Dict[str, int]:
 def clear() -> None:
     with _lock:
         _cache.clear()
+        _retry.clear()
         _stats.update(hits=0, misses=0)

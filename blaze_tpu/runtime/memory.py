@@ -172,13 +172,15 @@ def batch_nbytes(batch: ColumnBatch) -> int:
 
 
 def _col_nbytes(c) -> int:
-    from blaze_tpu.columnar.batch import ListData, StringData
+    from blaze_tpu.columnar.batch import ListData, StringData, StructData
 
     n = 0
     if isinstance(c.data, StringData):
         n += c.data.bytes.size + 4 * c.data.lengths.shape[0]
     elif isinstance(c.data, ListData):
         n += 4 * c.data.offsets.shape[0] + _col_nbytes(c.data.elements)
+    elif isinstance(c.data, StructData):
+        n += sum(_col_nbytes(ch) for ch in c.data.children)
     else:
         n += c.data.size * c.data.dtype.itemsize
     if c.validity is not None:

@@ -20,6 +20,7 @@ import threading
 import uuid
 from typing import Callable, Dict, Iterator, List, Optional
 
+from blaze_tpu.columnar import types as T
 from blaze_tpu.columnar.types import Schema, TypeKind
 from blaze_tpu.config import conf
 from blaze_tpu.exprs import ir
@@ -194,15 +195,17 @@ def _exprs_convertible(plan: SparkPlan) -> bool:
     conversion (NativeConverters.convertExpr:290-372); serializing an
     unknown fn by name would only explode at execution.
 
-    Also rejects wide decimals (precision > 18) anywhere visible at the
-    node — output schema, input (child) schemas, or expression dtypes: the
-    engine's decimal columns are int64-unscaled, so a p>18 plan would
-    silently truncate instead of computing 128-bit (the reference is
-    Decimal128 throughout blaze-serde/cast.rs). Such nodes stay on the
-    fallback path."""
+    Wide decimals (p > 18) convert only where the engine's Decimal128
+    limb kernels cover the usage (exprs/wide_decimal.py): pass-through /
+    sort / scan / non-keyed exchange, aggregates in _WIDE_OK_AGG_FNS
+    (sum/avg/min/max/count/first*) over NARROW grouping keys, and
+    expression subtrees limited to add/sub, bounded mul, compares,
+    negate, null tests, supported casts and CheckOverflow. Anything else
+    (wide grouping/join keys, window/generate on wide, division, wide
+    hash-partition keys) stays on the fallback path."""
     from blaze_tpu.exprs.functions import is_supported
 
-    if _any_wide_decimal(plan):
+    if _any_wide_decimal(plan) and not _wide_usage_ok(plan):
         return False
     for root in _iter_attr_exprs(plan.attrs):
         stack = [root]
@@ -212,6 +215,142 @@ def _exprs_convertible(plan: SparkPlan) -> bool:
                 return False
             stack.extend(e.children())
     return True
+
+
+# node kinds where wide-decimal columns may appear (given the expression
+# checks below); everything else — agg, joins, window, generate, expand —
+# falls back until its wide path exists
+_WIDE_OK_KINDS = {
+    "FileSourceScanExec", "ProjectExec", "FilterExec", "SortExec",
+    "LocalLimitExec", "GlobalLimitExec", "UnionExec",
+    "TakeOrderedAndProjectExec", "DataWritingCommandExec",
+    "InsertIntoHadoopFsRelationCommand",
+}
+
+_WIDE_CMP = {ir.BinOp.EQ, ir.BinOp.NEQ, ir.BinOp.LT, ir.BinOp.LE,
+             ir.BinOp.GT, ir.BinOp.GE, ir.BinOp.EQ_NULLSAFE}
+_WIDE_CASTABLE_SRC = (TypeKind.INT8, TypeKind.INT16, TypeKind.INT32,
+                      TypeKind.INT64, TypeKind.BOOLEAN)
+_WIDE_CAST_TARGETS = (TypeKind.INT32, TypeKind.INT64, TypeKind.FLOAT64)
+
+
+_AGG_KINDS = {"HashAggregateExec", "SortAggregateExec",
+              "ObjectHashAggregateExec"}
+# wide-capable agg fns (ops/agg.py limb-plane branches; first* is
+# take-based and storage-agnostic)
+_WIDE_OK_AGG_FNS = {"sum", "avg", "min", "max", "count", "first",
+                    "first_ignores_null"}
+
+
+def _wide_usage_ok(plan: SparkPlan) -> bool:
+    in_schema = plan.children[0].schema if plan.children else plan.schema
+    if plan.kind in _EXCHANGE_KINDS:
+        # pass-through wide columns ride the frame serde; HASH KEYS must
+        # not be wide (murmur3 over limb planes is not implemented)
+        keys = plan.attrs.get("keys") or []
+        return not any(_touches_wide(e, in_schema) for e in keys)
+    if plan.kind in _AGG_KINDS:
+        # GROUPING on wide keys needs limb-aware neighbor-equality in the
+        # group layout — not wired; wide AGGREGATES are
+        for g in plan.attrs.get("grouping", []):
+            if _touches_wide(g, in_schema):
+                return False
+        for call in plan.attrs.get("aggs", []):
+            wide = (call["dtype"].wide_decimal
+                    or any(_touches_wide(a, in_schema)
+                           for a in call["args"]))
+            if not wide:
+                continue
+            if call["fn"] not in _WIDE_OK_AGG_FNS:
+                return False
+            if not all(_wide_subtree_ok(a, in_schema)
+                       for a in call["args"]):
+                return False
+        return True
+    if plan.kind not in _WIDE_OK_KINDS:
+        return False
+    for root in _iter_attr_exprs(plan.attrs):
+        if not _wide_subtree_ok(root, in_schema):
+            return False
+    return True
+
+
+def _col_dtype(e: ir.Expr, schema) -> Optional[T.DataType]:
+    """Result dtype of an expression when statically determinable."""
+    if isinstance(e, ir.Col):
+        try:
+            return schema.fields[schema.index_of(e.name)].dtype
+        except KeyError:
+            return None
+    if isinstance(e, ir.Literal):
+        return e.dtype
+    if isinstance(e, ir.Cast):
+        return e.dtype
+    if isinstance(e, ir.Binary):
+        return e.result_type
+    if isinstance(e, ir.CheckOverflow):
+        return T.decimal(e.precision, e.scale)
+    if isinstance(e, ir.MakeDecimal):
+        return T.decimal(e.precision, e.scale)
+    if isinstance(e, ir.Negate):
+        return _col_dtype(e.child, schema)
+    return None
+
+
+def _touches_wide(e: ir.Expr, schema) -> bool:
+    dt = _col_dtype(e, schema)
+    if dt is not None and dt.wide_decimal:
+        return True
+    for d in _expr_dtypes(e):
+        if d.wide_decimal:
+            return True
+    return any(_touches_wide(c, schema) for c in e.children())
+
+
+def _wide_subtree_ok(e: ir.Expr, schema) -> bool:
+    if not _touches_wide(e, schema):
+        return True
+    if isinstance(e, (ir.Col, ir.Literal)):
+        return True
+    if isinstance(e, (ir.IsNull, ir.IsNotNull, ir.Negate,
+                      ir.CheckOverflow)):
+        return all(_wide_subtree_ok(c, schema) for c in e.children())
+    if isinstance(e, ir.Cast):
+        src = _col_dtype(e.child, schema)
+        dst = e.dtype
+        if src is None:
+            return False
+        if dst.wide_decimal:
+            ok = src.is_decimal or src.kind in _WIDE_CASTABLE_SRC
+        elif src.wide_decimal:
+            ok = ((dst.is_decimal and not dst.wide_decimal)
+                  or dst.kind in _WIDE_CAST_TARGETS)
+        else:
+            ok = True
+        return ok and _wide_subtree_ok(e.child, schema)
+    if isinstance(e, ir.Binary):
+        lt = _col_dtype(e.left, schema)
+        rt = _col_dtype(e.right, schema)
+        kids_ok = (_wide_subtree_ok(e.left, schema)
+                   and _wide_subtree_ok(e.right, schema))
+        if e.op in _WIDE_CMP:
+            # the limb comparator needs decimal on both sides
+            return (kids_ok and lt is not None and rt is not None
+                    and lt.is_decimal and rt.is_decimal)
+        if e.op in (ir.BinOp.ADD, ir.BinOp.SUB):
+            return (kids_ok and e.result_type is not None
+                    and e.result_type.is_decimal
+                    and lt is not None and rt is not None
+                    and lt.is_decimal and rt.is_decimal)
+        if e.op == ir.BinOp.MUL:
+            # the 128-bit product is exact only while p1+p2 <= 38
+            return (kids_ok and e.result_type is not None
+                    and e.result_type.is_decimal
+                    and lt is not None and rt is not None
+                    and lt.is_decimal and rt.is_decimal
+                    and lt.precision + rt.precision <= 38)
+        return False  # division/mod need 128-bit long division
+    return False
 
 
 def _flag_name(kind: str) -> str:

@@ -553,6 +553,18 @@ def _op_agg(plan: SparkPlan, part: int, nparts: int) -> pd.DataFrame:
         # NativeAggBase): the original grouping exprs reference pre-shuffle
         # columns that no longer exist — bind positionally instead
         df = df.rename(columns=dict(zip(df.columns[:len(gnames)], gnames)))
+    # GLOBAL aggregate (no grouping): synthesize one constant group —
+    # Spark emits exactly one row even over empty input, so guarantee a
+    # row exists for the synthetic group
+    synthetic = not gnames
+    if synthetic:
+        gnames = ["__global__"]
+        df["__global__"] = np.int32(0)
+        # a global FINAL/MERGE over empty state still emits one row
+        # (count 0, sum/min/max null); a partial emits none and the
+        # final side synthesizes
+        if not len(df) and mode != "partial":
+            df = _global_identity_rows(plan)
 
     from blaze_tpu.ops.agg import AGG_BUF_PREFIX
 
@@ -683,7 +695,41 @@ def _op_agg(plan: SparkPlan, part: int, nparts: int) -> pd.DataFrame:
                 raise NotImplementedError(f"fallback merge agg {fn}")
         else:
             raise NotImplementedError(f"fallback agg mode {mode}")
-    return pd.DataFrame(out_cols)
+    out = pd.DataFrame(out_cols)
+    if synthetic:
+        out = out.drop(columns=["__global__"])
+    return out
+
+
+def _global_identity_rows(plan: SparkPlan) -> pd.DataFrame:
+    """One identity STATE row for a global final/merge over empty input;
+    the reductions over it produce Spark's global-agg-on-empty answers
+    (count 0, sum/min/max null)."""
+    from blaze_tpu.ops.agg import AGG_BUF_PREFIX
+
+    row: Dict[str, Any] = {"__global__": np.int32(0)}
+    for i, call in enumerate(plan.attrs["aggs"]):
+        p = f"{AGG_BUF_PREFIX}.{i}"
+        fn = call["fn"]
+        if fn == "sum":
+            row[f"{p}.sum"] = 0
+            row[f"{p}.nonempty"] = False
+        elif fn == "count":
+            row[f"{p}.count"] = 0
+        elif fn == "avg":
+            row[f"{p}.sum"] = 0
+            row[f"{p}.count"] = 0
+        elif fn in ("min", "max"):
+            row[f"{p}.val"] = None
+            row[f"{p}.has"] = False
+        elif fn in ("first", "first_ignores_null"):
+            row[f"{p}.val"] = None
+            row[f"{p}.has"] = False
+            if fn == "first":
+                row[f"{p}.valid"] = False
+        elif fn in ("collect_list", "collect_set"):
+            row[f"{p}.list"] = []
+    return pd.DataFrame([row])
 
 
 def _op_join(plan: SparkPlan, part: int, nparts: int) -> pd.DataFrame:

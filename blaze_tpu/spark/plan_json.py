@@ -212,7 +212,17 @@ def decode_expr(node: dict) -> ir.Expr:
             return ir.Literal(dt, int(Decimal(str(v)).scaleb(dt.scale)))
         return ir.Literal(dt, str(v))
     if cls in _BIN:
-        return ir.Binary(_BIN[cls], decode_expr(ch[0]), decode_expr(ch[1]))
+        # Catalyst arithmetic nodes carry their planned dataType — the
+        # decimal result precision/scale the engine must honor
+        # (NativeConverters.scala:599-676)
+        rt = None
+        if node.get("dataType") is not None:
+            try:
+                rt = decode_datatype(node.get("dataType"))
+            except PlanJsonError:
+                rt = None
+        return ir.Binary(_BIN[cls], decode_expr(ch[0]), decode_expr(ch[1]),
+                         result_type=rt)
     if cls == "Not":
         return ir.Not(decode_expr(ch[0]))
     if cls == "IsNull":
