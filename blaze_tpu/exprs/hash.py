@@ -111,6 +111,38 @@ def hash_bytes(s: StringData, seed: Array) -> Array:
     return _fmix(h, lens.astype(jnp.uint32))
 
 
+def _hash_wide_decimal(col: Column, seed: Array) -> Array:
+    """Spark hash of a decimal with precision > 18: murmur3 over the
+    MINIMAL big-endian two's-complement byte array of the unscaled
+    BigInteger (java BigInteger.toByteArray), i.e. leading sign-filler
+    bytes are stripped while one sign bit stays. Built as a (cap, 16)
+    byte matrix + per-row length and fed to the string hasher."""
+    hi = col.data.children[0].data
+    lo = col.data.children[1].data
+    # big-endian 16-byte representation
+    parts = []
+    for word in (hi, lo):
+        for b in range(7, -1, -1):
+            parts.append(((word >> (8 * b)) & jnp.int64(0xFF)
+                          ).astype(jnp.uint8))
+    be = jnp.stack(parts, axis=1)                      # (cap, 16)
+    filler = jnp.where(hi < 0, jnp.uint8(0xFF), jnp.uint8(0))
+    # count leading bytes droppable: byte == filler AND the NEXT byte's
+    # sign bit matches (so the retained prefix still encodes the sign)
+    nxt = jnp.concatenate([be[:, 1:], be[:, -1:]], axis=1)
+    next_sign_ok = (nxt >> 7) == (filler[:, None] >> 7)
+    droppable = (be == filler[:, None]) & next_sign_ok
+    # prefix-run length of droppable (stop at first non-droppable),
+    # capped at 15 so at least one byte remains
+    run = jnp.cumprod(droppable.astype(jnp.int32), axis=1)
+    strip = jnp.minimum(jnp.sum(run, axis=1), 15).astype(jnp.int32)
+    length = jnp.int32(16) - strip
+    # left-align: shift each row left by `strip` bytes
+    idx = (jnp.arange(16, dtype=jnp.int32)[None, :] + strip[:, None])
+    aligned = jnp.take_along_axis(be, jnp.minimum(idx, 15), axis=1)
+    return hash_bytes(StringData(aligned, length), seed)
+
+
 def hash_column(col: Column, seed: Array, row_mask: Optional[Array] = None) -> Array:
     """Chainable per-column hash: null (or padding) rows keep `seed`."""
     k = col.dtype.kind
@@ -120,6 +152,8 @@ def hash_column(col: Column, seed: Array, row_mask: Optional[Array] = None) -> A
         h = hash_int32(col.data.astype(jnp.int32), seed)
     elif k == TypeKind.BOOLEAN:
         h = hash_int32(col.data.astype(jnp.int32), seed)
+    elif k == TypeKind.DECIMAL and col.dtype.wide_decimal:
+        h = _hash_wide_decimal(col, seed)
     elif k in (TypeKind.INT64, TypeKind.TIMESTAMP, TypeKind.DECIMAL):
         h = hash_int64(col.data, seed)
     elif k == TypeKind.FLOAT32:

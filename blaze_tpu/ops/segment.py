@@ -45,7 +45,12 @@ def _col_neighbor_eq(col: Column) -> Array:
         in_len = pos < l[:, None]
         data_eq = (l == lprev) & jnp.all(
             jnp.where(in_len, b == bprev, True), axis=1)
-    else:
+    elif col.is_struct:
+        # struct-backed storage (incl. wide decimals' limb planes):
+        # rows equal when every child plane is equal
+        data_eq = jnp.ones((cap,), jnp.bool_)
+        for ch in col.data.children:
+            data_eq = data_eq & (ch.data == jnp.roll(ch.data, 1))
         data_eq = col.data == jnp.roll(col.data, 1)
         if jnp.issubdtype(col.data.dtype, jnp.floating):
             # NaN == NaN for grouping (Spark), -0.0 == 0.0

@@ -197,12 +197,13 @@ def _exprs_convertible(plan: SparkPlan) -> bool:
 
     Wide decimals (p > 18) convert only where the engine's Decimal128
     limb kernels cover the usage (exprs/wide_decimal.py): pass-through /
-    sort / scan / non-keyed exchange, aggregates in _WIDE_OK_AGG_FNS
-    (sum/avg/min/max/count/first*) over NARROW grouping keys, and
-    expression subtrees limited to add/sub, bounded mul, compares,
-    negate, null tests, supported casts and CheckOverflow. Anything else
-    (wide grouping/join keys, window/generate on wide, division, wide
-    hash-partition keys) stays on the fallback path."""
+    sort / scan / exchanges (incl. wide hash keys), grouped aggregates in
+    _WIDE_OK_AGG_FNS (sum/avg/min/max/count/first*, wide grouping keys
+    included), equality joins on type-matched wide keys, and expression
+    subtrees limited to add/sub, bounded mul, compares, negate, null
+    tests, supported casts and CheckOverflow. Anything else (window/
+    generate on wide, division, BNLJ wide conditions beyond the
+    allowlist) stays on the fallback path."""
     from blaze_tpu.exprs.functions import is_supported
 
     if _any_wide_decimal(plan) and not _wide_usage_ok(plan):
@@ -242,18 +243,23 @@ _WIDE_OK_AGG_FNS = {"sum", "avg", "min", "max", "count", "first",
                     "first_ignores_null"}
 
 
+_WIDE_JOIN_KINDS = {"SortMergeJoinExec", "BroadcastHashJoinExec",
+                    "ShuffledHashJoinExec"}
+
+
 def _wide_usage_ok(plan: SparkPlan) -> bool:
     in_schema = plan.children[0].schema if plan.children else plan.schema
     if plan.kind in _EXCHANGE_KINDS:
-        # pass-through wide columns ride the frame serde; HASH KEYS must
-        # not be wide (murmur3 over limb planes is not implemented)
-        keys = plan.attrs.get("keys") or []
-        return not any(_touches_wide(e, in_schema) for e in keys)
+        # wide hash keys partition through the device murmur3 over the
+        # minimal big-endian two's-complement bytes (exprs/hash.py,
+        # JVM Spark's p>18 semantics); pass-through rides the frame serde
+        return True
     if plan.kind in _AGG_KINDS:
-        # GROUPING on wide keys needs limb-aware neighbor-equality in the
-        # group layout — not wired; wide AGGREGATES are
+        # wide GROUPING keys group via limb-plane neighbor-equality
+        # (ops/segment.py struct branch) and two-key sort order; wide
+        # AGGREGATES are limited to the limb-kernel set
         for g in plan.attrs.get("grouping", []):
-            if _touches_wide(g, in_schema):
+            if not _wide_subtree_ok(g, in_schema):
                 return False
         for call in plan.attrs.get("aggs", []):
             wide = (call["dtype"].wide_decimal
@@ -265,6 +271,30 @@ def _wide_usage_ok(plan: SparkPlan) -> bool:
                 return False
             if not all(_wide_subtree_ok(a, in_schema)
                        for a in call["args"]):
+                return False
+        return True
+    if plan.kind in _WIDE_JOIN_KINDS:
+        # equality joins compare ENCODED key arrays, which the wide
+        # two-key encoding serves — but both sides must share the exact
+        # decimal type or equal values encode differently (Spark's key
+        # normalization projections guarantee this in real plans)
+        lsch = plan.children[0].schema
+        rsch = plan.children[1].schema
+        for lk, rk in zip(plan.attrs.get("left_keys", []),
+                          plan.attrs.get("right_keys", [])):
+            lt = _col_dtype(lk, lsch)
+            rt = _col_dtype(rk, rsch)
+            lw = lt is not None and lt.wide_decimal
+            rw = rt is not None and rt.wide_decimal
+            if lw != rw or (lw and lt != rt):
+                return False
+            if not (_wide_subtree_ok(lk, lsch)
+                    and _wide_subtree_ok(rk, rsch)):
+                return False
+        cond = plan.attrs.get("condition")
+        if cond is not None:
+            joined = Schema(list(lsch.fields) + list(rsch.fields))
+            if not _wide_subtree_ok(cond, joined):
                 return False
         return True
     if plan.kind not in _WIDE_OK_KINDS:
