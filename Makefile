@@ -1,0 +1,18 @@
+# The commit gate. Run `make check` before EVERY snapshot commit —
+# round 3 shipped with 38/252 tests red because this didn't exist.
+# Mirrors the reference's CI gate (.github/workflows/tpcds.yml): the
+# full suite plus the query-level validator matrix, both on the
+# virtual 8-device CPU mesh.
+
+PYENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
+
+.PHONY: check test validate
+
+check: test validate
+	@echo "CHECK OK — safe to commit"
+
+test:
+	$(PYENV) python -m pytest tests/ -q
+
+validate:
+	$(PYENV) python validate.py

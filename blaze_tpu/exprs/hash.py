@@ -93,6 +93,12 @@ def hash_bytes(s: StringData, seed: Array) -> Array:
     nfull = lens // 4  # number of full 4-byte words
 
     h = jnp.broadcast_to(seed.astype(jnp.uint32), (cap,))
+    # Under shard_map the loop body's output is varying over the manual
+    # mesh axes (it reads the sharded batch data) while `h` derives only
+    # from the replicated seed — fori_loop then rejects the carry type.
+    # XOR-with-zero of batch data promotes h to the same varying type
+    # without changing its value (fused away by XLA).
+    h = h ^ (lens.astype(jnp.uint32) & jnp.uint32(0))
 
     def word_step(j, h):
         wj = jax.lax.dynamic_index_in_dim(words, j, axis=1, keepdims=False)

@@ -51,6 +51,7 @@ def _col_neighbor_eq(col: Column) -> Array:
         data_eq = jnp.ones((cap,), jnp.bool_)
         for ch in col.data.children:
             data_eq = data_eq & (ch.data == jnp.roll(ch.data, 1))
+    else:
         data_eq = col.data == jnp.roll(col.data, 1)
         if jnp.issubdtype(col.data.dtype, jnp.floating):
             # NaN == NaN for grouping (Spark), -0.0 == 0.0

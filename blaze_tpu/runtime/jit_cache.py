@@ -54,9 +54,11 @@ def _with_stale_exec_retry(key, fn, make_fn, jit_kwargs):
     steady-state cost is zero."""
     import functools
 
+    with _lock:
+        holder = _retry.setdefault(key, [fn])
+
     @functools.wraps(fn)
     def wrapped(*args, **kwargs):
-        holder = _retry.setdefault(key, [fn])
         try:
             return holder[0](*args, **kwargs)
         # raised as ValueError on some paths and as XlaRuntimeError (a
@@ -64,9 +66,10 @@ def _with_stale_exec_retry(key, fn, make_fn, jit_kwargs):
         except (ValueError, RuntimeError) as e:
             if "buffers but compiled program expected" not in str(e):
                 raise
-            _stats["stale_exec_rebuilds"] = \
-                _stats.get("stale_exec_rebuilds", 0) + 1
-            holder[0] = jax.jit(make_fn(), **jit_kwargs)
+            with _lock:
+                _stats["stale_exec_rebuilds"] = \
+                    _stats.get("stale_exec_rebuilds", 0) + 1
+                holder[0] = jax.jit(make_fn(), **jit_kwargs)
             return holder[0](*args, **kwargs)
 
     return wrapped

@@ -17,6 +17,8 @@ evaluating a UDF on the JVM.
 
 from __future__ import annotations
 
+import decimal
+import math
 import operator
 from typing import Any, Callable, Dict, Iterator, List
 
@@ -309,9 +311,28 @@ def _mm3_bytes(b: bytes, seed: int) -> int:
 
 
 def _round_half_up(a, d):
+    """Spark Round on doubles: BigDecimal.valueOf(d).setScale(s, HALF_UP).
+    BigDecimal.valueOf goes through Double.toString (shortest repr), which
+    Python's repr matches — so decimal.Decimal(repr(x)) reproduces the JVM
+    result on boundary values like round(2.675, 2) where float math does
+    not (2.675 is stored as 2.67499...95, but its shortest repr is
+    "2.675", which HALF_UP rounds to 2.68)."""
     av = np.asarray(a, np.float64)
-    scale = 10.0 ** int(np.asarray(d).reshape(-1)[0]) if d is not None else 1.0
-    return np.sign(av) * np.floor(np.abs(av) * scale + 0.5) / scale
+    nd = int(np.asarray(d).reshape(-1)[0]) if d is not None else 0
+    q = decimal.Decimal(1).scaleb(-nd)
+
+    def one(x):
+        if not math.isfinite(x):
+            return x
+        # java BigDecimal.setScale has unbounded precision; the default
+        # 28-digit context raises InvalidOperation for |x| >= ~1e26.
+        # 400 covers the full double range (1e308) at any target scale.
+        with decimal.localcontext(prec=400):
+            return float(decimal.Decimal(repr(x)).quantize(
+                q, rounding=decimal.ROUND_HALF_UP))
+
+    return np.asarray([one(float(x)) for x in np.ravel(av)],
+                      np.float64).reshape(av.shape)
 
 
 def _lpad(s: str, n: int, p: str) -> str:

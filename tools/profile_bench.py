@@ -1,0 +1,136 @@
+"""Per-phase profile of the bench pipeline on the real chip.
+
+Times each piece of the whole-stage program in isolation (chain mask
+compute, digit-plane build, pallas one-hot accumulate, XLA one-hot
+accumulate, recombination) so BENCH gains a published breakdown
+(VERDICT r3 item 2). Writes JSON to stdout, diagnostics to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+ROWS = 1 << 21
+GROUPS = 1 << 16
+REPS = 4
+
+
+def timeit(fn, *args):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    ts = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def main():
+    from blaze_tpu.ops import mxu_agg
+
+    print(f"platform={jax.default_backend()}", file=sys.stderr)
+    rng = np.random.default_rng(0)
+    keys = jnp.asarray(rng.integers(0, GROUPS, ROWS).astype(np.int32))
+    qty = jnp.asarray(rng.integers(1, 100, ROWS).astype(np.int32))
+    price = jnp.asarray(rng.random(ROWS) * 100)
+    valid = jnp.ones((ROWS,), jnp.bool_)
+    jax.block_until_ready((keys, qty, price))
+
+    res = {}
+
+    # sync floor
+    tiny = jax.device_put(np.zeros(8, np.float32))
+    floors = []
+    for _ in range(7):
+        t0 = time.perf_counter()
+        np.asarray(tiny)
+        floors.append(time.perf_counter() - t0)
+    res["sync_floor_ms"] = float(np.median(floors)) * 1e3
+
+    # 1. chain only: filter mask + project
+    @jax.jit
+    def chain(qty, price):
+        mask = (qty <= 50) & (price > 10.0)
+        amount = qty.astype(jnp.float64) * price
+        return mask, amount
+
+    res["chain_ms"] = timeit(chain, qty, price) * 1e3
+
+    mask, amount = chain(qty, price)
+
+    # 2. digit-plane build only (what grouped_multi does before the matmul)
+    @jax.jit
+    def planes(amount, mask):
+        v = jnp.where(mask, amount, 0.0)
+        absv = jnp.abs(v)
+        maxv = jnp.max(absv)
+        exp = jnp.floor(jnp.log2(jnp.maximum(maxv, 1e-300))) + 1.0
+        s = jnp.minimum(48.0 - exp, 1000.0)
+        scaled = jnp.round(absv * jnp.exp2(s)).astype(jnp.int64)
+        sign = jnp.where(v < 0, -1.0, 1.0).astype(jnp.bfloat16)
+        ps = [jnp.where(mask, 1.0, 0.0).astype(jnp.bfloat16)]
+        for c in range(6):
+            ps.append(((scaled >> (8 * c)) & 0xFF).astype(jnp.bfloat16) * sign)
+        return jnp.stack(ps, axis=1)
+
+    res["planes_ms"] = timeit(planes, amount, mask) * 1e3
+    D = planes(amount, mask)
+    gh = GROUPS // 128
+
+    # 3. pallas accumulate alone
+    def pallas_acc(keys, D):
+        return mxu_agg._pallas_accumulate(keys, D, gh)
+
+    if jax.default_backend() == "tpu":
+        pj = jax.jit(pallas_acc)
+        res["pallas_acc_ms"] = timeit(pj, keys, D) * 1e3
+        part = pj(keys, D)
+        res["pallas_part_shape"] = list(part.shape)
+
+        @jax.jit
+        def recombine(part):
+            return jnp.sum(part.astype(jnp.float64), axis=0)
+
+        res["recombine_ms"] = timeit(recombine, part) * 1e3
+
+    # 4. XLA one-hot accumulate alone
+    @jax.jit
+    def xla_acc(keys, D, valid):
+        oh_l, oh_h = mxu_agg._onehots(keys, valid, gh)
+        n, P = D.shape
+        A = (oh_l[:, None, :] * D[:, :, None]).reshape(n, P * 128)
+        blk = mxu_agg._blk(n)
+        nb = n // blk
+        return jax.lax.dot_general(
+            oh_h.reshape(nb, blk, gh), A.reshape(nb, blk, P * 128),
+            (((1,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+
+    res["xla_acc_ms"] = timeit(xla_acc, keys, D, valid) * 1e3
+
+    # 5. full grouped_multi (one batch)
+    @jax.jit
+    def gm(keys, amount, mask):
+        return mxu_agg.grouped_multi(
+            keys, mask, [("count", jnp.ones_like(mask)),
+                         ("sum", amount, jnp.ones_like(mask))], GROUPS)
+
+    res["grouped_multi_ms"] = timeit(gm, keys, amount, mask) * 1e3
+
+    # theoretical floor
+    P = int(D.shape[1])
+    flops = 2 * ROWS * GROUPS * P
+    res["tflop_per_batch"] = flops / 1e12
+    print(json.dumps(res, indent=1))
+
+
+if __name__ == "__main__":
+    main()
