@@ -9,15 +9,40 @@ counts become ONE-HOT MATMULS: decompose key k into (hi, lo) parts, then
     S[hi, lo] = sum_r v_r * onehot_hi(r) (x) onehot_lo(r)
               = A^T B  with  A = onehot_lo * v  (n x GL),  B = onehot_hi
 
-which runs on the systolic array at TFLOP rates instead of the VPU's
-sort/scatter paths. Exactness: values are decomposed into 8-bit integer
-digits (integers <= 256 are exact in bfloat16); per-block partial sums stay
-below 2^24 so the MXU's f32 accumulation is exact; digits recombine in f64.
-Relative error is bounded by the fixed-point quantization, 2^-48 of the
-batch max — the same 49-bit effective mantissa this backend's emulated f64
-has anyway. GL is 128 (not 256): the digit-scaled side is the (n, GL)
-matrix, and halving it halves the dominant memory traffic while the matmul
-FLOPs (2*n*R) stay identical.
+which runs on the systolic array instead of the VPU's sort/scatter paths.
+
+int8 engine (v2): values decompose into BALANCED base-256 digits
+d_c in [-128, 127] (digits of v + bias, bias = 0x80 per byte, minus 128 —
+signs fold into the digits, no separate sign plane), the one-hot sides are
+int8, and the MXU runs s8 x s8 -> s32 at TWICE the bf16 rate (v5e: 394
+TOPS vs 197 TFLOPS). int32 accumulation of 8-bit digits is EXACT for up to
+2^23 rows per block (127 * 2^23 < 2^31), so a whole 2M-row batch
+accumulates in ONE block — no (nblk, ...) partial carrier in HBM and no
+32-way f64 recombination per batch (both were measured costs of the bf16
+formulation). Digits recombine in f64: exact for int64 sums within 2^53
+(descending-power partial coefficients stay < 2^53 when the total does),
+and to 46 bits of the batch max for f64 sums — the same class as this
+backend's emulated-f64 mantissa.
+
+GL is 128: the digit-scaled side is the (n, P*GL) matrix, and keeping GL
+at one lane-tile halves that carrier vs 256 while total matmul FLOPs
+(2*n*R*P) are GL-invariant.
+
+Non-finite float values cannot ride digit planes (digits of NaN/Inf are
+garbage that would corrupt EVERY group's slot, not just their own): the
+builders detect them per batch and report a `bad` flag so the caller falls
+back to the general streaming path — same contract as the stage compiler's
+out-of-range key flag.
+
+Streaming use (the stage compiler's lax.scan over a stage's batches) rides
+the split API — digitize() / accumulate() / finalize(): the scan carry
+stays in RAW DIGIT-PLANE SPACE ((gh, P, GL) f64, one fused
+multiply-accumulate per batch) and the 6-8-term digit recombination plus
+per-aggregate carry updates run ONCE per stage instead of once per batch.
+Float planes fold their per-batch scale 2^-s into the carry weight
+(recombination is linear in the planes, so scaling commutes); int and
+count planes carry weight 1 and stay exact (digit sums across 64 batches
+of 2^23 rows stay under 2^38 << 2^53).
 
 No reference analog: this is the TPU-first replacement for the hash-table
 accumulate of agg_tables.rs:360-430 (SURVEY.md §7b).
@@ -29,21 +54,39 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 Array = jax.Array
 
-CHUNK_BITS = 8          # integers <= 256 are exact in bfloat16
-F64_CHUNKS = 6          # 48 bits ~ this backend's effective f64 mantissa
-I64_CHUNKS = 8          # 64 bits (top chunk carries bits 56..62)
+CHUNK_BITS = 8
+F64_CHUNKS = 6          # 46-bit effective precision (see _float_digits)
+I64_CHUNKS = 8          # full int64 (|v| < 2^62; sums exact within 2^53)
 MAX_RANGE = 1 << 16
 _GL = 128
 
+# balanced-digit biases: digits of (v + BIAS) are the balanced digits + 128
+_BIAS6 = np.int64(128 * ((1 << 48) - 1) // 255)     # 6-chunk (f64 path)
+_BIAS8 = np.uint64(128 * ((1 << 64) - 1) // 255)    # 8-chunk (i64 path)
+
 # pallas fused path (TPU only): the XLA formulation materializes the
-# (n, P*GL) digit-carrier and (n, gh) one-hot operands in HBM (~12 GB of
-# traffic per 2M-row batch — measured 31.6 ms/batch); the kernel builds
-# both tiles in VMEM and leaves only the (nblk, gh, P*GL) partials in HBM.
-_PALLAS_T = 2048        # rows per tile
-_PALLAS_MAX_VMEM = 10 << 20
+# (n, P*GL) digit-carrier and (n, gh) one-hot operands in HBM; the kernel
+# builds both tiles in VMEM and leaves only the (gh, P*GL) s32 result.
+_PALLAS_MAX_VMEM = 14 << 20  # of the 16M scoped-vmem stack
+_I32_EXACT_ROWS = 1 << 23   # 127 * 2^23 < 2^31: s32 block-exactness bound
+
+
+def _pick_tile(n: int, gh: int, pgl: int):
+    """Largest T whose kernel fits the 16M scoped-vmem stack (estimate
+    calibrated on-chip: P=7/T=2048 measured 16.8M — the dominant terms
+    are the s32 select intermediates + s8 tiles for `a` and oh_h, ~5
+    bytes/elem each, plus the s32 accumulator + output)."""
+    for T in (2048, 1024, 512, 256):
+        if n % T:
+            continue
+        vmem = 2 * (gh * pgl * 4) + T * 5 * (pgl + gh)
+        if vmem <= _PALLAS_MAX_VMEM:
+            return T
+    return None
 
 
 def _use_pallas(n: int, gh: int, pgl: int) -> bool:
@@ -53,260 +96,339 @@ def _use_pallas(n: int, gh: int, pgl: int) -> bool:
         return False
     if jax.default_backend() != "tpu":
         return False
-    if n < _PALLAS_T or n % _PALLAS_T:
+    if n < 256 or n > _I32_EXACT_ROWS:
         return False
-    # acc + A-tile + onehot tiles must fit VMEM with headroom
-    vmem = (gh * pgl * 4) + _PALLAS_T * (pgl + gh + _GL) * 2
-    return vmem <= _PALLAS_MAX_VMEM
+    return _pick_tile(n, gh, pgl) is not None
 
 
-def _pallas_accumulate(keys: Array, planes_mat: Array, gh: int) -> Array:
-    """sum_r onehot_hi(r) (x) [onehot_lo(r) * planes(r, p)] per 64K-row
-    block. keys (n,) int32; planes_mat (n, P) bf16 with invalid rows
-    all-zero. Returns (nblk, gh, P*GL) f32 — f32-exact per block (block
-    digit sums < 2^24), recombined in f64 by the caller."""
+def _pallas_accumulate(keys: Array, ok: Array, words, recipe,
+                       gh: int) -> Array:
+    """sum_r onehot_hi(r) (x) [onehot_lo(r) * digit_p(r)] over the whole
+    batch, digits extracted IN VMEM from compact i32 word columns.
+
+    A materialized (n, P) s8 digit matrix gets lane-padded to (n, 128) in
+    HBM by XLA's layout rules (~19x the bytes; measured ~5ms/batch extra
+    at 2M rows), so the kernel instead takes the (n,) i32 words the
+    digits come from — the scaled 64-bit sum value as two halves, raw 0/1
+    count columns — plus a STATIC recipe of (kind, word_idx, shift) per
+    plane, and runs the shift/mask extraction on the VPU next to the MXU.
+
+    keys (n,) int32 (pre-clipped to [0, rng)); ok (n,) int32 0/1 — rows
+    with 0 contribute nothing; words: list of (n,) int32. Returns
+    (gh, P*GL) int32 — exact (digit block sums < 2^31 for n <= 2^23,
+    enforced by _use_pallas).
+
+    Data layout: ALL row-wise inputs ride ONE (2+W, n) i32 matrix whose
+    minor dim is n — fully lane-packed. Feeding (n, 1) columns instead
+    makes XLA materialize each through a 128-lane-padded layout when the
+    producer chain is nontrivial (~1 GB of HBM traffic per 2M-row word;
+    measured 47ms/batch vs <5ms). The kernel math is correspondingly
+    TRANSPOSED: one-hots build as (gh, T)/(GL, T) row-vector broadcasts
+    and the dot contracts the trailing T dim."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    n, P = planes_mat.shape
-    T = _PALLAS_T
-    blk = _blk(n)
-    tpb = blk // T                 # tiles per f32-exact block
-    nblk = n // blk
+    n = keys.shape[0]
+    P = len(recipe)
     pgl = P * _GL
+    T = _pick_tile(n, gh, pgl)
+    W2 = 2 + len(words)
 
-    keys2d = keys.astype(jnp.int32).reshape(n, 1)
+    m = jnp.stack([keys.astype(jnp.int32), ok.astype(jnp.int32)]
+                  + [w.astype(jnp.int32) for w in words], axis=0)
 
-    def kernel(keys_ref, planes_ref, out_ref, acc_ref):
-        j = pl.program_id(1)
+    def kernel(m_ref, out_ref, acc_ref):
+        i = pl.program_id(0)
 
-        @pl.when(j == 0)
+        @pl.when(i == 0)
         def _():
             acc_ref[:] = jnp.zeros_like(acc_ref)
 
-        # constants pinned to int32/f32: under jax_enable_x64 a bare
-        # Python int would promote to int64, which Mosaic cannot lower;
-        # the select is computed in f32 (same 32-bit tiling as the i32
-        # compare — a direct i1->bf16 select trips a Mosaic relayout bug)
-        # and converted to bf16 for the MXU.
-        one = jnp.float32(1)
-        zero = jnp.float32(0)
+        # constants pinned to int32: under jax_enable_x64 a bare Python
+        # int would promote to int64, which Mosaic cannot lower. Mosaic
+        # also rejects i8 vector multiply (arith.muli on i8), so the
+        # digit carrier is built by SELECT in i32 and cast to s8.
+        one = jnp.int32(1)
+        zero = jnp.int32(0)
         gl = jnp.int32(_GL)
-        k = keys_ref[:]                                        # (T, 1)
-        oh_l = jnp.where(
-            k % gl == jax.lax.broadcasted_iota(jnp.int32, (T, _GL), 1),
-            one, zero).astype(jnp.bfloat16)
+        k = m_ref[0:1, :]                                      # (1, T)
+        okc = m_ref[1:2, :] != zero                            # (1, T)
         oh_h = jnp.where(
-            k // gl == jax.lax.broadcasted_iota(jnp.int32, (T, gh), 1),
-            one, zero).astype(jnp.bfloat16)
-        # A[t, p*GL + l] = oh_l[t, l] * planes[t, p], built per plane so
-        # the concat stays a lane-tiled 2D layout
-        parts = [oh_l * planes_ref[:, p:p + 1] for p in range(P)]
-        a = parts[0] if P == 1 else jnp.concatenate(parts, axis=1)
+            (k // gl == jax.lax.broadcasted_iota(jnp.int32, (gh, T), 0))
+            & okc, one, zero).astype(jnp.int8)                 # (gh, T)
+        kl = k % gl
+        lo_hot = (kl == jax.lax.broadcasted_iota(jnp.int32, (_GL, T), 0)
+                  ) & okc                                      # (GL, T)
+        parts = []
+        for kind, wi, sh in recipe:
+            w = m_ref[2 + wi:3 + wi, :]                        # (1, T)
+            if kind == "digit":
+                # ((w >> sh) & 0xFF) - 128: bits sh..sh+7 regardless of
+                # arithmetic-vs-logical shift (the mask keeps only them)
+                d = ((w >> jnp.int32(sh)) & jnp.int32(0xFF)) - jnp.int32(128)
+            else:  # "raw": already a small int (count 0/1)
+                d = w
+            # cast each plane to s8 immediately: holding all P i32
+            # selects live until one concat+cast blows the 16M
+            # scoped-vmem stack (measured 16.8M at P=7/T=2048)
+            parts.append(jnp.where(lo_hot, d, zero).astype(jnp.int8))
+        a = parts[0] if P == 1 else jnp.concatenate(parts, axis=0)
+        # contract the row dim (trailing T on both sides)
         acc_ref[:] += jax.lax.dot_general(
-            oh_h, a, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            oh_h, a, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32)
 
-        @pl.when(j == tpb - 1)
+        @pl.when(i == n // T - 1)
         def _():
-            out_ref[0] = acc_ref[:]
+            out_ref[:] = acc_ref[:]
 
     # index maps stay int32 via numpy scalar constants (x64 mode would
-    # promote `i * tpb + j` with Python ints to an int64 Mosaic cannot
-    # return; jnp constants would be captured tracers, also rejected)
-    import numpy as np
-
-    def row_tile(i, j):
-        return (i * np.int32(tpb) + j, np.int32(0))
-
+    # promote Python-int arithmetic to an int64 Mosaic cannot return)
     return pl.pallas_call(
         kernel,
-        grid=(nblk, tpb),
-        in_specs=[
-            pl.BlockSpec((T, 1), row_tile, memory_space=pltpu.VMEM),
-            pl.BlockSpec((T, P), row_tile, memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((1, gh, pgl),
-                               lambda i, j: (i, np.int32(0), np.int32(0)),
+        grid=(n // T,),
+        in_specs=[pl.BlockSpec((W2, T), lambda i: (np.int32(0), i),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((gh, pgl),
+                               lambda i: (np.int32(0), np.int32(0)),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((nblk, gh, pgl), jnp.float32),
-        scratch_shapes=[pltpu.VMEM((gh, pgl), jnp.float32)],
-    )(keys2d, planes_mat)
+        out_shape=jax.ShapeDtypeStruct((gh, pgl), jnp.int32),
+        scratch_shapes=[pltpu.VMEM((gh, pgl), jnp.int32)],
+    )(m)
 
 
-def _blk(n: int) -> int:
-    # per-block accumulated digit sums must stay < 2^24 (f32-exact):
-    # BLK * 255 < 2^24  ->  BLK <= 2^16 (n is a power of two)
-    return min(n, 1 << 16)
+def _expand_words(words, recipe) -> Array:
+    """Materialize the (n, P) s8 digit matrix from word columns (the
+    portable path; the pallas kernel does this in VMEM instead)."""
+    planes = []
+    for kind, wi, sh in recipe:
+        w = words[wi]
+        if kind == "digit":
+            d = ((w >> np.int32(sh)) & jnp.int32(0xFF)) - jnp.int32(128)
+        else:
+            d = w
+        planes.append(d.astype(jnp.int8))
+    return jnp.stack(planes, axis=1)
 
 
-def _onehots(keys: Array, valid: Array, gh: int) -> Tuple[Array, Array]:
-    """(n, GL) digit-carrier side and (n, gh) one-hot side, bfloat16;
-    invalid rows are all-zero on the GL side."""
+def _xla_accumulate(keys: Array, valid: Array, D: Array, gh: int) -> Array:
+    """Portable s8 x s8 -> s32 formulation (CPU tests, odd shapes): the
+    (n, P*GL) carrier materializes in HBM, XLA's tuned matmul does the
+    rest. Returns (gh, P*GL) int32."""
+    n, P = D.shape
     kh = (keys // _GL).astype(jnp.int32)
     kl = (keys % _GL).astype(jnp.int32)
-    oh_l = ((kl[:, None] == jnp.arange(_GL, dtype=jnp.int32)[None, :]) &
-            valid[:, None]).astype(jnp.bfloat16)
-    oh_h = (kh[:, None] == jnp.arange(gh, dtype=jnp.int32)[None, :]
-            ).astype(jnp.bfloat16)
-    return oh_l, oh_h
-
-
-def _accumulate(a: Array, b: Array, n: int, gh: int) -> Array:
-    """sum_r a[r, l] * b[r, h], f32-exact per block, f64 across blocks."""
-    blk = _blk(n)
-    nb = n // blk
+    oh_l = kl[:, None] == jnp.arange(_GL, dtype=jnp.int32)[None, :]
+    A = jnp.where(oh_l[:, None, :], D[:, :, None].astype(jnp.int32), 0
+                  ).astype(jnp.int8).reshape(n, P * _GL)
+    oh_h = ((kh[:, None] == jnp.arange(gh, dtype=jnp.int32)[None, :])
+            & valid[:, None]).astype(jnp.int8)
+    blk = min(n, _I32_EXACT_ROWS)
+    nb = (n + blk - 1) // blk
+    if n % blk:
+        pad = nb * blk - n
+        A = jnp.concatenate([A, jnp.zeros((pad, P * _GL), jnp.int8)])
+        oh_h = jnp.concatenate([oh_h, jnp.zeros((pad, gh), jnp.int8)])
     part = jax.lax.dot_general(
-        b.reshape(nb, blk, gh), a.reshape(nb, blk, _GL),
+        oh_h.reshape(nb, blk, gh), A.reshape(nb, blk, P * _GL),
         (((1,), (1,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32)     # (nb, gh, GL)
-    return jnp.sum(part.astype(jnp.float64), axis=0)  # (gh, GL)
+        preferred_element_type=jnp.int32)       # (nb, gh, P*GL)
+    return jnp.sum(part, axis=0) if nb > 1 else part[0]
+
+
+def _accumulate_planes(keys: Array, valid: Array, words, recipe, gh: int,
+                       rng: int) -> Array:
+    """Shared dispatch: rows outside [0, rng) or invalid contribute
+    nothing (both backends mask them out of the one-hots). Returns
+    (gh, P, GL) f64."""
+    n = keys.shape[0]
+    P = len(recipe)
+    ok = valid & (keys >= 0) & (keys < rng)
+    kc = jnp.clip(keys, 0, rng - 1).astype(jnp.int32)
+    if _use_pallas(n, gh, P * _GL):
+        acc = _pallas_accumulate(kc, ok.astype(jnp.int32), words, recipe,
+                                 gh)
+    else:
+        D = _expand_words(words, recipe)
+        Dm = jnp.where(ok[:, None], D, jnp.int8(0))
+        acc = _xla_accumulate(kc, ok, Dm, gh)
+    return acc.astype(jnp.float64).reshape(gh, P, _GL)
+
+
+def _float_words(v: Array, ok: Array):
+    """Balanced base-256 digitization of round(v * 2^s), as i32 word
+    columns + recipe entries (6 planes).
+
+    s scales the batch max to 46 bits: |scaled| <= 2^46 stays inside the
+    asymmetric balanced-6-digit range (-128*(2^48-1)/255 ..
+    127*(2^48-1)/255). Returns (words, entries, s, bad) — bad is True
+    when any contributing value is non-finite (digits would be garbage;
+    caller must fall back)."""
+    finite = jnp.isfinite(v)
+    bad = jnp.any(ok & ~finite)
+    v = jnp.where(ok & finite, v, 0.0).astype(jnp.float64)
+    absv = jnp.abs(v)
+    maxv = jnp.max(absv)
+    exp = jnp.floor(jnp.log2(jnp.maximum(maxv, 1e-300))) + 1.0
+    # clamp so exp2(s) stays finite when the batch max is 0/denormal
+    s = jnp.minimum((CHUNK_BITS * F64_CHUNKS - 2) - exp, 1000.0)
+    scaled = jnp.round(v * jnp.exp2(s)).astype(jnp.int64)
+    u = scaled + _BIAS6
+    # i32 halves: int64 shifts lower to 2x-i32 emulation on TPU, and the
+    # pallas kernel wants lane-compact i32 columns anyway
+    lo = (u & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32).view(jnp.int32)
+    hi = (u >> 32).astype(jnp.int32)   # < 2^16, non-negative
+    words = [lo, hi]
+    entries = [("digit", 0, 0), ("digit", 0, 8), ("digit", 0, 16),
+               ("digit", 0, 24), ("digit", 1, 0), ("digit", 1, 8)]
+    return words, entries, s, bad
+
+
+def _int_words(v: Array):
+    """Balanced base-256 digitization of an int64, as i32 word columns +
+    recipe entries (8 planes).
+
+    Exact for |v| < 2^62 (the +bias add must not wrap uint64); grouped
+    sums recombine exactly in f64 while they stay within 2^53 — the same
+    contract as Spark's long sum overflow behavior being undefined."""
+    u = v.astype(jnp.int64).astype(jnp.uint64) + _BIAS8
+    lo = (u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32).view(jnp.int32)
+    hi = (u >> np.uint64(32)).astype(jnp.uint32).view(jnp.int32)
+    words = [lo, hi]
+    entries = [("digit", 0, 0), ("digit", 0, 8), ("digit", 0, 16),
+               ("digit", 0, 24), ("digit", 1, 0), ("digit", 1, 8),
+               ("digit", 1, 16), ("digit", 1, 24)]
+    return words, entries
+
+
+def _recombine(acc_gpl: Array, start: int, nch: int) -> Array:
+    """f64 digit recombination, descending power first (keeps partial
+    coefficients < 2^53 whenever the total is — see module docstring)."""
+    gh = acc_gpl.shape[0]
+    total = jnp.zeros((gh, _GL), jnp.float64)
+    for c in range(nch - 1, -1, -1):
+        total = total + acc_gpl[:, start + c, :] * float(
+            2 ** (CHUNK_BITS * c))
+    return total
 
 
 def grouped_sum(keys: Array, values: Array, valid: Array, rng: int) -> Array:
     """Per-key sums over keys in [0, rng). Returns values.dtype (rng,).
 
-    f64: exact to 48 bits of the batch max magnitude. int64: exact while
-    the true sums stay within 2^53 (the f64 recombination's exact range)."""
-    n = keys.shape[0]
-    gh = (rng + _GL - 1) // _GL
-    is_float = jnp.issubdtype(values.dtype, jnp.floating)
-
-    v = jnp.where(valid, values, 0)
-    oh_l, oh_h = _onehots(keys, valid, gh)
-    acc = jnp.zeros((gh, _GL), jnp.float64)
-
-    if is_float:
-        v = v.astype(jnp.float64)
-        absv = jnp.abs(v)
-        maxv = jnp.max(absv)
-        exp = jnp.floor(jnp.log2(jnp.maximum(maxv, 1e-300))) + 1.0
-        # clamp so exp2(s) stays finite when the batch max is 0/denormal
-        s = jnp.minimum((CHUNK_BITS * F64_CHUNKS) - exp, 1000.0)
-        scaled = jnp.round(absv * jnp.exp2(s))  # < 2^48: f64-exact digits
-        sign = jnp.where(v < 0, -1.0, 1.0).astype(jnp.bfloat16)
-        rem = scaled
-        for c in range(F64_CHUNKS - 1, -1, -1):
-            base = float(2 ** (CHUNK_BITS * c))
-            digit = jnp.floor(rem / base)
-            rem = rem - digit * base
-            a = oh_l * (digit.astype(jnp.bfloat16) * sign)[:, None]
-            acc = acc + _accumulate(a, oh_h, n, gh) * base
-        return acc.reshape(gh * _GL)[:rng] * jnp.exp2(-s)
-
-    # integral: bit-slice digits in int64 (f64 would lose beyond 2^53)
-    v = v.astype(jnp.int64)
-    absv = jnp.abs(v)
-    sign = jnp.where(v < 0, -1.0, 1.0).astype(jnp.bfloat16)
-    for c in range(I64_CHUNKS):
-        digit = ((absv >> (CHUNK_BITS * c)) & 0xFF).astype(jnp.bfloat16)
-        a = oh_l * (digit * sign)[:, None]
-        acc = acc + _accumulate(a, oh_h, n, gh) * float(
-            2 ** (CHUNK_BITS * c))
-    out = acc.reshape(gh * _GL)[:rng]
-    return jnp.round(out).astype(jnp.int64)
+    f64: exact to 46 bits of the batch max magnitude (non-finite inputs
+    are treated as 0 here — use grouped_multi's bad flag to detect them).
+    int64: exact while the true sums stay within 2^53."""
+    outs, _ = grouped_multi(keys, valid,
+                            [("sum", values, jnp.ones_like(valid))], rng)
+    return outs[0]
 
 
 def grouped_count(keys: Array, valid: Array, rng: int) -> Array:
     """Per-key counts of valid rows (exact). int64 (rng,)."""
-    n = keys.shape[0]
-    gh = (rng + _GL - 1) // _GL
-    oh_l, oh_h = _onehots(keys, valid, gh)
-    acc = _accumulate(oh_l, oh_h, n, gh)
-    return jnp.round(acc.reshape(gh * _GL)[:rng]).astype(jnp.int64)
+    outs, _ = grouped_multi(keys, jnp.ones_like(valid),
+                            [("count", valid)], rng)
+    return outs[0]
 
 
-def grouped_multi(keys: Array, valid: Array, specs, rng: int):
-    """Compute several grouped aggregates in ONE matmul.
+def digitize(valid: Array, specs):
+    """Digitize a batch's aggregate inputs into compact i32 word columns
+    plus a static per-plane extraction recipe.
 
     Each spec is ("sum", values, value_valid) or ("count", count_valid).
-    All digit planes of every spec stack along the matmul's N dimension, so
-    the hi-side one-hot streams through the MXU once per batch instead of
-    once per plane — the dominant memory traffic at large n.
-
-    Returns a list aligned with specs: f64/int64 (rng,) arrays.
+    Returns (words, recipe, layout, weights, bad):
+      * words — list of (n,) i32 columns (lane-compact; a materialized
+        (n, P) s8 matrix would pad to 128 lanes in HBM)
+      * recipe — per plane: ("digit", word_idx, shift) | ("raw", wi, 0)
+      * layout — per spec: ("sumf"|"sumi"|"count", start_plane)
+      * weights — per-plane carry weight: 2^-s for float-sum planes (the
+        batch scale folds into the linear recombination), 1.0 otherwise
+      * bad — True when any contributing float value was non-finite
+        (digits would be garbage; the caller must discard and fall back)
     """
-    n = keys.shape[0]
-    gh = (rng + _GL - 1) // _GL
-    oh_l, oh_h = _onehots(keys, valid, gh)
-
-    planes = []      # (n,) bf16 per plane
-    layout = []      # per spec: ("sumf", start, scale_s) | ("sumi", start)
-                     #         | ("count", start)
+    words = []
+    recipe = []
+    layout = []      # per spec: (kind, start)
+    weights = []     # per plane
+    bad = jnp.array(False)
+    one = jnp.asarray(1.0, jnp.float64)
     for spec in specs:
         if spec[0] == "count":
             _, cvalid = spec
-            planes.append(jnp.where(valid & cvalid, 1.0, 0.0
-                                    ).astype(jnp.bfloat16))
-            layout.append(("count", len(planes) - 1, None))
+            words.append(jnp.where(valid & cvalid, 1, 0).astype(jnp.int32))
+            recipe.append(("raw", len(words) - 1, 0))
+            weights.append(one)
+            layout.append(("count", len(recipe) - 1))
             continue
         _, values, vvalid = spec
         ok = valid & vvalid
-        v = jnp.where(ok, values, 0)
+        start = len(recipe)
         if jnp.issubdtype(values.dtype, jnp.floating):
-            v = v.astype(jnp.float64)
-            absv = jnp.abs(v)
-            maxv = jnp.max(absv)
-            exp = jnp.floor(jnp.log2(jnp.maximum(maxv, 1e-300))) + 1.0
-            # clamp so exp2(s) stays finite when the batch max is 0
-            s = jnp.minimum((CHUNK_BITS * F64_CHUNKS) - exp, 1000.0)
-            scaled = jnp.round(absv * jnp.exp2(s)).astype(jnp.int64)
-            sign = jnp.where(v < 0, -1.0, 1.0).astype(jnp.bfloat16)
-            start = len(planes)
-            for c in range(F64_CHUNKS):
-                digit = ((scaled >> (CHUNK_BITS * c)) & 0xFF
-                         ).astype(jnp.bfloat16)
-                planes.append(digit * sign)
-            layout.append(("sumf", start, s))
+            ws, entries, s, b = _float_words(values, ok)
+            bad = bad | b
+            weights.extend([jnp.exp2(-s)] * len(entries))
+            layout.append(("sumf", start))
         else:
-            v = v.astype(jnp.int64)
-            absv = jnp.abs(v)
-            sign = jnp.where(v < 0, -1.0, 1.0).astype(jnp.bfloat16)
-            start = len(planes)
-            for c in range(I64_CHUNKS):
-                digit = ((absv >> (CHUNK_BITS * c)) & 0xFF
-                         ).astype(jnp.bfloat16)
-                planes.append(digit * sign)
-            layout.append(("sumi", start, None))
+            # masked rows digitize as v=0, whose balanced digits are all
+            # zero (the bias byte is exactly 0x80), so no re-mask needed
+            v = jnp.where(ok, values, 0).astype(jnp.int64)
+            ws, entries = _int_words(v)
+            weights.extend([one] * len(entries))
+            layout.append(("sumi", start))
+        base = len(words)
+        words.extend(ws)
+        recipe.extend([(kind, base + wi, sh) for kind, wi, sh in entries])
+    return words, tuple(recipe), layout, jnp.stack(weights), bad
 
-    P = len(planes)
-    D = jnp.stack(planes, axis=1)                       # (n, P)
-    if _use_pallas(n, gh, P * _GL):
-        # fused VMEM kernel; valid is already folded into every plane
-        # (count planes are where(valid&cvalid, 1, 0); sum planes zero
-        # their invalid rows). Out-of-range keys are masked here so both
-        # backends share the contract "rows outside [0, rng) contribute
-        # nothing" (the XLA one-hot drops them by construction; clipping
-        # alone would fold them into the last slot)
-        ok = valid & (keys >= 0) & (keys < rng)
-        kc = jnp.clip(keys, 0, rng - 1).astype(jnp.int32)
-        D = jnp.where(ok[:, None], D, jnp.bfloat16(0))
-        part = _pallas_accumulate(kc, D, gh)            # (nblk, gh, P*GL)
-    else:
-        A = (oh_l[:, None, :] * D[:, :, None]).reshape(n, P * _GL)
-        blk = _blk(n)
-        nb = n // blk
-        part = jax.lax.dot_general(
-            oh_h.reshape(nb, blk, gh), A.reshape(nb, blk, P * _GL),
-            (((1,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)         # (nb, gh, P*GL)
-    acc = jnp.sum(part.astype(jnp.float64), axis=0
-                  ).reshape(gh, P, _GL)                 # (gh, P, GL)
 
+def accumulate(keys: Array, valid: Array, words, recipe,
+               rng: int) -> Array:
+    """One batch's digit-plane accumulation: (gh, P, GL) f64."""
+    gh = (rng + _GL - 1) // _GL
+    return _accumulate_planes(keys, valid, words, recipe, gh, rng)
+
+
+def finalize(acc: Array, layout, rng: int):
+    """Recombine a (weighted-summed) plane carrier into per-spec outputs:
+    f64 for float sums, int64 for int sums and counts.
+
+    Int sums recombine in INT64 arithmetic: the f64 carrier holds exact
+    per-plane digit sums (< 2^38 even across 64 maximal batches), but an
+    f64 recombination would round — the TPU backend's emulated f64 has a
+    ~49-bit effective mantissa, so plain double math goes off by ulps
+    beyond 2^49. Int64 shifts/adds are 2x-i32 emulated but EXACT: int
+    sums come out exact modulo 2^64 (Spark long-sum overflow wraps)."""
+    gh = acc.shape[0]
     outs = []
-    for kind, start, s in layout:
+    for kind, start in layout:
         if kind == "count":
             plane = acc[:, start, :].reshape(gh * _GL)[:rng]
             outs.append(jnp.round(plane).astype(jnp.int64))
             continue
-        nch = F64_CHUNKS if kind == "sumf" else I64_CHUNKS
-        total = jnp.zeros((gh, _GL), jnp.float64)
-        for c in range(nch):
-            total = total + acc[:, start + c, :] * float(
-                2 ** (CHUNK_BITS * c))
-        flat = total.reshape(gh * _GL)[:rng]
         if kind == "sumf":
-            outs.append(flat * jnp.exp2(-s))
-        else:
-            outs.append(jnp.round(flat).astype(jnp.int64))
+            nch = F64_CHUNKS
+            flat = _recombine(acc, start, nch).reshape(gh * _GL)[:rng]
+            outs.append(flat)
+            continue
+        total = jnp.zeros((gh, _GL), jnp.int64)
+        for c in range(I64_CHUNKS - 1, -1, -1):
+            plane = jnp.round(acc[:, start + c, :]).astype(jnp.int64)
+            total = total + (plane << np.int64(CHUNK_BITS * c))
+        outs.append(total.reshape(gh * _GL)[:rng])
     return outs
+
+
+def grouped_multi(keys: Array, valid: Array, specs, rng: int):
+    """Compute several grouped aggregates in ONE s8 matmul.
+
+    All digit planes of every spec stack along the matmul's N dimension,
+    so the hi-side one-hot streams through the MXU once per batch instead
+    of once per plane.
+
+    Returns (outs, bad): outs aligned with specs (f64/int64 (rng,)
+    arrays); bad True when any contributing float value was non-finite —
+    those rows contributed 0, so the caller MUST discard and fall back.
+    """
+    words, recipe, layout, weights, bad = digitize(valid, specs)
+    acc = accumulate(keys, valid, words, recipe, rng)
+    acc = acc * weights[None, :, None]
+    return finalize(acc, layout, rng), bad

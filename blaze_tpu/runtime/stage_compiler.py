@@ -135,10 +135,20 @@ def _match(root: Operator):
     return final, partial, chain, n
 
 
-def try_run_stage(root: Operator, ctx: ExecContext
+def try_run_stage(root: Operator, ctx: ExecContext, deferred: bool = False
                   ) -> Optional[ColumnBatch]:
     """Run the stage in one dispatch, or None if the pattern/shape/range
-    doesn't apply (caller then uses the streaming executor)."""
+    doesn't apply (caller then uses the streaming executor).
+
+    deferred=True (executor.collect_fetch): skip the in-function host pull
+    of the oob/num_rows flags and return (batch, flags, retry,
+    commit_metrics) instead — the flags ride the CALLER's single
+    device→host fetch (optimistic execution; on a remote-attached chip
+    every dependent pull is a ~90ms round trip). `retry()` recomputes the
+    stage through the full probe/fallback loop with the already-captured
+    batches; callers MUST discard the batch and use retry()'s result when
+    flags[0] != 0, and MUST call commit_metrics() only when the flags
+    came back clean (a discarded stage never ran to completion)."""
     if not conf.enable_stage_compiler:
         return None
     m = _match(root)
@@ -146,7 +156,10 @@ def try_run_stage(root: Operator, ctx: ExecContext
         mc = _match_chain(root)
         if mc is None:
             return None
-        return _run_chain_stage(root, mc[0], mc[1], ctx)
+        out = _run_chain_stage(root, mc[0], mc[1], ctx)
+        if out is not None and deferred:
+            return out, None, None, None
+        return out
     final, partial, chain, source = m
 
     gdtypes = [f.dtype for f in partial._group_fields]
@@ -258,14 +271,26 @@ def try_run_stage(root: Operator, ctx: ExecContext
             return apply_chain(bb)[0]
 
         sum_is_float = []
+        has_validity = []
         for i, call in enumerate(calls):
-            if call.fn == "count":
-                sum_is_float.append(False)
-                continue
             shp = jax.eval_shape(
                 lambda bb, i=i: input_fns[i](apply_chain_probe(bb)),
                 batches[0])
-            sum_is_float.append(jnp.issubdtype(shp.data.dtype, jnp.floating))
+            has_validity.append(shp.validity is not None)
+            sum_is_float.append(
+                call.fn != "count"
+                and jnp.issubdtype(shp.data.dtype, jnp.floating))
+
+        # plane count of the scan's digit-space carrier (must be static
+        # before the scan): presence + per-call validity-count planes +
+        # per-call sum digit planes
+        n_planes = 1
+        for i, call in enumerate(calls):
+            if has_validity[i]:
+                n_planes += 1
+            if call.fn != "count":
+                n_planes += (mxu_agg.F64_CHUNKS if sum_is_float[i]
+                             else mxu_agg.I64_CHUNKS)
 
         # kmins are STATIC ints from the memoized probe: no in-program min
         # pass. int32 twins for the packed-index arithmetic (wrapping is
@@ -277,15 +302,19 @@ def try_run_stage(root: Operator, ctx: ExecContext
                 lambda *xs: jnp.stack(xs), *batches)
             # single pass: dense MXU accumulation (oob set when the
             # memoized kmins/spans no longer cover the data, or keys go
-            # null — either triggers re-probe + recompile in the caller)
-            nagg = len(calls)
+            # null — either triggers re-probe + recompile in the caller).
+            # The carry stays in digit-plane space — recombination and
+            # per-aggregate updates run once per STAGE, not per batch
+            # (mxu_agg module docstring, streaming use).
+            gh = (R + mxu_agg._GL - 1) // mxu_agg._GL
             init = {
-                "presence": jnp.zeros((R,), jnp.int64),
-                "sums": [jnp.zeros((R,), jnp.float64 if sum_is_float[i]
-                                   else jnp.int64) for i in range(nagg)],
-                "counts": [jnp.zeros((R,), jnp.int64) for _ in range(nagg)],
+                "acc": jnp.zeros((gh, n_planes, mxu_agg._GL), jnp.float64),
                 "oob": jnp.array(False),
             }
+            # digitize()'s spec layout and the per-call slot map are
+            # trace-time constants; capture them from the (single) trace
+            # of step for use after the scan
+            trace_info = {}
 
             def step(carry, b):
                 b, live = apply_chain(b)
@@ -330,7 +359,7 @@ def try_run_stage(root: Operator, ctx: ExecContext
                     si = None
                     if call.fn != "count":
                         data = vcol.data
-                        if carry["sums"][i].dtype == jnp.float64:
+                        if sum_is_float[i]:
                             data = data.astype(jnp.float64)
                         else:
                             data = data.astype(jnp.int64)
@@ -339,21 +368,27 @@ def try_run_stage(root: Operator, ctx: ExecContext
                         specs.append(("sum", data, vv))
                         si = len(specs) - 1
                     slots.append((si, ci))
-                outs = mxu_agg.grouped_multi(k, inb, specs, R)
-                pres_step = outs[0]
-                carry["presence"] = carry["presence"] + pres_step
-                for i, (si, ci) in enumerate(slots):
-                    cnt_step = pres_step if ci is None else outs[ci]
-                    carry["counts"][i] = carry["counts"][i] + cnt_step
-                    if si is not None:
-                        carry["sums"][i] = carry["sums"][i] + outs[si]
+                words, recipe, layout, weights, bad_vals = \
+                    mxu_agg.digitize(inb, specs)
+                # non-finite float inputs can't ride digit planes — treat
+                # like out-of-range keys: flag and let the caller fall
+                # back to the streaming path
+                carry["oob"] = carry["oob"] | bad_vals
+                acc_b = mxu_agg.accumulate(k, inb, words, recipe, R)
+                carry["acc"] = carry["acc"] + acc_b * weights[None, :, None]
+                trace_info["layout"] = layout
+                trace_info["slots"] = slots
                 return carry, None
 
             carry, _ = jax.lax.scan(step, init, stacked)
 
-            # assemble output rows (dense slots -> compacted groups)
+            # recombine ONCE per stage, then assemble output rows
+            # (dense slots -> compacted groups)
+            outs = mxu_agg.finalize(carry["acc"], trace_info["layout"], R)
+            pres = outs[0]
+            slots = trace_info["slots"]
             cap = bucket_capacity(R)
-            present = carry["presence"] > 0
+            present = pres > 0
             schema = (final or partial)._schema
             slot = jnp.arange(R, dtype=jnp.int64)
             cols = []
@@ -363,12 +398,13 @@ def try_run_stage(root: Operator, ctx: ExecContext
                                    _pad(ki.astype(gdtype.jnp_dtype()), cap),
                                    None))
             for i, call in enumerate(calls):
-                cnt = carry["counts"][i]
+                si, ci = slots[i]
+                cnt = pres if ci is None else outs[ci]
                 if call.fn == "count":
                     cols.append(Column(T.INT64, _pad(cnt, cap), None))
                 elif call.fn == "avg":
                     ok = cnt > 0
-                    v = carry["sums"][i].astype(jnp.float64) / \
+                    v = outs[si].astype(jnp.float64) / \
                         jnp.maximum(cnt, 1).astype(jnp.float64)
                     cols.append(Column(T.FLOAT64,
                                        _pad(jnp.where(ok, v, 0.0), cap),
@@ -377,7 +413,7 @@ def try_run_stage(root: Operator, ctx: ExecContext
                     ok = cnt > 0
                     cols.append(Column(
                         result_field(call).dtype,
-                        _pad(carry["sums"][i], cap), _pad(ok, cap)))
+                        _pad(outs[si], cap), _pad(ok, cap)))
             out = ColumnBatch(schema, cols, jnp.asarray(R, jnp.int32), cap)
             out = out.compact(_pad(present, cap))
             assert out_mode_final  # partial-only rejected in _match
@@ -411,6 +447,29 @@ def try_run_stage(root: Operator, ctx: ExecContext
         key = ("stage", root.plan_key(), shape0, len(batches), spans, kmins)
         fn = jit_cache.get_or_compile(key, make)
         out, flags = fn(*batches)
+        if deferred:
+            def retry() -> ColumnBatch:
+                # flags tripped at the caller: rebuild on the captured
+                # batches and run the full (non-deferred) loop, which
+                # re-probes the range memo and falls back as needed
+                from blaze_tpu.ops.basic import MemorySourceExec
+
+                _R_MEMO.pop(memo_key, None)
+                src = MemorySourceExec(list(batches), source.schema)
+                root2 = _rebuild(root, src)
+                res = try_run_stage(root2, ctx)
+                return res if res is not None else _collect_streaming(
+                    root2, ctx)
+
+            def commit_metrics() -> None:
+                # only once the caller saw clean flags — a discarded
+                # stage must not report stage_compiled (and its retry
+                # shares these MetricNode objects via _rebuild's copy)
+                for op in (final, partial, *chain):
+                    op.metrics.add("output_batches", 1)
+                root.metrics.add("stage_compiled", 1)
+
+            return out, flags, retry, commit_metrics
         flags_np = np.asarray(flags)
         nrows = int(flags_np[1])
         if not bool(flags_np[0]):

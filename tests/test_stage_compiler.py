@@ -263,3 +263,34 @@ def test_chain_stage_string_columns(rng):
                 want_rows.append((int(k), sv))
     got_rows = list(zip((int(x) for x in np.asarray(d["k"])), d["s"]))
     assert sorted(got_rows) == sorted(want_rows)
+
+
+def test_nonfinite_values_fall_back(rng):
+    """NaN/Inf sum inputs can't ride the int8 digit planes (their digits
+    would corrupt every dense slot): grouped_multi raises the bad flag,
+    the stage program reports oob, and the streaming path produces the
+    per-group NaN/Inf Spark semantics."""
+    batches = _batches(rng, 2, 400, kmin=0, kmax=8)
+    d0 = batches[0].to_numpy()
+    v = np.asarray(d0["v"], np.float64).copy()
+    kk = np.asarray(d0["k"], np.int64).copy()
+    v[3], kk[3] = np.nan, 2       # NaN lands in group 2
+    v[7], kk[7] = np.inf, 5       # Inf lands in group 5
+    n0 = np.asarray(d0["n"], np.int32)
+    batches[0] = ColumnBatch.from_numpy(
+        {"k": kk, "v": v, "n": n0}, SCHEMA, capacity=batches[0].capacity)
+    plan = _plan(batches, with_filter=False)
+    out = collect(plan)
+    assert plan.metrics["stage_compiled"] == 0  # fell back
+    d = out.to_numpy()
+    ks = list(np.asarray(d["k"]))
+    sv = {k: float(d["sv"][i]) for i, k in enumerate(ks)}
+    assert np.isnan(sv[2])
+    assert np.isinf(sv[5])
+    # untouched groups still match pandas exactly
+    df = _oracle(batches, with_filter=False)
+    want = df.groupby("k")["v"].sum()
+    for k in ks:
+        if k in (2, 5):
+            continue
+        np.testing.assert_allclose(sv[k], want.loc[k], rtol=1e-9)
