@@ -154,6 +154,47 @@ def divmod_small(h: Array, l: Array, d) -> Tuple[Array, Array, Array]:
     return qh, ql, rem
 
 
+def divmod_full(h: Array, l: Array, dh: Array, dl: Array
+                ) -> Tuple[Array, Array, Array, Array]:
+    """Full 128/128 magnitude divmod: (qh, ql, rh, rl) of |a| divmod |d|.
+
+    Bit-serial restoring long division (128 fori_loop steps of
+    shift/compare/subtract over the two int64 limb planes) — branch-free
+    per row, static trip count, so it jits to one compact TPU loop.
+    Caller handles signs and rounding. d == 0 produces q = all-ones
+    (the caller must null those rows — Spark's divide-by-zero is null).
+    Exact for |a|, |d| < 2^127 (decimals are < 10^38 < 2^127)."""
+    from jax import lax
+
+    ah, al = abs_(h, l)
+    bh, bl = abs_(dh, dl)
+
+    def uge(xh, xl, yh, yl):
+        return ~(_u_lt(xh, yh) | ((xh == yh) & _u_lt(xl, yl)))
+
+    def step(i, st):
+        qh, ql, rh, rl = st
+        idx = jnp.int64(127) - i
+        hi_bit = (ah >> jnp.clip(idx - 64, 0, 63)) & jnp.int64(1)
+        lo_bit = (al >> jnp.clip(idx, 0, 63)) & jnp.int64(1)
+        bit = jnp.where(idx >= 64, hi_bit, lo_bit)
+        rh = (rh << 1) | ((rl >> 63) & jnp.int64(1))
+        rl = (rl << 1) | bit
+        g = uge(rh, rl, bh, bl)
+        sh, sl = sub(rh, rl, bh, bl)
+        rh = jnp.where(g, sh, rh)
+        rl = jnp.where(g, sl, rl)
+        qh = jnp.where(g & (idx >= 64),
+                       qh | (jnp.int64(1) << jnp.clip(idx - 64, 0, 63)), qh)
+        ql = jnp.where(g & (idx < 64),
+                       ql | (jnp.int64(1) << jnp.clip(idx, 0, 63)), ql)
+        return (qh, ql, rh, rl)
+
+    z = jnp.zeros_like(ah)
+    qh, ql, rh, rl = lax.fori_loop(0, 128, step, (z, z, z, z))
+    return qh, ql, rh, rl
+
+
 def rescale_checked(h: Array, l: Array, delta: int, half_up: bool = True
                     ) -> Tuple[Array, Array, Array]:
     """rescale plus a per-row ok flag: upscaling by 10^delta WRAPS mod

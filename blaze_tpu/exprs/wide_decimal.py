@@ -9,11 +9,12 @@ whose operands or result are wide, storing values as StructData
 
 Supported here — and enforced at plan time by the convert strategy's
 wide-decimal walk (spark/converters.py) so anything else falls back:
-add/sub, mul while p1+p2 <= 38 (the product fits 128 bits), all
-comparisons, negate, casts int/narrow/wide -> wide, wide -> narrow /
-float64, and CheckOverflow (null outside 10^p, Spark non-ANSI).
-Division with a wide operand/result needs 128-bit long division and is
-plan-time rejected instead of silently approximated.
+add/sub, mul while p1+p2 <= 38 (the product fits 128 bits), division
+via bit-serial 128-bit long division (int128.divmod_full) with HALF_UP
+at the planned result scale while the scale-alignment upscale provably
+fits 128 bits, all comparisons, negate, casts int/narrow/wide -> wide,
+wide -> narrow / float64, and CheckOverflow (null outside 10^p, Spark
+non-ANSI). Mod remains plan-time rejected.
 """
 
 from __future__ import annotations
@@ -76,7 +77,51 @@ def arith(lc: Column, rc: Column, op: ir.BinOp,
         h, l = _mul(lc, rc)
         h, l, ok = i128.rescale_checked(h, l, out_s - (ls + rs))
         return _shape(result_type, h, l, _and_ok(validity, ok))
+    if op == ir.BinOp.DIV:
+        return _div(lc, rc, result_type, validity)
     raise NotImplementedError(f"wide decimal op {op}")
+
+
+def _div(lc: Column, rc: Column, result_type: DataType,
+         validity: Optional[Array]) -> Column:
+    """Spark decimal division: HALF_UP at the planner's result scale.
+
+    value = round(a * 10^delta / b) with delta = out_s - a.s + b.s; a
+    negative delta instead scales the DIVISOR up (both checked for
+    128-bit wrap). Divide-by-zero and out-of-precision quotients go null
+    (Spark non-ANSI). Ref: datafusion-ext-commons cast.rs decimal paths /
+    Spark Decimal.divide (java BigDecimal HALF_UP)."""
+    out_s = result_type.scale
+    delta = out_s - lc.dtype.scale + rc.dtype.scale
+    ah, al = planes(lc)
+    bh, bl = planes(rc)
+    ok = jnp.ones(ah.shape, jnp.bool_)
+    if delta >= 0:
+        ah, al, ok1 = i128.rescale_checked(ah, al, delta, half_up=False)
+        ok = ok & ok1
+    else:
+        bh, bl, ok1 = i128.rescale_checked(bh, bl, -delta, half_up=False)
+        ok = ok & ok1
+    nonzero = (bh != 0) | (bl != 0)
+    sign = i128.is_neg(ah, al) ^ i128.is_neg(bh, bl)
+    qh, ql, rh, rl = i128.divmod_full(ah, al, bh, bl)
+    # HALF_UP: bump |q| when 2*rem >= |b| (128-bit unsigned compare;
+    # rem < |b| < 2^127 so the doubled value's carry bit decides alone
+    # when set)
+    dbh, dbl = bh, bl
+    abh, abl = i128.abs_(dbh, dbl)
+    carry = (rh >> 63) & jnp.int64(1)
+    r2h = (rh << 1) | ((rl >> 63) & jnp.int64(1))
+    r2l = rl << 1
+    ge = (carry == 1) | ~(i128._u_lt(r2h, abh)
+                          | ((r2h == abh) & i128._u_lt(r2l, abl)))
+    qh, ql = i128.add(qh, ql,
+                      jnp.zeros_like(qh), ge.astype(jnp.int64))
+    nh, nl = i128.neg(qh, ql)
+    h = jnp.where(sign, nh, qh)
+    l = jnp.where(sign, nl, ql)
+    ok = ok & nonzero & i128.in_precision(h, l, result_type.precision)
+    return _shape(result_type, h, l, _and_ok(validity, ok))
 
 
 def _and_ok(validity: Optional[Array], ok: Array) -> Array:

@@ -42,6 +42,7 @@ from blaze_tpu.columnar.batch import (
     Column, ColumnBatch, StringData, bucket_capacity,
 )
 from blaze_tpu.columnar.types import Field, Schema
+from blaze_tpu.config import conf
 from blaze_tpu.exprs import ir
 from blaze_tpu.exprs.compiler import compile_expr
 from blaze_tpu.ops import segment as seg
@@ -366,6 +367,32 @@ class HashJoinLikeExec(Operator):
         else:
             build = ColumnBatch.empty(build_op.schema)
 
+        # Runtime build-size fallback (ref broadcast_join_exec.rs:188-249:
+        # an oversized collected build side switches the operator from its
+        # hash-table strategy to sort-merge at runtime). This engine's
+        # kernel is already sort-based, so the TPU analog of "fall back to
+        # SMJ" is BOUNDED-MEMORY build processing: the build side is
+        # joined in sorted CHUNKS (each sort sized under the threshold)
+        # instead of as one resident sorted batch. Inner and probe-side
+        # semi/anti/existence joins — the shapes planners broadcast —
+        # merge exactly across chunks; other types keep the resident path.
+        if (isinstance(self, BroadcastJoinExec)
+                and conf.enable_bhj_fallbacks_to_smj
+                and self.join_filter is None
+                and not build_side_semi
+                and jt in (JoinType.INNER, JoinType.LEFT_SEMI,
+                           JoinType.LEFT_ANTI, JoinType.EXISTENCE)):
+            from blaze_tpu.runtime.memory import batch_nbytes
+
+            build_rows = int(build.num_rows)
+            build_bytes = batch_nbytes(build)
+            if (build_rows > conf.bhj_fallback_rows_threshold
+                    or build_bytes > conf.bhj_fallback_mem_threshold):
+                self.metrics.add("bhj_fallback_to_smj", 1)
+                yield from self._gen_chunked_build(
+                    ctx, probe_op, build, probe_cols, build_cols, jt)
+                return
+
         null_safe = [k.null_safe for k in self.keys]
         # Build-side sort uses its natural flag layout; per-probe-batch
         # match sorts may add null-flag keys when a probe batch carries
@@ -408,6 +435,79 @@ class HashJoinLikeExec(Operator):
                                         probe_is_left, probe_op.schema)
             if out is not None and int(out.num_rows) > 0:
                 yield out
+
+    def _gen_chunked_build(self, ctx: ExecContext, probe_op: Operator,
+                           build: ColumnBatch, probe_cols: List[int],
+                           build_cols: List[int], jt: JoinType):
+        """Bounded-memory join against an oversized build side: the build
+        rows are processed in sorted chunks (each chunk's sort stays under
+        the fallback threshold). Inner outputs union across chunks; semi/
+        anti/existence accumulate per-probe-row match counts and emit
+        after the last chunk. (See the fallback comment in _gen; ref
+        broadcast_join_exec.rs:188-249.)"""
+        from blaze_tpu.runtime.memory import batch_nbytes
+
+        null_safe = [k.null_safe for k in self.keys]
+        nrows = int(build.num_rows)
+        # chunk rows bound by BOTH thresholds: a byte-triggered fallback
+        # (huge rows, few of them) must not end up with one whole-build
+        # chunk — that would be the resident path wearing a fallback
+        # metric
+        bytes_per_row = max(batch_nbytes(build) // max(
+            int(build.capacity), 1), 1)
+        cs_mem = conf.bhj_fallback_mem_threshold // bytes_per_row
+        cs = bucket_capacity(int(max(min(
+            conf.bhj_fallback_rows_threshold, cs_mem, 1 << 20), 1024)))
+        nchunks = (nrows + cs - 1) // cs
+        chunks = []
+        iota = jnp.arange(build.capacity, dtype=jnp.int32)
+        for i in range(nchunks):
+            lo = i * cs
+            n = min(cs, nrows - lo)
+            piece = build.take(iota[lo:lo + cs], n)
+            flags = [piece.columns[bc].validity is not None
+                     for bc in build_cols]
+            chunks.append(self._sort_build(piece, build_cols, null_safe,
+                                           flags))
+        semi_like = jt in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI,
+                           JoinType.EXISTENCE)
+        for probe in probe_op.execute(ctx):
+            ctx.check_running()
+            if int(probe.num_rows) == 0:
+                continue
+            cnt_total = jnp.zeros((probe.capacity,), jnp.int64)
+            for piece in chunks:
+                force_flags = [
+                    piece.columns[bc].validity is not None
+                    or probe.columns[pc].validity is not None
+                    for bc, pc in zip(build_cols, probe_cols)]
+                if semi_like:
+                    key = ("join_match", self.plan_key(),
+                           tuple(force_flags), probe.shape_key(),
+                           piece.shape_key())
+
+                    def make():
+                        def run(p, b):
+                            return match_ranges(b, p, build_cols,
+                                                probe_cols, null_safe,
+                                                force_flags)
+                        return run
+
+                    _, cnt, _ = jit_cache.get_or_compile(key, make)(
+                        probe, piece)
+                    cnt_total = cnt_total + cnt.astype(jnp.int64)
+                    continue
+                # INNER: per-chunk pair outputs union exactly
+                with self.metrics.timer("join_time_ns"):
+                    out, _ = self._join_batch(
+                        probe, piece, probe_cols, build_cols, null_safe,
+                        force_flags, not self.build_is_left, False)
+                if out is not None and int(out.num_rows) > 0:
+                    yield out
+            if semi_like:
+                out = self._semi_like(probe, cnt_total, jt)
+                if out is not None and int(out.num_rows) > 0:
+                    yield out
 
     def _sort_build(self, build: ColumnBatch, build_cols: List[int],
                     null_safe: List[bool], force_flags: List[bool]

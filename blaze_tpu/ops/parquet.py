@@ -116,7 +116,12 @@ class ParquetScanExec(Operator):
         if self.fs_resource_id:
             fs = resources.get(self.fs_resource_id)
             return fs(path) if callable(fs) else fs.open(path)
-        return path  # pyarrow opens local paths directly
+        # default resolver: scheme:// URIs route through fsspec (the
+        # Hadoop-FS-per-URI analog, hadoop_fs.rs:23-132); local paths
+        # pass through for pyarrow to open directly
+        from blaze_tpu.runtime import filesystem
+
+        return filesystem.open_input(path)
 
     def execute(self, ctx: ExecContext) -> BatchStream:
         def gen():
@@ -237,7 +242,10 @@ class ParquetSinkExec(Operator):
         import glob as _glob
         import os as _os
 
-        remote = bool(self.fs_resource_id)
+        from blaze_tpu.runtime import filesystem
+
+        remote = bool(self.fs_resource_id) or (
+            filesystem.path_scheme(self.path) is not None)
         if ctx.num_partitions <= 1 and not (
                 not remote and _os.path.isdir(self.path)):
             return self.path
@@ -260,6 +268,10 @@ class ParquetSinkExec(Operator):
                 fs = resources.get(self.fs_resource_id)
                 sink = fs(out_path) if callable(fs) else fs.open(out_path,
                                                                  "wb")
+            else:
+                from blaze_tpu.runtime import filesystem
+
+                sink = filesystem.open_output(out_path)
             compression = self.props.get("compression", "zstd")
             writer = pq.ParquetWriter(sink, arrow_schema,
                                       compression=compression)
@@ -275,13 +287,12 @@ class ParquetSinkExec(Operator):
                     rows += int(batch.num_rows)
             finally:
                 writer.close()
-                if self.fs_resource_id and hasattr(sink, "close"):
+                if not isinstance(sink, str) and hasattr(sink, "close"):
                     sink.close()
-            import os
+            from blaze_tpu.runtime import filesystem
 
-            nbytes = (os.path.getsize(out_path)
-                      if not self.fs_resource_id and os.path.exists(out_path)
-                      else 0)
+            nbytes = (0 if self.fs_resource_id
+                      else filesystem.size(out_path))
             self.metrics.add("output_rows_written", rows)
             yield ColumnBatch.from_numpy(
                 {"path": [out_path], "num_rows": np.array([rows], np.int64),

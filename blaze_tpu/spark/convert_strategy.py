@@ -28,6 +28,13 @@ _ALWAYS_KINDS = {"FileSourceScanExec"}  # cheap + unlock children (ref :81+)
 
 
 def apply_strategy(plan: SparkPlan) -> SparkPlan:
+    # expression-subtree fallback first (NativeConverters.scala:290-372):
+    # an interpreter-covered-but-not-device-covered ScalarFn becomes a
+    # UdfWrapper with natively computed params, so tagging below sees a
+    # convertible node instead of demoting the whole operator
+    from blaze_tpu.spark.expr_subtree_fallback import rewrite_plan
+
+    rewrite_plan(plan)
     _tag_convertible(plan)
     _assign(plan)
     changed = True

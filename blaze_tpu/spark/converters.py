@@ -379,7 +379,22 @@ def _wide_subtree_ok(e: ir.Expr, schema) -> bool:
                     and lt is not None and rt is not None
                     and lt.is_decimal and rt.is_decimal
                     and lt.precision + rt.precision <= 38)
-        return False  # division/mod need 128-bit long division
+        if e.op == ir.BinOp.DIV:
+            # 128-bit bit-serial long division (int128.divmod_full) with
+            # HALF_UP at the planner's result scale; the scale-alignment
+            # upscale (numerator when delta >= 0, divisor otherwise) must
+            # provably stay within 128 bits — a wrapped upscale would
+            # null rows whose true quotient is representable
+            if not (kids_ok and e.result_type is not None
+                    and e.result_type.is_decimal
+                    and lt is not None and rt is not None
+                    and lt.is_decimal and rt.is_decimal):
+                return False
+            delta = e.result_type.scale - lt.scale + rt.scale
+            if delta >= 0:
+                return lt.precision + delta <= 38
+            return rt.precision - delta <= 38
+        return False  # mod still needs a kernel
     return False
 
 

@@ -1045,11 +1045,21 @@ def _eval(e: ir.Expr, df: pd.DataFrame):
                 f"no Python fallback for scalar fn {e.name}")
         return fn(*[np.asarray(_eval(a, df)) for a in e.args])
     if isinstance(e, ir.UdfWrapper):
-        # a NeverConvert parent can drag a decoded UDF onto this path;
-        # evaluate through the hive_udf registry (spark/hive_udf.py)
+        # a NeverConvert parent can drag a wrapped expression onto this
+        # path. Two wrapper origins, two registries:
+        #   udf:<name>          — hive_udf registrations
+        #   fallbackfn:<name>:<ret-kind> — expr_subtree_fallback rewrites
+        #     of PYTHON_FNS-covered scalar fns (the rewrite runs BEFORE
+        #     tagging, so a later NeverConvert decision must still be
+        #     able to evaluate the wrapped node here)
+        parts = e.resource_id.split(":")
+        if parts[0] == "fallbackfn" and len(parts) >= 2:
+            fn = PYTHON_FNS.get(parts[1])
+            if fn is not None:
+                return fn(*[np.asarray(_eval(p, df)) for p in e.params])
         from blaze_tpu.spark import hive_udf
 
-        name = e.resource_id.split(":", 1)[-1]
+        name = parts[1] if len(parts) > 1 else parts[0]
         hit = hive_udf.lookup(name)
         if hit is None:
             raise NotImplementedError(f"no evaluator for UDF {name}")

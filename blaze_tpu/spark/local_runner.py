@@ -37,7 +37,8 @@ from blaze_tpu.spark.stages import Stage, plan_stages
 def run_plan(root: SparkPlan, num_partitions: int = 4,
              work_dir: Optional[str] = None,
              mesh_exchange: str = "auto",
-             mesh_quota: Optional[int] = None) -> ColumnBatch:
+             mesh_quota: Optional[int] = None,
+             run_info: Optional[Dict[str, int]] = None) -> ColumnBatch:
     """Convert + execute a Spark plan tree locally; returns the collected
     result batch.
 
@@ -47,17 +48,28 @@ def run_plan(root: SparkPlan, num_partitions: int = 4,
     unsupported shapes; "off" always uses .data/.index files. mesh_quota
     caps the per-device-per-partition staging rows (None = safe default,
     no overflow possible).
+
+    run_info: optional dict populated with execution-path counters
+    ("mesh_stages", "file_stages", "broadcast_stages") so callers — the
+    multichip dryrun, tests — can assert WHICH transport carried each
+    exchange rather than trusting the result alone.
     """
     from blaze_tpu.runtime.tracing import profiled_scope
 
     with profiled_scope("run_plan"):
         return _run_plan_inner(root, num_partitions, work_dir,
-                               mesh_exchange, mesh_quota)
+                               mesh_exchange, mesh_quota, run_info)
 
 
 def _run_plan_inner(root: SparkPlan, num_partitions: int,
                     work_dir: Optional[str], mesh_exchange: str,
-                    mesh_quota: Optional[int]) -> ColumnBatch:
+                    mesh_quota: Optional[int],
+                    run_info: Optional[Dict[str, int]] = None) -> ColumnBatch:
+    if run_info is None:
+        run_info = {}
+    run_info.setdefault("mesh_stages", 0)
+    run_info.setdefault("file_stages", 0)
+    run_info.setdefault("broadcast_stages", 0)
     apply_strategy(root)
     from blaze_tpu.spark import converters, fallback
 
@@ -112,13 +124,16 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
                             _input_tasks(stage, stages), quota=mesh_quota,
                             work_dir=work_dir, stats=stats):
                         shuffle_bytes[stage.stage_id] = stats.get("bytes", 0)
+                        run_info["mesh_stages"] += 1
                         continue
                 logical = _run_shuffle_stage(stage, stages, shuffle_mgr)
                 # logical (uncompressed) bytes: the mesh path reports the
                 # same unit, so the AQE threshold is transport-independent
                 shuffle_bytes[stage.stage_id] = logical
+                run_info["file_stages"] += 1
             elif stage.kind == "broadcast":
                 _run_broadcast_stage(stage)
+                run_info["broadcast_stages"] += 1
             else:
                 parts = _input_tasks(stage, stages, fallback=num_partitions)
                 out = _run_result_stage(stage, parts)

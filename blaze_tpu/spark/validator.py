@@ -354,6 +354,8 @@ class Result:
     seconds: float
     error: Optional[str] = None
     diff: Optional[str] = None
+    spill_count: int = 0
+    spilled_bytes: int = 0
 
 
 def _compare(got: pd.DataFrame, want: pd.DataFrame) -> Optional[str]:
@@ -382,7 +384,15 @@ def _to_pandas(batch) -> pd.DataFrame:
 
 
 def run_matrix(tmpdir: str, rows: int = 20_000,
-               queries: Optional[List[str]] = None) -> List[Result]:
+               queries: Optional[List[str]] = None,
+               spill_budget: Optional[int] = None) -> List[Result]:
+    """spill_budget: when set, MemManager is (re)initialized to this many
+    bytes before every cell so sort/agg/shuffle spill fires IN QUERY
+    CONTEXT (the reference fuzz-gates a 1.23M-row external sort under
+    MemManager::init(10000), sort_exec.rs:954) — each Result then records
+    the spill counters the run produced."""
+    from blaze_tpu.runtime import memory as M
+
     paths, frames = generate_tables(tmpdir, rows=rows)
     results: List[Result] = []
     for name, build in QUERIES.items():
@@ -391,6 +401,10 @@ def run_matrix(tmpdir: str, rows: int = 20_000,
         modes = ["bhj"] if name in _JOINLESS else ["bhj", "smj"]
         for mode in modes:
             t0 = time.time()
+            mgr = M.init(spill_budget) if spill_budget else M.get_manager()
+            # deltas, not totals: without spill_budget the SHARED global
+            # manager carries counts from earlier cells/process activity
+            sc0, sb0 = mgr.spill_count, mgr.spilled_bytes
             try:
                 plan, oracle = build(paths, frames, mode)
                 out = run_plan(plan, num_partitions=4)
@@ -400,7 +414,10 @@ def run_matrix(tmpdir: str, rows: int = 20_000,
                 diff = _compare(got.reset_index(drop=True),
                                 want.reset_index(drop=True))
                 results.append(Result(name, mode, diff is None,
-                                      time.time() - t0, diff=diff))
+                                      time.time() - t0, diff=diff,
+                                      spill_count=mgr.spill_count - sc0,
+                                      spilled_bytes=mgr.spilled_bytes
+                                      - sb0))
             except Exception:
                 results.append(Result(name, mode, False, time.time() - t0,
                                       error=traceback.format_exc(limit=8)))
@@ -409,11 +426,16 @@ def run_matrix(tmpdir: str, rows: int = 20_000,
 
 def print_report(results: List[Result]) -> bool:
     ok = True
-    print(f"{'query':34s} {'join':5s} {'status':8s} {'sec':>6s}")
+    show_spill = any(r.spill_count for r in results)
+    hdr = f"{'query':34s} {'join':5s} {'status':8s} {'sec':>6s}"
+    print(hdr + ("  spills  spill_mb" if show_spill else ""))
     for r in results:
         status = "PASS" if r.ok else "FAIL"
         ok = ok and r.ok
-        print(f"{r.query:34s} {r.mode:5s} {status:8s} {r.seconds:6.1f}")
+        line = f"{r.query:34s} {r.mode:5s} {status:8s} {r.seconds:6.1f}"
+        if show_spill:
+            line += f"  {r.spill_count:6d}  {r.spilled_bytes / 1e6:8.1f}"
+        print(line)
         if r.diff:
             print(f"    diff: {r.diff}")
         if r.error:

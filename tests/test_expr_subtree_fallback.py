@@ -1,0 +1,142 @@
+"""Expression-subtree fallback (spark/expr_subtree_fallback.py).
+
+Ref contract being matched: NativeConverters.scala:290-372 — ONE exotic
+function in a Project wraps only that expression (params computed
+natively); the operator itself stays on the accelerated path instead of
+demoting to the row engine.
+"""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from blaze_tpu.columnar import types as T
+from blaze_tpu.exprs import ir
+from blaze_tpu.exprs.ir import BinOp, col, lit
+from blaze_tpu.spark import plan_model as P
+from blaze_tpu.spark.convert_strategy import apply_strategy
+from blaze_tpu.spark.fallback import register_python_fn
+from blaze_tpu.spark.local_runner import run_plan
+
+SCHEMA = T.Schema([T.Field("k", T.INT64), T.Field("v", T.FLOAT64)])
+
+
+@pytest.fixture
+def table(tmp_path, rng):
+    n = 3000
+    df = pd.DataFrame({
+        "k": rng.integers(0, 50, n).astype(np.int64),
+        "v": rng.random(n) * 100 - 20,
+    })
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(pa.Table.from_pandas(df), path)
+    return path, df
+
+
+def _exotic(a, b):
+    # signature: object arrays (None for null) -> array
+    av = np.asarray([x if x is not None else np.nan for x in a], np.float64)
+    bv = np.asarray([x if x is not None else np.nan for x in b], np.float64)
+    return np.sqrt(np.abs(av)) * 3.0 + bv
+
+
+register_python_fn("exotic_metric", _exotic)
+
+
+def _plan(path):
+    sc = P.scan(SCHEMA, [(path, [])])
+    proj = P.project(
+        sc,
+        [col("k"),
+         ir.ScalarFn("exotic_metric",
+                     (ir.Binary(BinOp.MUL, col("v"), lit(2.0)), col("v")),
+                     result_type=T.FLOAT64),
+         ir.Binary(BinOp.ADD, col("v"), lit(1.0))],
+        ["k", "m", "v1"],
+        T.Schema([T.Field("k", T.INT64), T.Field("m", T.FLOAT64),
+                  T.Field("v1", T.FLOAT64)]))
+    return proj
+
+
+def test_project_stays_native_with_wrapped_expr(table):
+    """The Project converts natively: only the exotic expression crosses
+    to the host evaluator; sibling expressions and the scan stay
+    columnar."""
+    path, df = table
+    plan = _plan(path)
+    apply_strategy(plan)
+    assert plan.strategy != "NeverConvert", (
+        "one unknown fn must not demote the whole operator")
+    # the rewrite replaced the ScalarFn with a UdfWrapper over the SAME
+    # argument subtrees
+    wrapped = plan.attrs["exprs"][1]
+    assert isinstance(wrapped, ir.UdfWrapper)
+    assert isinstance(wrapped.params[0], ir.Binary)
+
+
+def test_wrapped_expr_results_match_pandas(table):
+    path, df = table
+    out = run_plan(_plan(path), num_partitions=2)
+    d = out.to_numpy()
+    got = pd.DataFrame({k: list(v) for k, v in d.items()})
+    want = pd.DataFrame({
+        "k": df.k,
+        "m": np.sqrt(np.abs(df.v * 2.0)) * 3.0 + df.v,
+        "v1": df.v + 1.0,
+    })
+    got = got.sort_values(["k", "m"]).reset_index(drop=True)
+    want = want.sort_values(["k", "m"]).reset_index(drop=True)
+    np.testing.assert_allclose(got["m"], want["m"], rtol=1e-9)
+    np.testing.assert_allclose(got["v1"], want["v1"], rtol=1e-9)
+
+
+def test_string_returns_still_demote(table):
+    """A fallback-only fn with a string return stays UNwrapped (the
+    wrapper crossing is fixed-width only) and the operator falls back
+    whole — the pre-existing contract."""
+    path, _ = table
+
+    register_python_fn("exotic_str", lambda a: np.asarray(
+        [None if x is None else f"<{x}>" for x in a], object))
+    sc = P.scan(SCHEMA, [(path, [])])
+    proj = P.project(
+        sc, [col("k"),
+             ir.ScalarFn("exotic_str", (col("v"),),
+                         result_type=T.STRING)],
+        ["k", "s"],
+        T.Schema([T.Field("k", T.INT64), T.Field("s", T.STRING)]))
+    apply_strategy(proj)
+    assert isinstance(proj.attrs["exprs"][1], ir.ScalarFn)
+    assert proj.strategy == "NeverConvert"
+
+
+def test_wrapped_expr_on_neverconvert_operator_still_evaluates(tmp_path, rng):
+    """Regression: rewrite_plan runs BEFORE strategy tagging, so an
+    operator that still tags NeverConvert (here: a wide-decimal column
+    whose walk rejects UdfWrapper) must be able to evaluate the wrapped
+    node on the row engine via PYTHON_FNS."""
+    from decimal import Decimal
+
+    n = 200
+    wide = T.decimal(38, 4)
+    vals = [Decimal(int(rng.integers(1, 10**15)) * 10**15
+                    + int(rng.integers(0, 10**15))).scaleb(-4)
+            for _ in range(n)]
+    df = pd.DataFrame({"a": vals})
+    path = str(tmp_path / "w.parquet")
+    pq.write_table(pa.Table.from_pandas(
+        df, schema=pa.schema([("a", pa.decimal128(38, 4))])), path)
+
+    register_python_fn("mystery_dec", lambda a: np.asarray(
+        [float(x) * 2.0 for x in a], np.float64))
+    sc = P.scan(T.Schema([T.Field("a", wide)]), [(path, [])])
+    proj = P.project(
+        sc, [ir.ScalarFn("mystery_dec", (ir.Cast(col("a"), T.FLOAT64),),
+                         result_type=T.FLOAT64)],
+        ["m"], T.Schema([T.Field("m", T.FLOAT64)]))
+    out = run_plan(proj, num_partitions=1)
+    got = sorted(float(x) for x in out.to_numpy()["m"])
+    want = sorted(float(v) * 2.0 for v in vals)
+    np.testing.assert_allclose(got, want, rtol=1e-9)

@@ -304,3 +304,51 @@ def test_bnlj_existence(rng):
         JoinType.EXISTENCE, condition=cond)
     out2 = collect(j2).to_numpy()
     assert list(np.asarray(out2["exists"])) == [False, False, False]
+
+
+# ---------------------------------------------------------------------------
+# runtime BHJ build-size fallback (ref broadcast_join_exec.rs:188-249)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("jt,how", [
+    (JoinType.INNER, "inner"),
+    (JoinType.LEFT_SEMI, None),
+    (JoinType.LEFT_ANTI, None),
+])
+def test_bhj_runtime_size_fallback(rng, jt, how):
+    """An oversized build side flips BroadcastJoinExec into bounded
+    chunked-build mode at RUNTIME (enable_bhj_fallbacks_to_smj): results
+    stay identical to the resident path and the switch is observable as
+    the bhj_fallback_to_smj metric."""
+    from blaze_tpu.config import conf
+    from blaze_tpu.ops.join import BroadcastJoinExec
+
+    n_build, n_probe = 5000, 700
+    bk = rng.integers(0, 400, n_build).astype(np.int64)
+    bv = rng.random(n_build)
+    pk = rng.integers(0, 500, n_probe).astype(np.int64)
+    pv = rng.random(n_probe)
+    right = _mk(RS, bk, bv)          # build side (right)
+    left = _mk(LS, pk, pv)           # probe side
+
+    def run(threshold):
+        old = conf.bhj_fallback_rows_threshold
+        conf.bhj_fallback_rows_threshold = threshold
+        try:
+            j = BroadcastJoinExec(MemorySourceExec([left], LS),
+                                  MemorySourceExec([right], RS),
+                                  [JoinKey(0, 0)], jt)
+            out = _df(collect(j))
+            return out, j.metrics["bhj_fallback_to_smj"]
+        finally:
+            conf.bhj_fallback_rows_threshold = old
+
+    resident, m0 = run(10_000_000)
+    chunked, m1 = run(1024)          # build 5000 rows > 1024 -> fallback
+    assert m0 == 0
+    assert m1 == 1
+    assert _rows(resident) == _rows(chunked)
+    if how:  # cross-check inner against pandas
+        want = _oracle(pd.DataFrame({"lk": pk, "lv": pv}),
+                       pd.DataFrame({"rk": bk, "rv": bv}), how)
+        assert _rows(chunked) == _rows(want)

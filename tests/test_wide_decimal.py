@@ -374,20 +374,6 @@ def test_grouped_wide_sum_through_shuffle(wide_table, rng):
         assert got[int(g)] == v
 
 
-def test_division_on_wide_falls_back(wide_table):
-    df, p = wide_table
-    from blaze_tpu.spark.convert_strategy import apply_strategy
-
-    proj = SparkPlan(
-        "ProjectExec", T.Schema([T.Field("q", T.decimal(38, 10))]),
-        [_scan(p)],
-        {"exprs": [ir.Binary(ir.BinOp.DIV, ir.col("a"), ir.col("b"),
-                             result_type=T.decimal(38, 10))],
-         "names": ["q"]})
-    apply_strategy(proj)
-    assert proj.strategy == "NeverConvert"
-
-
 def test_cast_and_check_overflow(wide_table):
     df, p = wide_table
     narrow = T.decimal(10, 2)
@@ -419,3 +405,69 @@ def test_cast_and_check_overflow(wide_table):
         else:
             assert c is None  # overflow -> null
         np.testing.assert_allclose(f, float(row.a), rtol=1e-12)
+
+
+def test_project_division(wide_table):
+    """128-bit long division with HALF_UP at the planned result scale
+    (int128.divmod_full): wide/wide and wide/narrow quotients match
+    python Decimal; divide-by-zero goes null (Spark non-ANSI)."""
+    from decimal import ROUND_HALF_UP
+
+    df, p = wide_table
+    q_t = T.decimal(38, 10)
+    proj = SparkPlan(
+        "ProjectExec",
+        T.Schema([T.Field("k", T.INT64), T.Field("q", q_t),
+                  T.Field("qn", T.decimal(30, 6))]),
+        [_scan(p)],
+        {"exprs": [
+            ir.col("k"),
+            ir.Binary(ir.BinOp.DIV, ir.col("a"), ir.col("b"),
+                      result_type=q_t),
+            ir.Binary(ir.BinOp.DIV, ir.col("a"),
+                      ir.Literal(T.decimal(2, 0), 7),
+                      result_type=T.decimal(30, 6)),
+        ], "names": ["k", "q", "qn"]})
+    from blaze_tpu.spark.convert_strategy import apply_strategy
+    import copy
+    probe = copy.deepcopy(proj)
+    apply_strategy(probe)
+    assert probe.strategy != "NeverConvert", "division must convert"
+    out = run_plan(proj, num_partitions=1)
+    d = out.to_numpy()
+    by_k = {int(k): (q, qn) for k, q, qn in zip(d["k"], d["q"], d["qn"])}
+    exp10 = Decimal(1).scaleb(-10)
+    exp6 = Decimal(1).scaleb(-6)
+    for _, row in df.iterrows():
+        q, qn = by_k[int(row.k)]
+        if row.a is None:
+            assert q is None and qn is None
+            continue
+        if row.b is None or row.b == 0:
+            assert q is None
+        else:
+            want = (row.a / row.b).quantize(exp10, rounding=ROUND_HALF_UP)
+            assert q == int(want.scaleb(10)), (row.a, row.b, q, want)
+        want_n = (row.a / Decimal(7)).quantize(exp6,
+                                               rounding=ROUND_HALF_UP)
+        assert qn == int(want_n.scaleb(6))
+
+
+def test_division_gating_regression(wide_table):
+    """Unsupported wide usages still fall back whole-node: a division
+    whose scale-alignment can't provably fit 128 bits, and a MOD on wide
+    operands, must both tag NeverConvert (and still produce correct
+    results through the row engine)."""
+    from blaze_tpu.spark.convert_strategy import apply_strategy
+
+    df, p = wide_table
+    # delta = out_s - a.s + b.s = 20 - 4 + 4 = 20; p + delta = 45 > 38
+    bad = SparkPlan(
+        "ProjectExec",
+        T.Schema([T.Field("q", T.decimal(38, 20))]),
+        [_scan(p)],
+        {"exprs": [ir.Binary(ir.BinOp.DIV, ir.col("a"), ir.col("b"),
+                             result_type=T.decimal(38, 20))],
+         "names": ["q"]})
+    apply_strategy(bad)
+    assert bad.strategy == "NeverConvert"

@@ -22,14 +22,34 @@ def main() -> int:
                     help="store_sales row count")
     ap.add_argument("--queries", type=str, default="",
                     help="comma-separated subset of query names")
+    ap.add_argument("--spill-budget", type=int, default=0,
+                    help="force-spill mode: MemManager byte budget per "
+                    "cell (e.g. 2000000 with --rows 2000000 makes every "
+                    "sort/agg/shuffle spill in query context)")
+    ap.add_argument("--json-out", type=str, default="",
+                    help="also write the per-cell results as JSON")
     args = ap.parse_args()
 
     from blaze_tpu.spark.validator import print_report, run_matrix
 
     queries = [q for q in args.queries.split(",") if q] or None
     with tempfile.TemporaryDirectory(prefix="blaze_tpu_validate_") as tmp:
-        results = run_matrix(tmp, rows=args.rows, queries=queries)
-    return 0 if print_report(results) else 1
+        results = run_matrix(tmp, rows=args.rows, queries=queries,
+                             spill_budget=args.spill_budget or None)
+    ok = print_report(results)
+    if args.json_out:
+        import dataclasses
+        import json
+
+        with open(args.json_out, "w") as f:
+            json.dump({"rows": args.rows,
+                       "spill_budget": args.spill_budget,
+                       "results": [dataclasses.asdict(r) for r in results]},
+                      f, indent=1)
+    if args.spill_budget and ok and not any(r.spill_count for r in results):
+        print("FORCE-SPILL MODE: no spill observed — budget too large?")
+        return 1
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":
