@@ -47,6 +47,10 @@ class BlazeConf:
     # dense grouped-agg key range for the MXU one-hot path (<= 2^16:
     # 256x256 byte decomposition); stages whose keys exceed it fall back
     dense_agg_range: int = 1 << 16
+    # external-sort spill frame rows: merge cost is one dispatch trio
+    # per pooled frame, so bigger frames amortize the fixed per-dispatch
+    # overhead (~90ms each on the remote-attached chip)
+    spill_frame_rows: int = 1 << 16
     # AQE dynamic join selection: a planned SMJ whose shuffled input came
     # in under this many bytes becomes a broadcast join (Spark's
     # autoBroadcastJoinThreshold analog; 0 disables)

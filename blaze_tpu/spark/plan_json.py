@@ -189,6 +189,10 @@ def decode_expr(node: dict) -> ir.Expr:
     cls = _cls(node)
     ch = node["children"]
 
+    if cls in _shim().transparent_expr_wrappers():
+        # PromotePrecision (<=3.3) / KnownNotNull / normalized-float
+        # hints: identity value semantics on these kernels
+        return decode_expr(ch[0])
     if cls == "AttributeReference":
         return ir.Col(_attr_name(node.get("exprId")))
     if cls == "Alias":
@@ -232,6 +236,10 @@ def decode_expr(node: dict) -> ir.Expr:
     if cls == "UnaryMinus":
         return ir.Negate(decode_expr(ch[0]))
     if cls == "Cast" or cls == "AnsiCast":
+        if cls == "AnsiCast" or not _shim().cast_is_legacy(node):
+            # the engine's cast kernels implement LEGACY (non-ANSI)
+            # semantics; ANSI/TRY casts must stay on Spark
+            raise PlanJsonError("non-LEGACY cast mode stays on Spark")
         return ir.Cast(decode_expr(ch[0]),
                        decode_datatype(node.get("dataType")))
     if cls == "In":
@@ -402,13 +410,40 @@ def _output_schema(node: dict) -> T.Schema:
 # ---------------------------------------------------------------------------
 
 
-def decode_plan_json(text: str) -> SparkPlan:
-    """Spark `executedPlan.toJSON` -> SparkPlan tree (planner input)."""
-    nodes = json.loads(text)
-    if not isinstance(nodes, list) or not nodes:
-        raise PlanJsonError("expected the TreeNode pre-order array")
-    tree, _ = _build_tree(nodes, 0)
-    return _decode_node(tree)
+# decode-time version shim (spark/shims.py); module-level because the
+# recursive decoders thread no context object. decode_plan_json is the
+# only writer.
+_CURRENT_SHIM = None
+
+
+def _shim():
+    global _CURRENT_SHIM
+    if _CURRENT_SHIM is None:
+        from blaze_tpu.spark.shims import for_version
+
+        _CURRENT_SHIM = for_version(None)
+    return _CURRENT_SHIM
+
+
+def decode_plan_json(text: str, spark_version: str = None) -> SparkPlan:
+    """Spark `executedPlan.toJSON` -> SparkPlan tree (planner input).
+
+    spark_version selects the per-version decode shim (spark/shims.py) —
+    node-class renames, AQE shells, cast eval-mode and limit-offset
+    encodings differ across 3.0-3.5; None = the 3.3 dialect."""
+    from blaze_tpu.spark.shims import for_version
+
+    global _CURRENT_SHIM
+    prev = _CURRENT_SHIM
+    _CURRENT_SHIM = for_version(spark_version)
+    try:
+        nodes = json.loads(text)
+        if not isinstance(nodes, list) or not nodes:
+            raise PlanJsonError("expected the TreeNode pre-order array")
+        tree, _ = _build_tree(nodes, 0)
+        return _decode_node(tree)
+    finally:
+        _CURRENT_SHIM = prev
 
 
 _JOIN_TYPES = {"Inner": "inner", "LeftOuter": "left", "RightOuter": "right",
@@ -417,18 +452,19 @@ _JOIN_TYPES = {"Inner": "inner", "LeftOuter": "left", "RightOuter": "right",
 
 
 def _decode_node(node: dict) -> SparkPlan:
-    cls = _cls(node)
+    shim = _shim()
+    cls = shim.normalize_plan_class(_cls(node))
     ch = node["children"]
 
     # transparent wrappers (AQE shells, columnar transitions, reused
-    # exchanges — ref shims AQE node recognition, ShimsImpl.scala:271-299)
-    if cls in ("AdaptiveSparkPlanExec", "QueryStageExec",
-               "ShuffleQueryStageExec", "BroadcastQueryStageExec",
-               "InputAdapter", "WholeStageCodegenExec",
-               "ColumnarToRowExec", "RowToColumnarExec",
-               "ReusedExchangeExec", "AQEShuffleReadExec",
-               "CustomShuffleReaderExec", "CollectLimitExec"):
+    # exchanges — ref shims AQE node recognition, ShimsImpl.scala:271-299;
+    # the per-version shell set lives in spark/shims.py)
+    if cls in shim.transparent_wrappers() or cls in (
+            "AQEShuffleReadExec", "CollectLimitExec"):
         if cls == "CollectLimitExec":
+            if shim.limit_offset(node):
+                raise PlanJsonError("limit offset has no kernel; "
+                                    "stays on Spark")
             inner = _decode_node(ch[0])
             return SparkPlan("GlobalLimitExec", inner.schema, [inner],
                              {"limit": int(node.get("limit", 0))})
@@ -547,6 +583,9 @@ def _decode_node(node: dict) -> SparkPlan:
         child = _decode_node(ch[0])
         return SparkPlan("BroadcastExchangeExec", child.schema, [child], {})
     if cls in ("LocalLimitExec", "GlobalLimitExec"):
+        if shim.limit_offset(node):
+            raise PlanJsonError("limit offset has no kernel; "
+                                "stays on Spark")
         child = _decode_node(ch[0])
         return SparkPlan(cls, child.schema, [child],
                          {"limit": int(node.get("limit", 0))})

@@ -30,11 +30,13 @@ def pyspark_available() -> bool:
         return False
 
 
-def capture_plan_json(spark, sql: str) -> str:
-    """The executed physical plan of `sql`, as Spark's TreeNode JSON —
-    the exact artifact plan_json.decode_plan_json consumes."""
+def capture_plan_json(spark, sql: str) -> tuple:
+    """(plan_json, spark_version) of `sql`'s executed physical plan —
+    the exact artifacts plan_json.decode_plan_json consumes (the version
+    selects the decode shim, spark/shims.py)."""
     df = spark.sql(sql)
-    return df._jdf.queryExecution().executedPlan().toJSON()
+    return (df._jdf.queryExecution().executedPlan().toJSON(),
+            str(spark.version))
 
 
 def run_sql(spark, sql: str, num_partitions: int = 4):
@@ -42,6 +44,6 @@ def run_sql(spark, sql: str, num_partitions: int = 4):
     from blaze_tpu.spark.local_runner import run_plan
     from blaze_tpu.spark.plan_json import decode_plan_json
 
-    js = capture_plan_json(spark, sql)
-    plan = decode_plan_json(js)
+    js, version = capture_plan_json(spark, sql)
+    plan = decode_plan_json(js, spark_version=version)
     return run_plan(plan, num_partitions=num_partitions)

@@ -1,0 +1,192 @@
+"""Per-Spark-version decode shims (spark/shims.py).
+
+Ref: shim-per-Spark-line dispatch (Shims.scala:54-231) + AQE node
+recognition (ShimsImpl.scala:271-299). Synthetic TreeNode JSON in each
+version's dialect: class renames, 3.4 cast evalMode, 3.4 limit offsets,
+<=3.3 PromotePrecision wrappers, 3.5 AQE shells.
+"""
+
+import json
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from blaze_tpu.spark.plan_json import PlanJsonError, decode_plan_json
+from blaze_tpu.spark.shims import for_version
+
+SPARK = "org.apache.spark.sql"
+
+
+def test_version_snap():
+    assert for_version(None).version == (3, 3)
+    assert for_version("3.0.3").version == (3, 0)
+    assert for_version("3.3.2").version == (3, 3)
+    assert for_version("3.4.1").version == (3, 4)
+    assert for_version("3.6.0").version == (3, 5)  # nearest known below
+
+
+def _attr(name, dtype, eid):
+    return [{
+        "class": f"{SPARK}.catalyst.expressions.AttributeReference",
+        "num-children": 0, "name": name, "dataType": dtype,
+        "nullable": True, "metadata": {},
+        "exprId": {"product-class": f"{SPARK}.catalyst.expressions.ExprId",
+                   "id": eid, "jvmId": "x"},
+        "qualifier": [],
+    }]
+
+
+def _scan(path, attrs):
+    return {
+        "class": f"{SPARK}.execution.FileSourceScanExec",
+        "num-children": 0,
+        "relation": {"location": {"rootPaths": [f"file:{path}"]},
+                     "fileFormat": {}},
+        "output": attrs,
+        "requiredSchema": {"type": "struct", "fields": []},
+        "partitionFilters": [], "dataFilters": [],
+    }
+
+
+@pytest.fixture
+def table(tmp_path, rng):
+    df = pd.DataFrame({"v": rng.random(50) * 10})
+    p = str(tmp_path / "t.parquet")
+    pq.write_table(pa.Table.from_pandas(df), p)
+    return p, df
+
+
+def test_custom_shuffle_reader_rename_30(table):
+    """3.0/3.1's CustomShuffleReaderExec decodes as the AQE shell that
+    3.2+ calls AQEShuffleReadExec."""
+    p, df = table
+    plan = [
+        {"class": f"{SPARK}.execution.adaptive.CustomShuffleReaderExec",
+         "num-children": 1, "child": 0},
+        _scan(p, [_attr("v", "double", 1)]),
+    ]
+    root = decode_plan_json(json.dumps(plan), spark_version="3.0.2")
+    # shell dissolved: the scan(+rename projection) remains
+    assert root.kind == "ProjectExec"
+    assert root.children[0].kind == "FileSourceScanExec"
+
+
+def test_result_query_stage_35(table):
+    p, df = table
+    plan = [
+        {"class": f"{SPARK}.execution.adaptive.ResultQueryStageExec",
+         "num-children": 1, "child": 0},
+        _scan(p, [_attr("v", "double", 1)]),
+    ]
+    root = decode_plan_json(json.dumps(plan), spark_version="3.5.1")
+    assert root.children[0].kind == "FileSourceScanExec"
+    # and a 3.3 decode rejects the unknown shell
+    with pytest.raises(PlanJsonError):
+        decode_plan_json(json.dumps(plan), spark_version="3.3.0")
+
+
+def _cast_plan(path, extra_cast_fields):
+    cast = [{"class": f"{SPARK}.catalyst.expressions.Cast",
+             "num-children": 1, "child": 0, "dataType": "long",
+             **extra_cast_fields}] + _attr("v", "double", 1)
+    return [
+        {"class": f"{SPARK}.execution.ProjectExec", "num-children": 1,
+         "projectList": [[{
+             "class": f"{SPARK}.catalyst.expressions.Alias",
+             "num-children": 1, "child": 0, "name": "c",
+             "exprId": {"product-class":
+                        f"{SPARK}.catalyst.expressions.ExprId",
+                        "id": 9, "jvmId": "x"},
+             "qualifier": []}] + cast],
+         "child": 0},
+        _scan(path, [_attr("v", "double", 1)]),
+    ]
+
+
+def test_cast_eval_mode_34(table):
+    """3.4 encodes evalMode: LEGACY decodes; ANSI/TRY fall back (the
+    engine's cast kernels are non-ANSI). 3.3 encodes ansiEnabled."""
+    p, _ = table
+    ok = decode_plan_json(json.dumps(_cast_plan(p, {"evalMode": "LEGACY"})),
+                          spark_version="3.4.0")
+    assert ok.kind == "ProjectExec"
+    for mode in ("ANSI", "TRY"):
+        with pytest.raises(PlanJsonError):
+            decode_plan_json(
+                json.dumps(_cast_plan(p, {"evalMode": mode})),
+                spark_version="3.4.0")
+    with pytest.raises(PlanJsonError):
+        decode_plan_json(
+            json.dumps(_cast_plan(p, {"ansiEnabled": True})),
+            spark_version="3.3.0")
+    ok33 = decode_plan_json(
+        json.dumps(_cast_plan(p, {"ansiEnabled": False})),
+        spark_version="3.3.2")
+    assert ok33.kind == "ProjectExec"
+
+
+def test_limit_offset_34(table):
+    p, _ = table
+    plan = [
+        {"class": f"{SPARK}.execution.GlobalLimitExec", "num-children": 1,
+         "limit": 10, "offset": 5, "child": 0},
+        _scan(p, [_attr("v", "double", 1)]),
+    ]
+    with pytest.raises(PlanJsonError):
+        decode_plan_json(json.dumps(plan), spark_version="3.4.1")
+    # 3.3 has no offset field semantics: same JSON decodes (field ignored)
+    root = decode_plan_json(json.dumps(plan), spark_version="3.3.0")
+    assert root.kind == "GlobalLimitExec"
+    # 3.4 with offset 0 decodes
+    plan[0]["offset"] = 0
+    assert decode_plan_json(json.dumps(plan),
+                            spark_version="3.4.1").kind == "GlobalLimitExec"
+
+
+def test_promote_precision_wrapper_33(table):
+    """<=3.3 wraps decimal operands in PromotePrecision (removed in 3.4,
+    SPARK-39316): it decodes transparently."""
+    p, df = table
+    pp = [{"class": f"{SPARK}.catalyst.expressions.PromotePrecision",
+           "num-children": 1, "child": 0}] + _attr("v", "double", 1)
+    plan = [
+        {"class": f"{SPARK}.execution.FilterExec", "num-children": 1,
+         "condition": [{
+             "class": f"{SPARK}.catalyst.expressions.GreaterThan",
+             "num-children": 2, "left": 0, "right": 1}] + pp + [
+             {"class": f"{SPARK}.catalyst.expressions.Literal",
+              "num-children": 0, "value": "5.0", "dataType": "double"}],
+         "child": 0},
+        _scan(p, [_attr("v", "double", 1)]),
+    ]
+    root = decode_plan_json(json.dumps(plan), spark_version="3.3.0")
+    assert root.kind == "FilterExec"
+    from blaze_tpu.spark.local_runner import run_plan
+
+    out = run_plan(root, num_partitions=1)
+    assert int(out.num_rows) == int((df.v > 5.0).sum())
+
+
+def test_pre30_rejected():
+    from blaze_tpu.spark.shims import ShimError
+
+    with pytest.raises(ShimError):
+        for_version("2.4.8")
+    with pytest.raises(ShimError):
+        for_version("nonsense")
+
+
+def test_custom_shuffle_reader_accepted_without_version(table):
+    """A 3.0/3.1 capture decoded with NO version string (the default
+    shim) must still dissolve the old shell name."""
+    p, _ = table
+    plan = [
+        {"class": f"{SPARK}.execution.adaptive.CustomShuffleReaderExec",
+         "num-children": 1, "child": 0},
+        _scan(p, [_attr("v", "double", 1)]),
+    ]
+    root = decode_plan_json(json.dumps(plan))
+    assert root.children[0].kind == "FileSourceScanExec"
