@@ -271,9 +271,12 @@ class _AggState:
         return acc
 
     def close(self) -> None:
+        """Double-fault-safe: called from the stream's finally during
+        unwinding; one failing spill close must not mask the query error
+        or leak the remaining files (runtime.memory.close_all_quietly)."""
         self.manager.unregister(self)
-        for sf in self.spills:
-            sf.close()
+        spills, self.spills = self.spills, []
+        self._M.close_all_quietly(spills, "agg spill")
 
 
 class AggExec(Operator):

@@ -85,6 +85,9 @@ class ExternalSorter:
         self.pending: List[ColumnBatch] = []
         self.pending_bytes = 0
         self.runs: List = []
+        # counters survive abort() — metrics read them after cleanup
+        self.spill_count = 0
+        self.spilled_bytes = 0
         self._M = M
         self.manager.register(self)
 
@@ -106,7 +109,14 @@ class ExternalSorter:
         # k-INVARIANT (20 krows/s at k=8 vs 24 krows/s at k=64 on the
         # CPU mesh), so the O(k) head-min scan the reference's LoserTree
         # would replace is not the cost driver; iteration overhead is.
-        frame = int(conf.spill_frame_rows)
+        # The frame is CLAMPED against the memory budget: the merge holds
+        # one head frame per run (plus pool/carry) un-budgeted, so frames
+        # sized ~budget/8 keep the merge's working set inside the budget
+        # class that forced spilling in the first place.
+        cap = max(int(big.capacity), 1)
+        row_bytes = max(self._M.batch_nbytes(big) // cap, 1)
+        budget_rows = max(self.manager.total // (8 * row_bytes), 1024)
+        frame = int(min(int(conf.spill_frame_rows), budget_rows))
         for lo in range(0, max(int(sb.num_rows), 1), frame):
             from blaze_tpu.ops.common import slice_batch
 
@@ -115,6 +125,8 @@ class ExternalSorter:
                 break
             run.write(chunk)
         self.runs.append(run)
+        self.spill_count += 1
+        self.spilled_bytes += run.bytes_written
         self.pending, self.pending_bytes = [], 0
         return freed
 
@@ -140,11 +152,15 @@ class ExternalSorter:
     def abort(self) -> None:
         """Idempotent cleanup (also the error path: SortExec wraps its
         stream in try/finally so a cancelled query never leaks the
-        MemManager registration or spill files)."""
+        MemManager registration or spill files).
+
+        Double-fault contract (ref §5.3 failure detection): cleanup runs
+        during exception unwinding, so a failing close must neither mask
+        the original query error nor stop later runs from closing."""
         self.manager.unregister(self)
         self.pending, self.pending_bytes = [], 0
-        for r in self.runs:
-            r.close()
+        runs, self.runs = self.runs, []
+        self._M.close_all_quietly(runs, "sort spill-run")
 
     # -- k-way merge of sorted runs --
     # The reference merges with a per-ROW LoserTree over run cursors
@@ -254,12 +270,11 @@ class SortExec(Operator):
                     if int(batch.num_rows):
                         with self.metrics.timer():
                             sorter.add(batch)
-                runs = sorter.runs  # finish() may add a final spill run
                 with self.metrics.timer():
                     yield from sorter.finish()
-                self.metrics.add("spill_count", len(runs))
-                self.metrics.add("spilled_bytes",
-                                 sum(r.bytes_written for r in runs))
+                # counters (not the runs list) — abort() empties the list
+                self.metrics.add("spill_count", sorter.spill_count)
+                self.metrics.add("spilled_bytes", sorter.spilled_bytes)
             finally:
                 sorter.abort()
 

@@ -177,7 +177,7 @@ class WindowExec(Operator):
                         yield compute(
                             chunks[0] if len(chunks) == 1
                             else concat_batches(chunks, work_schema))
-                    self.metrics.add("spill_count", len(sorter.runs))
+                    self.metrics.add("spill_count", sorter.spill_count)
                     return
                 carry: Optional[ColumnBatch] = None
                 for sb in sorter.finish():
@@ -194,7 +194,7 @@ class WindowExec(Operator):
                     yield compute(done)
                 if carry is not None and int(carry.num_rows) > 0:
                     yield compute(carry)
-                self.metrics.add("spill_count", len(sorter.runs))
+                self.metrics.add("spill_count", sorter.spill_count)
             finally:
                 sorter.abort()
 

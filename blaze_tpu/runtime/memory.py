@@ -126,6 +126,21 @@ def init(total: int) -> MemManager:
     return _global
 
 
+def close_all_quietly(closeables, what: str) -> None:
+    """Close every item best-effort. Cleanup paths run during exception
+    unwinding (§5.3 double-fault contract): one failing close must
+    neither mask the original query error nor stop the remaining
+    closes — failures are logged and swallowed."""
+    import logging
+
+    for c in closeables:
+        try:
+            c.close()
+        except Exception:  # noqa: BLE001 — see contract above
+            logging.getLogger(__name__).warning(
+                "%s close failed during cleanup", what, exc_info=True)
+
+
 class SpillFile:
     """A sequence of serialized batches in a host tempfile (ref FileSpill,
     onheap_spill.rs:26-75; format = the zstd batch frames)."""

@@ -12,8 +12,9 @@ the module is a no-op without it. Usage with a live Spark session:
 
     from blaze_tpu.spark.pyspark_ext import capture_plan_json, run_sql
 
-    js = capture_plan_json(spark, "SELECT ...")   # real Catalyst output
-    batch = run_sql(spark, "SELECT ...")          # executes on this engine
+    js, version = capture_plan_json(spark, "SELECT ...")  # Catalyst JSON
+    plan = decode_plan_json(js, spark_version=version)    # shimmed decode
+    batch = run_sql(spark, "SELECT ...")          # or: all in one step
 """
 
 from __future__ import annotations

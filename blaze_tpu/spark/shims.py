@@ -75,10 +75,12 @@ class Shim:
         return w
 
     def limit_offset(self, node: dict) -> int:
-        if self.version >= (3, 4):
-            v = node.get("offset", 0)
-            return int(v) if v else 0
-        return 0
+        # unconditional (not gated on >= 3.4): the field never appears
+        # in <=3.3 JSON, and a 3.4+ capture decoded WITHOUT its version
+        # string must still fall back loudly rather than silently drop
+        # the offset
+        v = node.get("offset", 0)
+        return int(v) if v else 0
 
     # ---- expression surface ----
     def transparent_expr_wrappers(self) -> frozenset:
@@ -88,9 +90,14 @@ class Shim:
 
     def cast_is_legacy(self, node: dict) -> bool:
         """True when the cast carries the non-ANSI semantics this
-        engine's cast kernels implement (exprs/cast.py)."""
-        if self.version >= (3, 4):
-            mode = node.get("evalMode", "LEGACY")
+        engine's cast kernels implement (exprs/cast.py).
+
+        BOTH encodings are checked regardless of version: `evalMode`
+        (3.4+) and `ansiEnabled` (<=3.3) never coexist, and a 3.4+
+        capture decoded without its version string must still reject
+        ANSI/TRY casts instead of running them with LEGACY kernels."""
+        mode = node.get("evalMode")
+        if mode is not None:
             # encoded as a bare enum name or Some(name)
             if isinstance(mode, list) and mode:
                 mode = mode[0]

@@ -175,3 +175,45 @@ def test_window_with_spill(rng, tiny_budget):
     assert got["rn"].tolist() == df["rn"].tolist()
     np.testing.assert_allclose(got["rsum"], df["rsum"], rtol=1e-9)
     assert int(out.num_rows) == len(df)
+
+
+def test_cleanup_double_fault(rng):
+    """§5.3 double-fault contract: a spill-run close that itself fails
+    during error unwinding must neither mask the original error nor
+    stop the remaining runs from closing."""
+    import numpy as np
+
+    from blaze_tpu.columnar import types as T
+    from blaze_tpu.columnar.batch import ColumnBatch
+    from blaze_tpu.ops.sort import ExternalSorter
+    from blaze_tpu.ops.sort_keys import SortSpec
+    from blaze_tpu.runtime import memory as M
+
+    schema = T.Schema([T.Field("k", T.INT64)])
+    mgr = M.MemManager(1)
+    s = ExternalSorter(schema, [SortSpec(0)], mgr)
+    for _ in range(3):
+        s.add(ColumnBatch.from_numpy(
+            {"k": rng.integers(0, 100, 500).astype(np.int64)}, schema))
+        s.spill()
+    closed = []
+    real_close = type(s.runs[0]).close
+
+    def bad_close(self):
+        closed.append(self)
+        if len(closed) == 1:
+            raise OSError("disk went away")
+        return real_close(self)
+
+    runs = list(s.runs)
+    try:
+        type(s.runs[0]).close = bad_close
+        s.abort()  # must not raise, must attempt every close
+    finally:
+        type(runs[0]).close = real_close
+    assert len(closed) == 3
+    for r in runs[1:]:
+        assert r._fp is None  # genuinely closed
+    assert s.runs == []
+    # idempotent after the fault
+    s.abort()

@@ -108,7 +108,8 @@ def _cast_plan(path, extra_cast_fields):
 
 def test_cast_eval_mode_34(table):
     """3.4 encodes evalMode: LEGACY decodes; ANSI/TRY fall back (the
-    engine's cast kernels are non-ANSI). 3.3 encodes ansiEnabled."""
+    engine's cast kernels are non-ANSI) — even when the capture's
+    version was not supplied. 3.3 encodes ansiEnabled."""
     p, _ = table
     ok = decode_plan_json(json.dumps(_cast_plan(p, {"evalMode": "LEGACY"})),
                           spark_version="3.4.0")
@@ -118,6 +119,8 @@ def test_cast_eval_mode_34(table):
             decode_plan_json(
                 json.dumps(_cast_plan(p, {"evalMode": mode})),
                 spark_version="3.4.0")
+        with pytest.raises(PlanJsonError):
+            decode_plan_json(json.dumps(_cast_plan(p, {"evalMode": mode})))
     with pytest.raises(PlanJsonError):
         decode_plan_json(
             json.dumps(_cast_plan(p, {"ansiEnabled": True})),
@@ -137,10 +140,14 @@ def test_limit_offset_34(table):
     ]
     with pytest.raises(PlanJsonError):
         decode_plan_json(json.dumps(plan), spark_version="3.4.1")
-    # 3.3 has no offset field semantics: same JSON decodes (field ignored)
-    root = decode_plan_json(json.dumps(plan), spark_version="3.3.0")
-    assert root.kind == "GlobalLimitExec"
-    # 3.4 with offset 0 decodes
+    # the offset field only exists in 3.4+ JSON, so it is honored (and
+    # rejected) regardless of the announced version — a version-less
+    # decode of a 3.4 capture must not silently drop rows
+    with pytest.raises(PlanJsonError):
+        decode_plan_json(json.dumps(plan), spark_version="3.3.0")
+    with pytest.raises(PlanJsonError):
+        decode_plan_json(json.dumps(plan))
+    # offset 0 decodes everywhere
     plan[0]["offset"] = 0
     assert decode_plan_json(json.dumps(plan),
                             spark_version="3.4.1").kind == "GlobalLimitExec"
