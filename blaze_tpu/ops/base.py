@@ -98,8 +98,22 @@ class MapLikeOp(Operator):
 
 
 def count_stream(op: Operator, stream: BatchStream) -> BatchStream:
-    """Wrap a stream updating the operator's baseline metrics."""
+    """Wrap a stream updating the operator's baseline metrics.
+
+    With `conf.enable_input_batch_statistics` (the reference's
+    batch_statisitcs module: per-exec input-batch stat metrics behind
+    spark.blaze.enableInputBatchStatistics), every batch also records
+    byte/row-size statistics — each operator's output stream IS its
+    parent's input stream, so one output-side hook covers the plan."""
+    from blaze_tpu.config import conf
+
+    stats = conf.enable_input_batch_statistics
     for batch in stream:
         op.metrics.add("output_batches", 1)
         op.metrics.add("output_rows", int(batch.num_rows))
+        if stats:
+            from blaze_tpu.runtime.memory import batch_nbytes
+
+            op.metrics.add("stat_bytes", batch_nbytes(batch))
+            op.metrics.set_max("stat_max_batch_rows", int(batch.num_rows))
         yield batch

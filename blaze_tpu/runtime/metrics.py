@@ -9,8 +9,11 @@ bridge) can remap values into Spark's metric system.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Callable, Dict, List, Optional
+
+_max_lock = threading.Lock()
 
 
 class MetricsSet:
@@ -23,6 +26,13 @@ class MetricsSet:
 
     def add(self, name: str, delta: int) -> None:
         self.values[name] = self.values.get(name, 0) + int(delta)
+
+    def set_max(self, name: str, value: int) -> None:
+        """Max-semantics update (a read-then-add emulation would produce
+        impossible values when concurrent tasks interleave)."""
+        with _max_lock:
+            if int(value) > self.values.get(name, 0):
+                self.values[name] = int(value)
 
     def timer(self, name: str = "elapsed_compute_ns"):
         return _Timer(self, name)

@@ -31,21 +31,24 @@ from blaze_tpu.exprs import ir
 from blaze_tpu.spark.plan_model import SparkPlan
 
 
+def _map_value(v, fn):
+    """Rewrite Exprs inside a field value, descending nested tuples
+    (CaseWhen carries a tuple of (cond, value) PAIRS)."""
+    if isinstance(v, ir.Expr):
+        return _map_expr(v, fn)
+    if isinstance(v, tuple):
+        return tuple(_map_value(x, fn) for x in v)
+    return v
+
+
 def _map_expr(e: ir.Expr, fn: Callable[[ir.Expr], ir.Expr]) -> ir.Expr:
     """Bottom-up rebuild: apply `fn` to every node, children first."""
     changes = {}
     for f in dataclasses.fields(e):
         v = getattr(e, f.name)
-        if isinstance(v, ir.Expr):
-            nv = _map_expr(v, fn)
-            if nv is not v:
-                changes[f.name] = nv
-        elif isinstance(v, tuple) and any(
-                isinstance(x, ir.Expr) for x in v):
-            nv = tuple(_map_expr(x, fn) if isinstance(x, ir.Expr) else x
-                       for x in v)
-            if nv != v:
-                changes[f.name] = nv
+        nv = _map_value(v, fn)
+        if nv is not v and nv != v:
+            changes[f.name] = nv
     if changes:
         e = dataclasses.replace(e, **changes)
     return fn(e)
