@@ -108,12 +108,12 @@ def count_stream(op: Operator, stream: BatchStream) -> BatchStream:
     from blaze_tpu.config import conf
 
     stats = conf.enable_input_batch_statistics
+    if stats:
+        from blaze_tpu.runtime.memory import batch_nbytes
     for batch in stream:
         op.metrics.add("output_batches", 1)
         op.metrics.add("output_rows", int(batch.num_rows))
         if stats:
-            from blaze_tpu.runtime.memory import batch_nbytes
-
             op.metrics.add("stat_bytes", batch_nbytes(batch))
             op.metrics.set_max("stat_max_batch_rows", int(batch.num_rows))
         yield batch

@@ -13,8 +13,6 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
-_max_lock = threading.Lock()
-
 
 class MetricsSet:
     def __init__(self) -> None:
@@ -23,14 +21,16 @@ class MetricsSet:
             "output_batches": 0,
             "elapsed_compute_ns": 0,
         }
+        self._lock = threading.Lock()
 
     def add(self, name: str, delta: int) -> None:
         self.values[name] = self.values.get(name, 0) + int(delta)
 
     def set_max(self, name: str, value: int) -> None:
         """Max-semantics update (a read-then-add emulation would produce
-        impossible values when concurrent tasks interleave)."""
-        with _max_lock:
+        impossible values when concurrent tasks interleave); per-instance
+        lock so different operators' metrics never contend."""
+        with self._lock:
             if int(value) > self.values.get(name, 0):
                 self.values[name] = int(value)
 

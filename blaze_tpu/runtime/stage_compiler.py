@@ -46,6 +46,7 @@ _AGG_FNS = ("sum", "count", "avg")
 
 # plan-shape -> last working dense range bucket (see try_run_stage)
 _R_MEMO: dict = {}
+_stats_warned = False
 
 
 def _walk_chain(node: Operator):
@@ -151,6 +152,19 @@ def try_run_stage(root: Operator, ctx: ExecContext, deferred: bool = False
     came back clean (a discarded stage never ran to completion)."""
     if not conf.enable_stage_compiler:
         return None
+    if conf.enable_input_batch_statistics:
+        # per-batch stat metrics hook into the STREAMING path's
+        # count_stream; a whole-stage program has no per-batch stream by
+        # design — warn once instead of silently recording nothing
+        global _stats_warned
+        if not _stats_warned:
+            _stats_warned = True
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "enable_input_batch_statistics records nothing for "
+                "whole-stage-compiled stages (single dispatch, no batch "
+                "stream); disable the stage compiler to collect stats")
     m = _match(root)
     if m is None:
         mc = _match_chain(root)

@@ -37,7 +37,10 @@ def _map_value(v, fn):
     if isinstance(v, ir.Expr):
         return _map_expr(v, fn)
     if isinstance(v, tuple):
-        return tuple(_map_value(x, fn) for x in v)
+        new = tuple(_map_value(x, fn) for x in v)
+        # preserve identity when nothing changed so callers can use a
+        # cheap `is` check instead of deep subtree equality
+        return v if all(a is b for a, b in zip(new, v)) else new
     return v
 
 
@@ -47,7 +50,7 @@ def _map_expr(e: ir.Expr, fn: Callable[[ir.Expr], ir.Expr]) -> ir.Expr:
     for f in dataclasses.fields(e):
         v = getattr(e, f.name)
         nv = _map_value(v, fn)
-        if nv is not v and nv != v:
+        if nv is not v:
             changes[f.name] = nv
     if changes:
         e = dataclasses.replace(e, **changes)
