@@ -71,20 +71,22 @@ _BIAS8 = np.uint64(128 * ((1 << 64) - 1) // 255)    # 8-chunk (i64 path)
 # pallas fused path (TPU only): the XLA formulation materializes the
 # (n, P*GL) digit-carrier and (n, gh) one-hot operands in HBM; the kernel
 # builds both tiles in VMEM and leaves only the (gh, P*GL) s32 result.
-_PALLAS_MAX_VMEM = 14 << 20  # of the 16M scoped-vmem stack
+_PALLAS_MAX_VMEM = 11 << 20  # resident-tile-bytes envelope (see _pick_tile)
 _I32_EXACT_ROWS = 1 << 23   # 127 * 2^23 < 2^31: s32 block-exactness bound
 
 
 def _pick_tile(n: int, gh: int, pgl: int):
-    """Largest T whose kernel fits the 16M scoped-vmem stack (estimate
-    calibrated on-chip: P=7/T=2048 measured 16.8M — the dominant terms
-    are the s32 select intermediates + s8 tiles for `a` and oh_h, ~5
-    bytes/elem each, plus the s32 accumulator + output)."""
-    for T in (2048, 1024, 512, 256):
+    """Largest T whose kernel fits the scoped-vmem stack.
+
+    Calibrated on-chip against the TRANSPOSED kernel (row-vector
+    operands, per-plane transients are (GL, T)/(gh, T) and short-lived):
+    P=7..16 compile at T=4096 and P=24 at T=2048, so the proxy is the
+    resident tile bytes T*(pgl+gh) against an ~11M envelope; T=4096 also
+    measured fastest (one fewer grid level of per-tile overhead)."""
+    for T in (4096, 2048, 1024, 512, 256):
         if n % T:
             continue
-        vmem = 2 * (gh * pgl * 4) + T * 5 * (pgl + gh)
-        if vmem <= _PALLAS_MAX_VMEM:
+        if T * (pgl + gh) <= _PALLAS_MAX_VMEM:
             return T
     return None
 

@@ -49,6 +49,23 @@ _R_MEMO: dict = {}
 _stats_warned = False
 
 
+def _warn_stats_once() -> None:
+    """Per-batch stat metrics hook into the STREAMING path's
+    count_stream; a whole-stage program has no per-batch stream by
+    design. Called only when a stage ACTUALLY compiled (a warning on
+    mere flag co-existence would be a false alarm for plans that never
+    match the whole-stage pattern)."""
+    global _stats_warned
+    if conf.enable_input_batch_statistics and not _stats_warned:
+        _stats_warned = True
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "enable_input_batch_statistics records nothing for "
+            "whole-stage-compiled stages (single dispatch, no batch "
+            "stream); disable the stage compiler to collect stats")
+
+
 def _walk_chain(node: Operator):
     """Longest row-aligned map chain below `node` (filters fold as masks —
     only mask-producing/row-aligned ops may ride a compiled stage).
@@ -152,19 +169,6 @@ def try_run_stage(root: Operator, ctx: ExecContext, deferred: bool = False
     came back clean (a discarded stage never ran to completion)."""
     if not conf.enable_stage_compiler:
         return None
-    if conf.enable_input_batch_statistics:
-        # per-batch stat metrics hook into the STREAMING path's
-        # count_stream; a whole-stage program has no per-batch stream by
-        # design — warn once instead of silently recording nothing
-        global _stats_warned
-        if not _stats_warned:
-            _stats_warned = True
-            import logging
-
-            logging.getLogger(__name__).warning(
-                "enable_input_batch_statistics records nothing for "
-                "whole-stage-compiled stages (single dispatch, no batch "
-                "stream); disable the stage compiler to collect stats")
     m = _match(root)
     if m is None:
         mc = _match_chain(root)
@@ -475,6 +479,8 @@ def try_run_stage(root: Operator, ctx: ExecContext, deferred: bool = False
                 return res if res is not None else _collect_streaming(
                     root2, ctx)
 
+            _warn_stats_once()
+
             def commit_metrics() -> None:
                 # only once the caller saw clean flags — a discarded
                 # stage must not report stage_compiled (and its retry
@@ -495,6 +501,7 @@ def try_run_stage(root: Operator, ctx: ExecContext, deferred: bool = False
         out = None
     if out is None:
         return _fallback(root, batches, source, ctx)
+    _warn_stats_once()
     for op in (final, partial, *chain):
         op.metrics.add("output_batches", 1)
     root.metrics.add("output_rows", nrows)
@@ -554,6 +561,7 @@ def _run_chain_stage(root: Operator, chain: List[MapLikeOp],
 
     fn = jit_cache.get_or_compile(key, make)
     out = fn(*batches)
+    _warn_stats_once()
     for op in chain:
         op.metrics.add("output_batches", 1)
     root.metrics.add("output_rows", int(out.num_rows))
