@@ -71,22 +71,27 @@ _BIAS8 = np.uint64(128 * ((1 << 64) - 1) // 255)    # 8-chunk (i64 path)
 # pallas fused path (TPU only): the XLA formulation materializes the
 # (n, P*GL) digit-carrier and (n, gh) one-hot operands in HBM; the kernel
 # builds both tiles in VMEM and leaves only the (gh, P*GL) s32 result.
-_PALLAS_MAX_VMEM = 11 << 20  # resident-tile-bytes envelope (see _pick_tile)
 _I32_EXACT_ROWS = 1 << 23   # 127 * 2^23 < 2^31: s32 block-exactness bound
 
 
 def _pick_tile(n: int, gh: int, pgl: int):
     """Largest T whose kernel fits the scoped-vmem stack.
 
-    Calibrated on-chip against the TRANSPOSED kernel (row-vector
-    operands, per-plane transients are (GL, T)/(gh, T) and short-lived):
-    P=7..16 compile at T=4096 and P=24 at T=2048, so the proxy is the
-    resident tile bytes T*(pgl+gh) against an ~11M envelope; T=4096 also
-    measured fastest (one fewer grid level of per-tile overhead)."""
-    for T in (4096, 2048, 1024, 512, 256):
+    Calibrated on-chip against the TRANSPOSED kernel. Two resident
+    terms: the s32 accumulator+output (2*gh*pgl*4 — independent of T)
+    and the per-tile operands (~T*(pgl+gh) bytes). Measured envelope:
+    P=7/16 @ T=4096, P=24 @ T=2048, P=29 @ T=1024 all compile; P=29 @
+    T=2048 and P=33 @ any T fail — i.e. accumulator alone must stay
+    <= ~16M and the combined total <= ~20M. T floors at 1024 (the
+    smaller-tile regime is untested-territory that ALSO failed at
+    P=29/T=512); T=4096 measured fastest where it fits."""
+    acc2 = 2 * gh * pgl * 4
+    if acc2 > 16 << 20:
+        return None
+    for T in (4096, 2048, 1024):
         if n % T:
             continue
-        if T * (pgl + gh) <= _PALLAS_MAX_VMEM:
+        if acc2 + T * (pgl + gh) <= 20 << 20:
             return T
     return None
 
@@ -98,7 +103,7 @@ def _use_pallas(n: int, gh: int, pgl: int) -> bool:
         return False
     if jax.default_backend() != "tpu":
         return False
-    if n < 256 or n > _I32_EXACT_ROWS:
+    if n < 1024 or n > _I32_EXACT_ROWS:
         return False
     return _pick_tile(n, gh, pgl) is not None
 
@@ -242,7 +247,7 @@ def _accumulate_planes(keys: Array, valid: Array, words, recipe, gh: int,
                        rng: int) -> Array:
     """Shared dispatch: rows outside [0, rng) or invalid contribute
     nothing (both backends mask them out of the one-hots). Returns
-    (gh, P, GL) f64."""
+    (gh, P, GL) int32 — exact per-batch plane sums."""
     n = keys.shape[0]
     P = len(recipe)
     ok = valid & (keys >= 0) & (keys < rng)
@@ -254,10 +259,10 @@ def _accumulate_planes(keys: Array, valid: Array, words, recipe, gh: int,
         D = _expand_words(words, recipe)
         Dm = jnp.where(ok[:, None], D, jnp.int8(0))
         acc = _xla_accumulate(kc, ok, Dm, gh)
-    return acc.astype(jnp.float64).reshape(gh, P, _GL)
+    return acc.reshape(gh, P, _GL)
 
 
-def _float_words(v: Array, ok: Array):
+def _float_words(v: Array, ok: Array, fixed_s=None):
     """Balanced base-256 digitization of round(v * 2^s), as i32 word
     columns + recipe entries (6 planes).
 
@@ -265,15 +270,31 @@ def _float_words(v: Array, ok: Array):
     asymmetric balanced-6-digit range (-128*(2^48-1)/255 ..
     127*(2^48-1)/255). Returns (words, entries, s, bad) — bad is True
     when any contributing value is non-finite (digits would be garbage;
-    caller must fall back)."""
+    caller must fall back).
+
+    fixed_s: a STATIC scale chosen by the caller (the stage compiler
+    probes a per-stage scale the way it probes key ranges, so every
+    batch shares one scale and the scan carry stays in integer space —
+    no per-batch emulated-f64 multiply-accumulate). bad then also trips
+    when a value overflows the fixed scale's 46-bit headroom, driving
+    the caller's re-probe/fallback loop."""
     finite = jnp.isfinite(v)
     bad = jnp.any(ok & ~finite)
     v = jnp.where(ok & finite, v, 0.0).astype(jnp.float64)
     absv = jnp.abs(v)
-    maxv = jnp.max(absv)
-    exp = jnp.floor(jnp.log2(jnp.maximum(maxv, 1e-300))) + 1.0
-    # clamp so exp2(s) stays finite when the batch max is 0/denormal
-    s = jnp.minimum((CHUNK_BITS * F64_CHUNKS - 2) - exp, 1000.0)
+    if fixed_s is None:
+        maxv = jnp.max(absv)
+        exp = jnp.floor(jnp.log2(jnp.maximum(maxv, 1e-300))) + 1.0
+        # clamp so exp2(s) stays finite when the batch max is 0/denormal
+        s = jnp.minimum((CHUNK_BITS * F64_CHUNKS - 2) - exp, 1000.0)
+    else:
+        s = jnp.asarray(fixed_s, jnp.float64)
+        # overflow must be tested in the FLOAT domain, before the cast:
+        # an out-of-range f64->i64 conversion saturates/wraps (x86
+        # cvttsd2si yields int64_min for BOTH signs), and
+        # |int64_min| is itself negative — a post-cast abs-compare
+        # would stay silent exactly when the data overflowed
+        bad = bad | jnp.any(ok & (absv > jnp.exp2(46.0 - s)))
     scaled = jnp.round(v * jnp.exp2(s)).astype(jnp.int64)
     u = scaled + _BIAS6
     # i32 halves: int64 shifts lower to 2x-i32 emulation on TPU, and the
@@ -332,7 +353,7 @@ def grouped_count(keys: Array, valid: Array, rng: int) -> Array:
     return outs[0]
 
 
-def digitize(valid: Array, specs):
+def digitize(valid: Array, specs, fixed_scales=None):
     """Digitize a batch's aggregate inputs into compact i32 word columns
     plus a static per-plane extraction recipe.
 
@@ -343,9 +364,15 @@ def digitize(valid: Array, specs):
       * recipe — per plane: ("digit", word_idx, shift) | ("raw", wi, 0)
       * layout — per spec: ("sumf"|"sumi"|"count", start_plane)
       * weights — per-plane carry weight: 2^-s for float-sum planes (the
-        batch scale folds into the linear recombination), 1.0 otherwise
-      * bad — True when any contributing float value was non-finite
-        (digits would be garbage; the caller must discard and fall back)
+        batch scale folds into the linear recombination), 1.0 otherwise.
+        With fixed_scales the weights are all exactly 1.0 — callers may
+        then carry raw integer plane sums and defer the 2^-s scaling to
+        finalize (pass the scales there instead).
+      * bad — True when any contributing float value was non-finite or
+        overflowed a fixed scale (the caller must discard and fall back)
+
+    fixed_scales: optional dict {spec_index: static scale} for float
+    sums (see _float_words).
     """
     words = []
     recipe = []
@@ -353,7 +380,7 @@ def digitize(valid: Array, specs):
     weights = []     # per plane
     bad = jnp.array(False)
     one = jnp.asarray(1.0, jnp.float64)
-    for spec in specs:
+    for si, spec in enumerate(specs):
         if spec[0] == "count":
             _, cvalid = spec
             words.append(jnp.where(valid & cvalid, 1, 0).astype(jnp.int32))
@@ -365,9 +392,11 @@ def digitize(valid: Array, specs):
         ok = valid & vvalid
         start = len(recipe)
         if jnp.issubdtype(values.dtype, jnp.floating):
-            ws, entries, s, b = _float_words(values, ok)
+            fs = None if fixed_scales is None else fixed_scales.get(si)
+            ws, entries, s, b = _float_words(values, ok, fixed_s=fs)
             bad = bad | b
-            weights.extend([jnp.exp2(-s)] * len(entries))
+            weights.extend([one if fs is not None else jnp.exp2(-s)]
+                           * len(entries))
             layout.append(("sumf", start))
         else:
             # masked rows digitize as v=0, whose balanced digits are all
@@ -386,12 +415,27 @@ def accumulate(keys: Array, valid: Array, words, recipe,
                rng: int) -> Array:
     """One batch's digit-plane accumulation: (gh, P, GL) f64."""
     gh = (rng + _GL - 1) // _GL
+    return _accumulate_planes(keys, valid, words, recipe, gh,
+                              rng).astype(jnp.float64)
+
+
+def accumulate_raw(keys: Array, valid: Array, words, recipe,
+                   rng: int) -> Array:
+    """One batch's digit-plane accumulation as RAW (gh, P, GL) int32 —
+    for callers carrying integer plane sums across batches (the stage
+    compiler's fixed-scale scan: i64 carry adds are 2x-i32 and exact,
+    vs the emulated-f64 multiply-accumulate a weighted carry needs)."""
+    gh = (rng + _GL - 1) // _GL
     return _accumulate_planes(keys, valid, words, recipe, gh, rng)
 
 
-def finalize(acc: Array, layout, rng: int):
+def finalize(acc: Array, layout, rng: int, scales=None):
     """Recombine a (weighted-summed) plane carrier into per-spec outputs:
     f64 for float sums, int64 for int sums and counts.
+
+    scales: optional dict {spec_index: static scale s} for fixed-scale
+    float sums (digitize(..., fixed_scales=...)): the 2^-s deferred from
+    the per-batch weights is applied here, once per stage.
 
     Int sums recombine in INT64 arithmetic: the f64 carrier holds exact
     per-plane digit sums (< 2^38 even across 64 maximal batches), but an
@@ -401,14 +445,18 @@ def finalize(acc: Array, layout, rng: int):
     sums come out exact modulo 2^64 (Spark long-sum overflow wraps)."""
     gh = acc.shape[0]
     outs = []
-    for kind, start in layout:
+    for si, (kind, start) in enumerate(layout):
         if kind == "count":
             plane = acc[:, start, :].reshape(gh * _GL)[:rng]
             outs.append(jnp.round(plane).astype(jnp.int64))
             continue
         if kind == "sumf":
             nch = F64_CHUNKS
-            flat = _recombine(acc, start, nch).reshape(gh * _GL)[:rng]
+            flat = _recombine(acc.astype(jnp.float64), start, nch
+                              ).reshape(gh * _GL)[:rng]
+            if scales is not None and si in scales:
+                flat = flat * jnp.exp2(-jnp.asarray(scales[si],
+                                                    jnp.float64))
             outs.append(flat)
             continue
         total = jnp.zeros((gh, _GL), jnp.int64)

@@ -46,6 +46,7 @@ _AGG_FNS = ("sum", "count", "avg")
 
 # plan-shape -> last working dense range bucket (see try_run_stage)
 _R_MEMO: dict = {}
+_STATICS_MEMO: dict = {}
 _stats_warned = False
 
 
@@ -197,13 +198,39 @@ def try_run_stage(root: Operator, ctx: ExecContext, deferred: bool = False
 
     nkeys = len(partial.group_exprs)
 
+    # trace-time statics shared by the probe and the main program —
+    # memoized per (plan, shape): eval_shape re-traces the whole chain
+    # per aggregate, which would otherwise run on EVERY stage dispatch
+    # including the fully-cached steady state
+    _input_fns0 = [fns[0] for fns in partial._input_fns]
+    statics_key = ("stage_statics", root.plan_key(), shape0)
+    statics = _STATICS_MEMO.get(statics_key)
+    if statics is None:
+        sum_is_float = []
+        has_validity = []
+        for i, call in enumerate(partial.aggs):
+            shp = jax.eval_shape(
+                lambda bb, i=i: _input_fns0[i](
+                    _apply_steps(_build_steps(chain), bb)[0]), batches[0])
+            has_validity.append(shp.validity is not None)
+            sum_is_float.append(
+                call.fn != "count"
+                and jnp.issubdtype(shp.data.dtype, jnp.floating))
+        statics = (tuple(sum_is_float), tuple(has_validity))
+        _STATICS_MEMO[statics_key] = statics
+    sum_is_float, has_validity = statics
+    float_calls = [i for i, f in enumerate(sum_is_float) if f]
+
     def make_probe():
-        """Pass 1: per-key min/max + null check (cheap, no matmuls). Its
-        own dispatch so the accumulation program can be compiled for the
-        SMALLEST dense range that fits the observed keys (composite keys
-        pack into one index: k = sum_i (k_i - min_i) * stride_i)."""
+        """Pass 1: per-key min/max + null check + per-float-agg abs-max
+        (cheap, no matmuls). Its own dispatch so the accumulation
+        program can be compiled for the SMALLEST dense range that fits
+        the observed keys (composite keys pack into one index:
+        k = sum_i (k_i - min_i) * stride_i) and for a FIXED float scale
+        (so the scan carry stays integer — mxu_agg accumulate_raw)."""
         steps = _build_steps(chain)
         group_fns = list(partial._group_fns)
+        input_fns = _input_fns0
 
         def run(*batches):
             # stacking INSIDE the program: eager jnp.stack per tree leaf
@@ -212,7 +239,7 @@ def try_run_stage(root: Operator, ctx: ExecContext, deferred: bool = False
                 lambda *xs: jnp.stack(xs), *batches)
 
             def min_step(carry, b):
-                kmins, kmaxs, bad = carry
+                kmins, kmaxs, vmaxs, bad = carry
                 b, mask = _apply_steps(steps, b)
                 nmins, nmaxs = [], []
                 for i, gfn in enumerate(group_fns):
@@ -224,14 +251,26 @@ def try_run_stage(root: Operator, ctx: ExecContext, deferred: bool = False
                     khi = jnp.where(ok, k, jnp.int64(-2 ** 62))
                     nmins.append(jnp.minimum(kmins[i], jnp.min(klo)))
                     nmaxs.append(jnp.maximum(kmaxs[i], jnp.max(khi)))
-                return (nmins, nmaxs, bad), None
+                nvmaxs = []
+                for j, ci in enumerate(float_calls):
+                    vcol = input_fns[ci](b)
+                    v = vcol.data.astype(jnp.float64)
+                    ok = mask & vcol.valid_mask() & jnp.isfinite(v)
+                    av = jnp.max(jnp.where(ok, jnp.abs(v), 0.0))
+                    nvmaxs.append(jnp.maximum(vmaxs[j], av))
+                return (nmins, nmaxs, nvmaxs, bad), None
 
             init = ([jnp.int64(2 ** 62)] * nkeys,
-                    [jnp.int64(-2 ** 62)] * nkeys, jnp.array(False))
-            (kmins, kmaxs, bad), _ = jax.lax.scan(min_step, init, stacked)
+                    [jnp.int64(-2 ** 62)] * nkeys,
+                    [jnp.float64(0.0)] * len(float_calls),
+                    jnp.array(False))
+            (kmins, kmaxs, vmaxs, bad), _ = jax.lax.scan(
+                min_step, init, stacked)
             kmins = [jnp.where(m == 2 ** 62, 0, m) for m in kmins]
             kmaxs = [jnp.where(m == -2 ** 62, 0, m) for m in kmaxs]
-            return jnp.stack(kmins), jnp.stack(kmaxs), bad
+            vm = (jnp.stack(vmaxs) if float_calls
+                  else jnp.zeros((1,), jnp.float64))
+            return jnp.stack(kmins), jnp.stack(kmaxs), vm, bad
 
         return run
 
@@ -243,12 +282,23 @@ def try_run_stage(root: Operator, ctx: ExecContext, deferred: bool = False
     memo_key = ("stage_R", root.plan_key(), shape0)
 
     def probe_spans():
+        import math
+
         probe = jit_cache.get_or_compile(
             ("stage_probe", root.plan_key(), shape0, len(batches)),
             make_probe)
-        kmins_v, kmaxs_v, bad_v = probe(*batches)
+        kmins_v, kmaxs_v, vmaxs_v, bad_v = probe(*batches)
         if bool(bad_v):
             return None  # null grouping keys: dense slots can't hold them
+        # fixed float scales: 44-bit headroom over the probed max (2
+        # spare bits, so values drifting up to 4x on later data still
+        # digitize; beyond that the in-program overflow flag re-probes)
+        scales = []
+        for j, ci in enumerate(float_calls):
+            vmax = float(np.asarray(vmaxs_v)[j])
+            exp = (math.floor(math.log2(vmax)) + 1.0
+                   if vmax > 0.0 else -996.0)
+            scales.append((ci, min(44.0 - exp, 1000.0)))
         spans, kmins = [], []
         for lo, hi in zip(np.asarray(kmins_v), np.asarray(kmaxs_v)):
             # power-of-two headroom per key: exact spans would invalidate
@@ -272,7 +322,7 @@ def try_run_stage(root: Operator, ctx: ExecContext, deferred: bool = False
             total <<= 1
         if total > max_R:
             return None
-        return tuple(spans), tuple(kmins)
+        return tuple(spans), tuple(kmins), tuple(scales)
 
     def make():
         # filters fold into a row mask instead of compacting (see _match)
@@ -285,23 +335,10 @@ def try_run_stage(root: Operator, ctx: ExecContext, deferred: bool = False
         def apply_chain(b: ColumnBatch):
             return _apply_steps(steps, b)
 
-        def apply_chain_probe(bb):
-            return apply_chain(bb)[0]
-
-        sum_is_float = []
-        has_validity = []
-        for i, call in enumerate(calls):
-            shp = jax.eval_shape(
-                lambda bb, i=i: input_fns[i](apply_chain_probe(bb)),
-                batches[0])
-            has_validity.append(shp.validity is not None)
-            sum_is_float.append(
-                call.fn != "count"
-                and jnp.issubdtype(shp.data.dtype, jnp.floating))
-
         # plane count of the scan's digit-space carrier (must be static
         # before the scan): presence + per-call validity-count planes +
-        # per-call sum digit planes
+        # per-call sum digit planes. sum_is_float/has_validity are the
+        # hoisted statics computed next to the probe.
         n_planes = 1
         for i, call in enumerate(calls):
             if has_validity[i]:
@@ -309,6 +346,19 @@ def try_run_stage(root: Operator, ctx: ExecContext, deferred: bool = False
             if call.fn != "count":
                 n_planes += (mxu_agg.F64_CHUNKS if sum_is_float[i]
                              else mxu_agg.I64_CHUNKS)
+
+        # map the probed per-CALL fixed scales onto SPEC indices (the
+        # spec list below is: presence, then per call [count?][sum?])
+        call_scale = dict(scales)
+        spec_fixed_scales = {}
+        spec_idx = 1
+        for i, call in enumerate(calls):
+            if has_validity[i]:
+                spec_idx += 1
+            if call.fn != "count":
+                if sum_is_float[i] and i in call_scale:
+                    spec_fixed_scales[spec_idx] = call_scale[i]
+                spec_idx += 1
 
         # kmins are STATIC ints from the memoized probe: no in-program min
         # pass. int32 twins for the packed-index arithmetic (wrapping is
@@ -324,9 +374,15 @@ def try_run_stage(root: Operator, ctx: ExecContext, deferred: bool = False
             # The carry stays in digit-plane space — recombination and
             # per-aggregate updates run once per STAGE, not per batch
             # (mxu_agg module docstring, streaming use).
+            # INTEGER carry: with the probed fixed float scales every
+            # plane's weight is 1, so the per-batch update is an exact
+            # i64 add (2x-i32) instead of an emulated-f64 FMA over the
+            # whole carrier (~2-3 ms/batch measured at 2M rows); the
+            # single f64 recombination happens in finalize. Plane sums
+            # stay < 2^38 across any scan length the driver uses.
             gh = (R + mxu_agg._GL - 1) // mxu_agg._GL
             init = {
-                "acc": jnp.zeros((gh, n_planes, mxu_agg._GL), jnp.float64),
+                "acc": jnp.zeros((gh, n_planes, mxu_agg._GL), jnp.int64),
                 "oob": jnp.array(False),
             }
             # digitize()'s spec layout and the per-call slot map are
@@ -387,22 +443,26 @@ def try_run_stage(root: Operator, ctx: ExecContext, deferred: bool = False
                         si = len(specs) - 1
                     slots.append((si, ci))
                 words, recipe, layout, weights, bad_vals = \
-                    mxu_agg.digitize(inb, specs)
-                # non-finite float inputs can't ride digit planes — treat
-                # like out-of-range keys: flag and let the caller fall
-                # back to the streaming path
+                    mxu_agg.digitize(inb, specs,
+                                     fixed_scales=spec_fixed_scales)
+                # non-finite float inputs (or fixed-scale overflow when
+                # data drifted past the probed magnitude) can't ride
+                # digit planes — treat like out-of-range keys: flag and
+                # let the caller re-probe / fall back
                 carry["oob"] = carry["oob"] | bad_vals
-                acc_b = mxu_agg.accumulate(k, inb, words, recipe, R)
-                carry["acc"] = carry["acc"] + acc_b * weights[None, :, None]
+                acc_b = mxu_agg.accumulate_raw(k, inb, words, recipe, R)
+                carry["acc"] = carry["acc"] + acc_b.astype(jnp.int64)
                 trace_info["layout"] = layout
                 trace_info["slots"] = slots
                 return carry, None
 
             carry, _ = jax.lax.scan(step, init, stacked)
 
-            # recombine ONCE per stage, then assemble output rows
-            # (dense slots -> compacted groups)
-            outs = mxu_agg.finalize(carry["acc"], trace_info["layout"], R)
+            # recombine ONCE per stage (2^-s applied here, not per
+            # batch), then assemble output rows (dense slots ->
+            # compacted groups)
+            outs = mxu_agg.finalize(carry["acc"], trace_info["layout"], R,
+                                    scales=spec_fixed_scales)
             pres = outs[0]
             slots = trace_info["slots"]
             cap = bucket_capacity(R)
@@ -452,7 +512,7 @@ def try_run_stage(root: Operator, ctx: ExecContext, deferred: bool = False
             if memo is None:  # null keys or range beyond max_R
                 return _fallback(root, batches, source, ctx)
             _R_MEMO[memo_key] = memo
-        spans, kmins = memo
+        spans, kmins, scales = memo
         R = 1
         for sp in spans:
             R *= sp
@@ -462,7 +522,8 @@ def try_run_stage(root: Operator, ctx: ExecContext, deferred: bool = False
             strides.append(acc)
             acc *= sp
         strides = list(reversed(strides))
-        key = ("stage", root.plan_key(), shape0, len(batches), spans, kmins)
+        key = ("stage", root.plan_key(), shape0, len(batches),
+               spans, kmins, scales)
         fn = jit_cache.get_or_compile(key, make)
         out, flags = fn(*batches)
         if deferred:

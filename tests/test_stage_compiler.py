@@ -294,3 +294,30 @@ def test_nonfinite_values_fall_back(rng):
         if k in (2, 5):
             continue
         np.testing.assert_allclose(sv[k], want.loc[k], rtol=1e-9)
+
+
+def test_fixed_scale_drift_reprobes(rng):
+    """The probed per-stage float scale is memoized like key ranges; a
+    later dataset with 1000x larger values must trip the in-program
+    overflow flag (checked in the FLOAT domain — an int64-cast overflow
+    saturates and would silently corrupt) and re-probe, not return
+    garbage sums."""
+    def plan_for(scale):
+        batches = []
+        for _ in range(3):
+            data = {"k": rng.integers(0, 50, 600).astype(np.int64),
+                    "v": (rng.random(600) * 10 - 3) * scale,
+                    "n": rng.integers(-50, 50, 600).astype(np.int32)}
+            batches.append(ColumnBatch.from_numpy(data, SCHEMA,
+                                                  capacity=1024))
+        return batches
+
+    small = plan_for(1.0)
+    p1 = _plan(small, with_filter=False)
+    _check(collect(p1), small, with_filter=False)
+    assert p1.metrics["stage_compiled"] == 1
+
+    big = plan_for(1000.0)   # beyond the 4x drift headroom
+    p2 = _plan(big, with_filter=False)
+    out = collect(p2)        # same plan/shape key -> memoized scale
+    _check(out, big, with_filter=False)
