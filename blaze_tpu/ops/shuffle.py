@@ -167,7 +167,9 @@ class ShuffleWriterExec(Operator):
                self.plan_key())
         row_offset = 0
         try:
-            for batch in self.children[0].execute(ctx):
+            from blaze_tpu.runtime.executor import execute_stage_or_plan
+
+            for batch in execute_stage_or_plan(self.children[0], ctx):
                 ctx.check_running()
                 if int(batch.num_rows) == 0:
                     continue

@@ -163,9 +163,12 @@ def run_mesh_shuffle_stage(stage_plan: pb.PlanNode, stage_id: int,
         file_outputs.append((data, index))
 
     # map side: stream every task's batches straight into the exchange
+    # (whole-stage single-dispatch where the subtree matches)
+    from blaze_tpu.runtime.executor import execute_stage_or_plan
+
     for task in range(ntasks):
         op = decode_plan(writer.input)  # fresh operator state per task
-        for batch in execute_plan(
+        for batch in execute_stage_or_plan(
                 op, ExecContext(partition=task, num_partitions=ntasks)):
             if int(batch.num_rows) == 0:
                 continue

@@ -72,6 +72,25 @@ def execute_plan(root: Operator, ctx: Optional[ExecContext] = None) -> BatchStre
     return root.execute(ctx)
 
 
+def execute_stage_or_plan(root: Operator,
+                          ctx: Optional[ExecContext] = None) -> BatchStream:
+    """Whole-stage single-dispatch attempt first, streaming otherwise.
+
+    Used by stage DRIVERS (shuffle writers, the mesh exchange) whose
+    subtree is a complete stage: a matching scan→filter→project→partial
+    agg pipeline runs as ONE jit program (stage_compiler), so a shuffle
+    map task costs one dispatch instead of one-per-batch. Agg-less
+    chains stay streaming (chain_ok=False): one whole-stage batch would
+    defeat the drivers' bounded staging/spill."""
+    ctx = ctx or ExecContext()
+    from blaze_tpu.runtime.stage_compiler import try_run_stage
+
+    staged = try_run_stage(root, ctx, chain_ok=False)
+    if staged is not None:
+        return iter([staged])
+    return root.execute(ctx)
+
+
 def collect(root: Operator, ctx: Optional[ExecContext] = None) -> ColumnBatch:
     """Materialize all output into one batch (test/driver helper)."""
     ctx = ctx or ExecContext()

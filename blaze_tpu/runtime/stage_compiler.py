@@ -36,7 +36,9 @@ from blaze_tpu.columnar.types import TypeKind
 from blaze_tpu.config import conf
 from blaze_tpu.exprs import ir
 from blaze_tpu.ops import mxu_agg
-from blaze_tpu.ops.agg import AggExec, AggMode, result_field
+from blaze_tpu.ops.agg import (
+    AggExec, AggMode, result_field, state_fields,
+)
 from blaze_tpu.ops.base import ExecContext, MapLikeOp, Operator
 from blaze_tpu.runtime import jit_cache
 
@@ -133,9 +135,11 @@ def _match(root: Operator):
     if not (isinstance(node, AggExec) and node.mode == AggMode.PARTIAL):
         return None
     partial = node
-    if final is None:
-        return None  # partial-only stages (shuffle map side) not wired yet
-    if (len(final.group_exprs) != len(partial.group_exprs)
+    # final=None is the shuffle-map-side shape: the stage emits the
+    # partial's typed STATE columns (sum/nonempty, sum/count, count)
+    # instead of finalized values
+    if final is not None and (
+            len(final.group_exprs) != len(partial.group_exprs)
             or [c.fn for c in final.aggs] != [c.fn for c in partial.aggs]):
         return None
     if not (1 <= len(partial.group_exprs) <= 4):
@@ -154,8 +158,8 @@ def _match(root: Operator):
     return final, partial, chain, n
 
 
-def try_run_stage(root: Operator, ctx: ExecContext, deferred: bool = False
-                  ) -> Optional[ColumnBatch]:
+def try_run_stage(root: Operator, ctx: ExecContext, deferred: bool = False,
+                  chain_ok: bool = True) -> Optional[ColumnBatch]:
     """Run the stage in one dispatch, or None if the pattern/shape/range
     doesn't apply (caller then uses the streaming executor).
 
@@ -172,6 +176,14 @@ def try_run_stage(root: Operator, ctx: ExecContext, deferred: bool = False
         return None
     m = _match(root)
     if m is None:
+        # chain_ok=False (the shuffle drivers): an agg-less chain stage
+        # flatten-compacts the WHOLE stage into one batch — fine for a
+        # collect (the result materializes anyway), but it would defeat
+        # the writers' per-batch bounded staging/spill and the mesh
+        # exchange's one-batch quota. Agg stages are safe either way
+        # (output is bounded by the group count).
+        if not chain_ok:
+            return None
         mc = _match_chain(root)
         if mc is None:
             return None
@@ -479,22 +491,44 @@ def try_run_stage(root: Operator, ctx: ExecContext, deferred: bool = False
                 si, ci = slots[i]
                 cnt = pres if ci is None else outs[ci]
                 if call.fn == "count":
+                    # count's state IS its result (state_fields: [count])
                     cols.append(Column(T.INT64, _pad(cnt, cap), None))
-                elif call.fn == "avg":
-                    ok = cnt > 0
-                    v = outs[si].astype(jnp.float64) / \
-                        jnp.maximum(cnt, 1).astype(jnp.float64)
-                    cols.append(Column(T.FLOAT64,
-                                       _pad(jnp.where(ok, v, 0.0), cap),
-                                       _pad(ok, cap)))
-                else:  # sum
-                    ok = cnt > 0
+                    continue
+                if out_mode_final:
+                    if call.fn == "avg":
+                        ok = cnt > 0
+                        v = outs[si].astype(jnp.float64) / \
+                            jnp.maximum(cnt, 1).astype(jnp.float64)
+                        cols.append(Column(T.FLOAT64,
+                                           _pad(jnp.where(ok, v, 0.0),
+                                                cap),
+                                           _pad(ok, cap)))
+                    else:  # sum
+                        ok = cnt > 0
+                        cols.append(Column(
+                            result_field(call).dtype,
+                            _pad(outs[si], cap), _pad(ok, cap)))
+                    continue
+                # partial (shuffle map side): typed STATE columns in the
+                # agg-buf layout the FINAL merge consumes by position
+                # (state_fields: sum -> [sum, nonempty]; avg -> [sum,
+                # count])
+                sfields = state_fields(call, i)
+                if call.fn == "avg":
+                    sd = sfields[0].dtype
                     cols.append(Column(
-                        result_field(call).dtype,
-                        _pad(outs[si], cap), _pad(ok, cap)))
+                        sd, _pad(outs[si].astype(sd.jnp_dtype()), cap),
+                        None))
+                    cols.append(Column(T.INT64, _pad(cnt, cap), None))
+                else:  # sum
+                    sd = sfields[0].dtype
+                    cols.append(Column(
+                        sd, _pad(outs[si].astype(sd.jnp_dtype()), cap),
+                        None))
+                    cols.append(Column(T.BOOLEAN, _pad(cnt > 0, cap),
+                                       None))
             out = ColumnBatch(schema, cols, jnp.asarray(R, jnp.int32), cap)
             out = out.compact(_pad(present, cap))
-            assert out_mode_final  # partial-only rejected in _match
             # oob + num_rows in ONE tiny array: each host pull is a
             # ~90ms round-trip on a remote-attached chip
             flags = jnp.stack([carry["oob"].astype(jnp.int32),
@@ -535,7 +569,7 @@ def try_run_stage(root: Operator, ctx: ExecContext, deferred: bool = False
 
                 _R_MEMO.pop(memo_key, None)
                 src = MemorySourceExec(list(batches), source.schema)
-                root2 = _rebuild(root, src)
+                root2 = _rebuild(root, source, src)
                 res = try_run_stage(root2, ctx)
                 return res if res is not None else _collect_streaming(
                     root2, ctx)
@@ -546,7 +580,7 @@ def try_run_stage(root: Operator, ctx: ExecContext, deferred: bool = False
                 # only once the caller saw clean flags — a discarded
                 # stage must not report stage_compiled (and its retry
                 # shares these MetricNode objects via _rebuild's copy)
-                for op in (final, partial, *chain):
+                for op in filter(None, (final, partial, *chain)):
                     op.metrics.add("output_batches", 1)
                 root.metrics.add("stage_compiled", 1)
 
@@ -563,7 +597,7 @@ def try_run_stage(root: Operator, ctx: ExecContext, deferred: bool = False
     if out is None:
         return _fallback(root, batches, source, ctx)
     _warn_stats_once()
-    for op in (final, partial, *chain):
+    for op in filter(None, (final, partial, *chain)):
         op.metrics.add("output_batches", 1)
     root.metrics.add("output_rows", nrows)
     root.metrics.add("stage_compiled", 1)
@@ -634,15 +668,23 @@ def _fallback(root, batches, source, ctx) -> ColumnBatch:
     from blaze_tpu.ops.basic import MemorySourceExec
 
     src = MemorySourceExec(batches, source.schema)
-    return _collect_streaming(_rebuild(root, src), ctx)
+    return _collect_streaming(_rebuild(root, source, src), ctx)
 
 
-def _rebuild(root: Operator, new_source: Operator) -> Operator:
-    """Clone the operator chain onto a replayable source (oob fallback)."""
+def _rebuild(root: Operator, source: Operator,
+             new_source: Operator) -> Operator:
+    """Clone the operator chain with THE stage-source node (identity
+    match) swapped for a replayable source (oob fallback).
+
+    Replacing every LEAF instead corrupts any stage whose source subtree
+    has several leaves: an agg over a broadcast join would get its scan
+    AND both broadcast readers replaced by the captured JOIN OUTPUT and
+    re-join garbage (silently wrong counts — caught by the q5 validator
+    cell when partial-only stages started exercising this path)."""
     import copy
 
     def clone(op: Operator) -> Operator:
-        if not op.children:
+        if op is source:
             return new_source
         c = copy.copy(op)
         c.children = [clone(ch) for ch in op.children]

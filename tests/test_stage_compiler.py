@@ -321,3 +321,87 @@ def test_fixed_scale_drift_reprobes(rng):
     p2 = _plan(big, with_filter=False)
     out = collect(p2)        # same plan/shape key -> memoized scale
     _check(out, big, with_filter=False)
+
+
+def test_partial_only_stage_state_columns(rng, tmp_path):
+    """Shuffle-map-side shape: a PARTIAL-only agg stage whole-stage
+    compiles and emits the typed agg-buf STATE columns the FINAL merge
+    consumes — end-to-end through a shuffle writer + reader + final
+    agg, vs pandas."""
+    from blaze_tpu.ops.base import ExecContext
+    from blaze_tpu.ops.shuffle import (
+        Partitioning, ShuffleWriterExec, read_shuffle_partition,
+    )
+
+    batches = _batches(rng, 3, 600)
+    node = MemorySourceExec(batches, SCHEMA)
+    node = FilterExec(node, [ir.Binary(BinOp.GE, col("v"),
+                                       ir.Literal(T.FLOAT64, -1.0))])
+    partial = AggExec(node, [col("k")], ["k"], CALLS, AggMode.PARTIAL)
+    data = str(tmp_path / "s.data")
+    index = str(tmp_path / "s.index")
+    w = ShuffleWriterExec(partial, Partitioning("hash", 2, [col("k")]),
+                          data, index)
+    list(w.execute(ExecContext()))
+    assert partial.metrics["stage_compiled"] == 1, \
+        "partial-only stage must whole-stage compile"
+
+    parts = []
+    for p in range(2):
+        parts.extend(read_shuffle_partition(data, index, p,
+                                            partial.schema))
+    merged = MemorySourceExec(parts, partial.schema)
+    final = AggExec(merged, [col("#0")], ["k"], CALLS, AggMode.FINAL)
+    out = collect(final)
+    _check(out, batches)
+
+
+def test_fallback_with_join_source(rng):
+    """Regression (q5 validator cell): when the stage source is a JOIN
+    subtree and the captured batches force the fallback (mixed shapes),
+    the rebuild must swap exactly the SOURCE node — replacing every leaf
+    re-joined the captured join output against itself and produced
+    silently wrong counts."""
+    from blaze_tpu.ops.join import JoinKey, JoinType, SortMergeJoinExec
+
+    LS = T.Schema([T.Field("cat", T.INT32), T.Field("price", T.FLOAT64),
+                   T.Field("dk", T.INT64)])
+    RS = T.Schema([T.Field("rk", T.INT64)])
+    # two left batches with DIFFERENT capacities -> join outputs with
+    # different shape keys -> the stage compiler must fall back
+    lbs = []
+    for n, cap in ((700, 1024), (200, 256)):
+        lbs.append(ColumnBatch.from_numpy({
+            "cat": rng.integers(1, 8, n).astype(np.int32),
+            "price": rng.random(n) * 100,
+            "dk": rng.integers(0, 50, n).astype(np.int64)}, LS,
+            capacity=cap))
+    rb = ColumnBatch.from_numpy(
+        {"rk": np.arange(0, 40, dtype=np.int64)}, RS)
+    join = SortMergeJoinExec(MemorySourceExec(lbs, LS),
+                             MemorySourceExec([rb], RS),
+                             [JoinKey(2, 0)], JoinType.LEFT_SEMI)
+    calls = [AggCall("sum", (col("price"),), T.FLOAT64, "rev"),
+             AggCall("count", (col("price"),), T.INT64, "n")]
+    for mode in (AggMode.PARTIAL, AggMode.FINAL):
+        node = AggExec(join if mode == AggMode.PARTIAL else node,
+                       [col("cat") if mode == AggMode.PARTIAL
+                        else col("#0")], ["cat"], calls, mode)
+    out = collect(node)
+    d = out.to_numpy()
+    # pandas oracle
+    frames = []
+    for b in lbs:
+        bd = b.to_numpy()
+        frames.append(pd.DataFrame({k: np.asarray(v) for k, v in
+                                    bd.items()}))
+    df = pd.concat(frames)
+    df = df[df.dk < 40]
+    want = df.groupby("cat").agg(rev=("price", "sum"),
+                                 n=("price", "count"))
+    ks = list(np.asarray(d["cat"]))
+    assert ks == sorted(want.index)
+    np.testing.assert_array_equal([int(x) for x in d["n"]],
+                                  want["n"].loc[ks])
+    np.testing.assert_allclose([float(x) for x in d["rev"]],
+                               want["rev"].loc[ks], rtol=1e-9)
