@@ -1,8 +1,9 @@
 # The commit gate. Run `make check` before EVERY snapshot commit —
 # round 3 shipped with 38/252 tests red because this didn't exist.
 # Mirrors the reference's CI gate (.github/workflows/tpcds.yml): the
-# full suite plus the query-level validator matrix, both on the
-# virtual 8-device CPU mesh.
+# full suite on the virtual 8-device CPU mesh, plus the query-level
+# validator matrix (which runs on the real chip when one is attached —
+# the axon hook overrides JAX_PLATFORMS for plain scripts).
 
 PYENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
