@@ -307,6 +307,12 @@ class ColumnBatch:
         """Pull live rows to host. Strings -> list[bytes|None]; lists ->
         list[list|None]; numerics -> numpy masked to live rows with None
         for nulls (object arrays)."""
+        # the ordered-collect path (local_runner) materializes on host
+        # and caches the pylike dict so the driver does not pull the
+        # same rows through the (slow) device->host link twice
+        cached = getattr(self, "_host_numpy", None)
+        if cached is not None:
+            return cached
         n = int(self.num_rows)
         out: Dict[str, object] = {}
         for f, c in zip(self.schema, self.columns):

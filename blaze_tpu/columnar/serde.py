@@ -193,6 +193,79 @@ def read_batches(fp: BinaryIO, schema: Schema) -> Iterator[ColumnBatch]:
         yield b
 
 
+def read_batch_host(fp: BinaryIO, schema: Schema) -> Optional[HostBatch]:
+    """Decode one frame to host numpy columns (no device upload) — the
+    spill-merge and host-coalescing paths (ops/host_sort.py) stay entirely
+    on the host until one bulk upload."""
+    head = fp.read(12)
+    if not head:
+        return None
+    if len(head) != 12 or head[:4] != MAGIC:
+        raise ValueError("bad batch frame header")
+    raw_len, comp_len = struct.unpack("<II", head[4:])
+    comp = _read_exact(fp, comp_len)
+    raw = zstandard.ZstdDecompressor().decompress(comp,
+                                                  max_output_size=raw_len)
+    bio = io.BytesIO(raw)
+    n, ncols = struct.unpack("<IH", _read_exact(bio, 6))
+    assert ncols == len(schema.fields), (ncols, len(schema.fields))
+    cols = [_decode_col_host(bio, f.dtype, n) for f in schema]
+    return HostBatch(schema, cols, n)
+
+
+def deserialize_batch_host(buf: bytes, schema: Schema) -> HostBatch:
+    hb = read_batch_host(io.BytesIO(buf), schema)
+    if hb is None:
+        raise ValueError("empty batch frame")
+    return hb
+
+
+def read_batches_host(fp: BinaryIO, schema: Schema) -> Iterator[HostBatch]:
+    while True:
+        hb = read_batch_host(fp, schema)
+        if hb is None:
+            return
+        yield hb
+
+
+def _decode_col_host(fp: BinaryIO, dtype, n: int) -> _HostCol:
+    from blaze_tpu.columnar.types import wide_decimal_storage
+
+    (hasv,) = struct.unpack("<B", _read_exact(fp, 1))
+    validity = None
+    if hasv:
+        vb = _read_exact(fp, (n + 7) // 8)
+        validity = np.unpackbits(np.frombuffer(vb, np.uint8), count=n,
+                                 bitorder="little").astype(bool)
+    if dtype.kind == TypeKind.NULL:
+        return _HostCol("null", None, None,
+                        validity if validity is not None
+                        else np.zeros((n,), bool))
+    if dtype.kind in (TypeKind.LIST, TypeKind.MAP):
+        raise ValueError("host decode does not support list storage")
+    if dtype.kind == TypeKind.STRUCT or dtype.wide_decimal:
+        fields = (wide_decimal_storage(dtype).fields
+                  if dtype.wide_decimal else dtype.fields)
+        children = [_decode_col_host(fp, f.dtype, n) for f in fields]
+        return _HostCol("struct", None, None, validity, children=children)
+    if dtype.is_string_like:
+        (total,) = struct.unpack("<I", _read_exact(fp, 4))
+        lens = np.frombuffer(_read_exact(fp, 4 * n), np.uint32)
+        payload = np.frombuffer(_read_exact(fp, total), np.uint8)
+        w = max(int(lens.max()) if n else 1, 1)
+        mat = np.zeros((n, w), np.uint8)
+        if n:
+            pos = np.arange(w)[None, :] < lens[:, None]
+            mat[pos] = payload
+        return _HostCol("str", mat, lens.astype(np.int32), validity)
+    if dtype.kind == TypeKind.BOOLEAN:
+        raw = np.frombuffer(_read_exact(fp, n), np.uint8).astype(bool)
+        return _HostCol("num", raw, None, validity)
+    npdt = np.dtype(dtype.np_dtype())
+    raw = np.frombuffer(_read_exact(fp, npdt.itemsize * n), npdt)
+    return _HostCol("num", raw.astype(npdt), None, validity)
+
+
 def _decode_col(fp: BinaryIO, dtype, n: int, cap: int):
     import jax.numpy as jnp
 

@@ -51,6 +51,15 @@ class BlazeConf:
     # per pooled frame, so bigger frames amortize the fixed per-dispatch
     # overhead (~90ms each on the remote-attached chip)
     spill_frame_rows: int = 1 << 16
+    # adaptive macro-batching: batch sources (scan, shuffle/broadcast
+    # readers) size batches toward this many bytes, clamped by the
+    # memory budget (ops/common.adaptive_batch_rows). On a
+    # remote-attached chip every per-batch dispatch/pull carries a fixed
+    # ~90ms round trip, so fewer, larger batches are strictly better
+    # until HBM pressure; under a small spill budget the clamp restores
+    # small bounded batches.
+    target_batch_bytes: int = 128 << 20
+    max_batch_rows: int = 1 << 21
     # AQE dynamic join selection: a planned SMJ whose shuffled input came
     # in under this many bytes becomes a broadcast join (Spark's
     # autoBroadcastJoinThreshold analog; 0 disables)

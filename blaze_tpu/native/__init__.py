@@ -66,6 +66,13 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.bn_call.argtypes = [ctypes.c_char_p, ctypes.c_int64,
                             ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
                             ctypes.POINTER(ctypes.c_int64)]
+    lib.bn_call_arrow.restype = ctypes.c_int
+    lib.bn_call_arrow.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                  ctypes.c_void_p]
+    lib.bn_arrow_stream_from_payload.restype = ctypes.c_int
+    lib.bn_arrow_stream_from_payload.argtypes = [ctypes.c_char_p,
+                                                 ctypes.c_int64,
+                                                 ctypes.c_void_p]
     lib.bn_init.restype = ctypes.c_int
     lib.bn_init.argtypes = [ctypes.c_int64]
     lib.bn_last_error.restype = ctypes.c_char_p
@@ -180,6 +187,46 @@ def serialize_host_batch(host_batch, lo: int, hi: int,
     if n < 0:
         raise RuntimeError(f"bn_serialize failed: {n}")
     return out.raw[:n]
+
+
+class _ArrowArrayStream(ctypes.Structure):
+    """Arrow C stream interface struct (stable ABI): 4 fn pointers +
+    private_data."""
+    _fields_ = [("get_schema", ctypes.c_void_p),
+                ("get_next", ctypes.c_void_p),
+                ("get_last_error", ctypes.c_void_p),
+                ("release", ctypes.c_void_p),
+                ("private_data", ctypes.c_void_p)]
+
+
+def call_arrow(task_def: bytes):
+    """bn_call_arrow: run a TaskDefinition, import the result as a
+    pyarrow.RecordBatchReader through the standard Arrow C stream —
+    proving the boundary any Arrow host (JVM arrow-c-data, arrow-rs)
+    consumes (ref blaze/src/rt.rs:76-80)."""
+    import pyarrow as pa
+
+    lib = _load()
+    stream = _ArrowArrayStream()
+    rc = lib.bn_call_arrow(task_def, len(task_def), ctypes.byref(stream))
+    if rc != 0:
+        raise RuntimeError(
+            f"bn_call_arrow failed ({rc}): {lib.bn_last_error().decode()}")
+    return pa.RecordBatchReader._import_from_c(ctypes.addressof(stream))
+
+
+def arrow_stream_from_payload(payload: bytes):
+    """Import a BTAS payload (schema header + BTB1 frames) as a pyarrow
+    RecordBatchReader via bn_arrow_stream_from_payload."""
+    import pyarrow as pa
+
+    lib = _load()
+    stream = _ArrowArrayStream()
+    rc = lib.bn_arrow_stream_from_payload(payload, len(payload),
+                                          ctypes.byref(stream))
+    if rc != 0:
+        raise RuntimeError("bn_arrow_stream_from_payload failed")
+    return pa.RecordBatchReader._import_from_c(ctypes.addressof(stream))
 
 
 def call_native(task_def: bytes) -> bytes:

@@ -15,8 +15,55 @@ import numpy as np
 from blaze_tpu.columnar.batch import (
     Column, ColumnBatch, StringData, bucket_capacity,
 )
-from blaze_tpu.columnar.types import Schema
+from blaze_tpu.columnar.types import Schema, TypeKind
 from blaze_tpu.exprs import strings as S
+
+
+def schema_row_bytes(schema: Schema) -> int:
+    """Rough per-row device bytes (validity + typical string width)."""
+    total = 0
+    for f in schema.fields:
+        total += _field_row_bytes(f.dtype) + 1
+    return max(total, 1)
+
+
+def _field_row_bytes(dtype) -> int:
+    k = dtype.kind
+    if k in (TypeKind.STRING, TypeKind.BINARY):
+        return 36  # 32-byte width bucket guess + lengths
+    if k in (TypeKind.LIST, TypeKind.MAP):
+        return 64
+    if dtype.wide_decimal:
+        return 16  # two int64 limb planes
+    if k == TypeKind.STRUCT:
+        return sum(_field_row_bytes(f.dtype) + 1 for f in dtype.fields)
+    try:
+        import numpy as np
+
+        return np.dtype(dtype.np_dtype()).itemsize
+    except Exception:  # noqa: BLE001
+        return 8
+
+
+def adaptive_target_bytes(manager=None) -> int:
+    """Macro-batch byte target: conf.target_batch_bytes clamped so one
+    batch stays well inside the (HBM-modeling) memory budget — a forced
+    small budget (spill tests) gets small bounded batches back."""
+    from blaze_tpu.config import conf
+    from blaze_tpu.runtime import memory as M
+
+    mgr = manager or M.get_manager()
+    return max(min(conf.target_batch_bytes, mgr.total // 8), 1 << 18)
+
+
+def adaptive_batch_rows(schema: Schema, manager=None) -> int:
+    """Source batch row target for macro-batching (power of two so jit
+    shape buckets stay few)."""
+    from blaze_tpu.config import conf
+
+    rows = adaptive_target_bytes(manager) // schema_row_bytes(schema)
+    rows = max(conf.batch_size, min(int(rows), conf.max_batch_rows))
+    return 1 << (max(int(rows), 1).bit_length() - 1)
 
 
 def concat_batches(batches: List[ColumnBatch], schema: Optional[Schema] = None,
@@ -40,13 +87,47 @@ def concat_batches(batches: List[ColumnBatch], schema: Optional[Schema] = None,
         idx_np[pos : pos + n] = np.arange(n) + offset
         pos += n
         offset += b.capacity
-    idx = jnp.asarray(idx_np)
 
-    out_cols = []
-    for ci, field in enumerate(schema):
-        parts = [b.columns[ci] for b in batches]
-        out_cols.append(_concat_one(parts, idx, field, cap))
-    return ColumnBatch(schema, out_cols, jnp.asarray(total, jnp.int32), cap)
+    idx = jnp.asarray(idx_np)
+    # one jitted program per (schema, input shapes, cap): the eager
+    # formulation paid one ~250ms gather dispatch per column per call on
+    # a remote-attached chip. List storage concatenates eagerly — its
+    # element recursion reads child counts, which have no host value
+    # inside a trace.
+    if any(_has_list(f.dtype) for f in schema.fields):
+        out_cols = []
+        for ci, field in enumerate(schema):
+            parts = [b.columns[ci] for b in batches]
+            out_cols.append(_concat_one(parts, idx, field, cap))
+        return ColumnBatch(schema, out_cols, jnp.asarray(total, jnp.int32),
+                           cap)
+
+    from blaze_tpu.runtime import jit_cache
+
+    key = ("concat", cap, tuple(schema.fields),
+           tuple(b.shape_key() for b in batches))
+
+    def make():
+        def run(idx, total, *bs):
+            out_cols = []
+            for ci, field in enumerate(schema):
+                parts = [b.columns[ci] for b in bs]
+                out_cols.append(_concat_one(parts, idx, field, cap))
+            return ColumnBatch(schema, out_cols, total.astype(jnp.int32),
+                               cap)
+
+        return run
+
+    fn = jit_cache.get_or_compile(key, make)
+    return fn(idx, jnp.asarray(total, jnp.int64), *batches)
+
+
+def _has_list(dtype) -> bool:
+    if dtype.kind in (TypeKind.LIST, TypeKind.MAP):
+        return True
+    if dtype.kind == TypeKind.STRUCT and not dtype.wide_decimal:
+        return any(_has_list(f.dtype) for f in dtype.fields)
+    return False
 
 
 def _concat_validity(parts, idx):
@@ -140,8 +221,25 @@ def _concat_list_columns(parts, idx, field, cap):
 
 
 def slice_batch(batch: ColumnBatch, start: int, count: int) -> ColumnBatch:
-    """Static slice of live rows [start, start+count) into a fresh batch."""
+    """Slice of live rows [start, start+count) into a fresh batch.
+
+    Jitted per (schema, input shape, output bucket) with start/count
+    traced — per-partition slicing in the exchange paths calls this with
+    many different offsets and must not compile (or eagerly dispatch) per
+    column per call."""
     cap = bucket_capacity(count)
-    idx = jnp.asarray(np.arange(cap, dtype=np.int64) + start)
-    return batch.take(jnp.clip(idx, 0, batch.capacity - 1),
-                      jnp.minimum(jnp.maximum(batch.num_rows - start, 0), count))
+    from blaze_tpu.runtime import jit_cache
+
+    key = ("slice", cap, tuple(batch.schema.fields), batch.shape_key())
+
+    def make():
+        def run(b, start, count):
+            idx = jnp.arange(cap, dtype=jnp.int64) + start
+            return b.take(
+                jnp.clip(idx, 0, b.capacity - 1),
+                jnp.minimum(jnp.maximum(b.num_rows - start, 0), count))
+
+        return run
+
+    return jit_cache.get_or_compile(key, make)(
+        batch, jnp.asarray(start, jnp.int64), jnp.asarray(count, jnp.int32))

@@ -1,0 +1,405 @@
+"""Host-side (numpy) row-encoded sort keys, run merge, and ordered collect.
+
+The device engine sorts with a variadic ``lax.sort`` over unsigned key
+arrays (ops/sort_keys.py). Two places must order rows where the data is
+already host-resident and a device round trip costs more than the work:
+
+  * merging spilled sort runs — frames live in host spill files, and the
+    round-4 device-dispatch merge measured 20-24 krows/s because every
+    pooled frame cost a fixed ~90 ms dispatch round-trip on a
+    remote-attached chip. The reference's merge is likewise host-side: a
+    LoserTree over spilled cursors (datafusion-ext-commons
+    loser_tree.rs:1-118, sort_exec.rs:419-475).
+  * the driver collect of a root ORDER BY — the result is pulled to host
+    anyway; ordering it during materialization is one numpy argsort
+    instead of a multi-minute 2M-row ``lax.sort`` compile+dispatch.
+
+Both build ONE memcmp-comparable key per row — the reference's design
+(sort_exec.rs converts rows to Arrow ``Rows`` for byte comparison): each
+sort column contributes big-endian bytes whose unsigned byte order equals
+the requested (asc, nulls_first) Spark order; the concatenation is viewed
+as a fixed-width ``S`` column that numpy compares with memcmp.
+
+Order equivalence with the device encoder is exact for ints, dates,
+timestamps, bools, strings (same 8-word prefix + length tiebreak) and
+decimals. float64 differs on TPU only: the device orders by the
+double-double (f32 hi, f32 lo) decomposition, the host by exact IEEE
+total order — the host order REFINES the device order for every value
+the emulated f64 can represent, so merged runs interleave device ties in
+exact IEEE order (NaN-above-inf and -0.0 == 0.0 match Spark on both).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from blaze_tpu.columnar import serde
+from blaze_tpu.columnar.serde import HostBatch, _HostCol
+from blaze_tpu.columnar.types import Schema, TypeKind
+from blaze_tpu.ops.sort_keys import DEFAULT_MAX_STRING_WORDS, SortSpec
+
+_I64_MIN = np.int64(-(1 << 63))
+_I32_MIN = np.uint32(1 << 31)
+
+
+def _be(a: np.ndarray) -> np.ndarray:
+    """(n,) unsigned -> (n, itemsize) uint8, big-endian."""
+    k = a.dtype.itemsize
+    return np.ascontiguousarray(
+        a.astype(a.dtype.newbyteorder(">"))).view(np.uint8).reshape(-1, k)
+
+
+def _f64_total_order(x: np.ndarray) -> np.ndarray:
+    x = np.where(np.isnan(x), np.float64(np.nan), x)
+    x = np.where(x == 0.0, np.float64(0.0), x)
+    u = x.view(np.uint64)
+    neg = (u >> np.uint64(63)) != 0
+    return np.where(neg, ~u, u ^ np.uint64(1 << 63))
+
+
+def _f32_total_order(x: np.ndarray) -> np.ndarray:
+    x = np.where(np.isnan(x), np.float32(np.nan), x)
+    x = np.where(x == np.float32(0.0), np.float32(0.0), x)
+    u = x.view(np.uint32)
+    neg = (u >> np.uint32(31)) != 0
+    return np.where(neg, ~u, u ^ _I32_MIN)
+
+
+def _value_parts(c: _HostCol, kind: TypeKind, wide: bool,
+                 n: int) -> List[np.ndarray]:
+    """Big-endian byte planes whose concatenated order is the ascending
+    value order (mirrors ops/sort_keys.encode_column case by case)."""
+    if kind == TypeKind.NULL:
+        return []
+    if wide:
+        hi = c.children[0].data.astype(np.int64)
+        lo = c.children[1].data.astype(np.int64)
+        return [_be((hi ^ _I64_MIN).view(np.uint64)),
+                _be(lo.view(np.uint64))]
+    if kind in (TypeKind.STRING, TypeKind.BINARY):
+        w = DEFAULT_MAX_STRING_WORDS * 8
+        b = c.data
+        if b.shape[1] >= w:
+            prefix = np.ascontiguousarray(b[:, :w])
+        else:
+            prefix = np.zeros((n, w), np.uint8)
+            prefix[:, :b.shape[1]] = b
+        return [prefix, _be(c.lengths.astype(np.uint32))]
+    if kind == TypeKind.BOOLEAN:
+        return [c.data.astype(np.uint8).reshape(-1, 1)]
+    if kind == TypeKind.FLOAT64:
+        return [_be(_f64_total_order(c.data.astype(np.float64)))]
+    if kind == TypeKind.FLOAT32:
+        return [_be(_f32_total_order(c.data.astype(np.float32)))]
+    if kind in (TypeKind.INT64, TypeKind.TIMESTAMP, TypeKind.DECIMAL):
+        x = c.data.astype(np.int64)
+        return [_be((x ^ _I64_MIN).view(np.uint64))]
+    # int8/16/32/date — device widens to 32-bit; any self-consistent
+    # width gives the same order
+    x = c.data.astype(np.int32)
+    return [_be(x.view(np.uint32) ^ _I32_MIN)]
+
+
+def encode_keys(hb: HostBatch, specs: Sequence[SortSpec]) -> np.ndarray:
+    """(n,) ``S``-bytes array: memcmp order == the requested sort order.
+    Frames/host batches hold live rows only, so no liveness plane."""
+    n = hb.num_rows
+    planes: List[np.ndarray] = []
+    for spec in specs:
+        c = hb.cols[spec.col]
+        f = hb.schema.fields[spec.col]
+        # the flag plane follows the FIELD's nullability, not whether this
+        # particular frame happened to carry a validity array — keys from
+        # different frames/runs of the same column must share one byte
+        # width or the memcmp merge compares misaligned planes
+        if f.nullable:
+            valid = (c.validity if c.validity is not None
+                     else np.ones((n,), bool))
+            first = spec.nulls_first
+            flag = np.where(valid, np.uint8(1 if first else 0),
+                            np.uint8(0 if first else 1))
+            planes.append(flag.reshape(-1, 1))
+        else:
+            valid = None
+        for p in _value_parts(c, f.dtype.kind, f.dtype.wide_decimal, n):
+            if valid is not None:
+                p = np.where(valid[:, None], p, np.uint8(0))
+            planes.append(p if spec.asc else ~p)
+    if not planes:
+        return np.zeros((n,), "S1")
+    mat = np.ascontiguousarray(np.concatenate(planes, axis=1))
+    w = mat.shape[1]
+    return mat.view(f"S{w}").reshape(-1)
+
+
+def sort_perm(hb: HostBatch, specs: Sequence[SortSpec]) -> np.ndarray:
+    return np.argsort(encode_keys(hb, specs), kind="stable")
+
+
+# ---------------------------------------------------------------------------
+# host batch manipulation (take / concat / device upload)
+# ---------------------------------------------------------------------------
+
+def host_supported(schema: Schema) -> bool:
+    """LIST/MAP storage (at any nesting depth) is not row-sliceable
+    host-side; those schemas keep the device paths."""
+    return not any(_contains_list(f.dtype) for f in schema.fields)
+
+
+def _contains_list(dtype) -> bool:
+    if dtype.kind in (TypeKind.LIST, TypeKind.MAP):
+        return True
+    if dtype.kind == TypeKind.STRUCT and not dtype.wide_decimal:
+        return any(_contains_list(f.dtype) for f in dtype.fields)
+    return False
+
+
+def _col_take(c: _HostCol, idx: np.ndarray) -> _HostCol:
+    v = c.validity[idx] if c.validity is not None else None
+    if c.kind == "null":
+        return _HostCol("null", None, None, v)
+    if c.kind == "struct":
+        return _HostCol("struct", None, None, v,
+                        children=[_col_take(ch, idx) for ch in c.children])
+    if c.kind == "str":
+        return _HostCol("str", c.data[idx], c.lengths[idx], v)
+    return _HostCol("num", c.data[idx], None, v)
+
+
+def host_take(hb: HostBatch, idx: np.ndarray) -> HostBatch:
+    return HostBatch(hb.schema, [_col_take(c, idx) for c in hb.cols],
+                     len(idx))
+
+
+def _col_concat(parts: List[_HostCol], kind: str) -> _HostCol:
+    if any(p.validity is not None for p in parts):
+        v = np.concatenate([
+            p.validity if p.validity is not None
+            else np.ones((_host_len(p),), bool) for p in parts])
+    else:
+        v = None
+    if kind == "null":
+        return _HostCol("null", None, None, v)
+    if kind == "struct":
+        nch = len(parts[0].children)
+        children = [_col_concat([p.children[i] for p in parts],
+                                parts[0].children[i].kind)
+                    for i in range(nch)]
+        return _HostCol("struct", None, None, v, children=children)
+    if kind == "str":
+        w = max(p.data.shape[1] for p in parts)
+        mats = []
+        for p in parts:
+            if p.data.shape[1] < w:
+                m = np.zeros((p.data.shape[0], w), np.uint8)
+                m[:, :p.data.shape[1]] = p.data
+                mats.append(m)
+            else:
+                mats.append(p.data)
+        return _HostCol("str", np.concatenate(mats),
+                        np.concatenate([p.lengths for p in parts]), v)
+    return _HostCol("num", np.concatenate([p.data for p in parts]), None, v)
+
+
+def _host_len(c: _HostCol) -> int:
+    if c.kind == "str":
+        return len(c.lengths)
+    if c.kind == "struct":
+        return _host_len(c.children[0])
+    if c.kind == "null":
+        return len(c.validity) if c.validity is not None else 0
+    return len(c.data)
+
+
+def host_concat(parts: List[HostBatch]) -> HostBatch:
+    if len(parts) == 1:
+        return parts[0]
+    schema = parts[0].schema
+    cols = [_col_concat([p.cols[i] for p in parts], parts[0].cols[i].kind)
+            for i in range(len(schema.fields))]
+    return HostBatch(schema, cols, sum(p.num_rows for p in parts))
+
+
+def _upload_col(c: _HostCol, f, n: int, cap: int):
+    import jax.numpy as jnp
+
+    from blaze_tpu.columnar.batch import (
+        Column, StringData, StructData, bucket_width, _pad_validity,
+    )
+    from blaze_tpu.columnar.types import wide_decimal_storage
+
+    validity = _pad_validity(c.validity, n, cap) \
+        if c.validity is not None else None
+    dtype = f.dtype
+    if c.kind == "null":
+        return Column(dtype, jnp.zeros((cap,), jnp.int8),
+                      jnp.zeros((cap,), jnp.bool_))
+    if c.kind == "struct":
+        fields = (wide_decimal_storage(dtype).fields
+                  if dtype.wide_decimal else dtype.fields)
+        children = [_upload_col(ch, sf, n, cap)
+                    for ch, sf in zip(c.children, fields)]
+        return Column(dtype, StructData(children), validity)
+    if c.kind == "str":
+        w = bucket_width(max(int(c.lengths.max()) if n else 1, 1))
+        mat = np.zeros((cap, w), np.uint8)
+        mat[:n, :min(w, c.data.shape[1])] = c.data[:, :w]
+        lens = np.zeros((cap,), np.int32)
+        lens[:n] = c.lengths
+        col = Column(dtype, StringData(jnp.asarray(mat), jnp.asarray(lens)),
+                     validity)
+        return col.normalized() if validity is not None else col
+    npdt = dtype.np_dtype()
+    full = np.zeros((cap,), npdt)
+    full[:n] = c.data.astype(npdt)
+    col = Column(dtype, jnp.asarray(full), validity)
+    return col.normalized() if validity is not None else col
+
+
+def host_to_device(hb: HostBatch, capacity: Optional[int] = None):
+    import jax.numpy as jnp
+
+    from blaze_tpu.columnar.batch import ColumnBatch, bucket_capacity
+
+    n = hb.num_rows
+    cap = capacity or bucket_capacity(n)
+    cols = [_upload_col(c, f, n, cap)
+            for c, f in zip(hb.cols, hb.schema.fields)]
+    return ColumnBatch(hb.schema, cols, jnp.asarray(n, jnp.int32), cap)
+
+
+# ---------------------------------------------------------------------------
+# k-way merge of sorted spill runs
+# ---------------------------------------------------------------------------
+
+class _Cursor:
+    """One sorted run: frame iterator + current frame's keys + position."""
+
+    def __init__(self, frames: Iterator[HostBatch],
+                 specs: Sequence[SortSpec]) -> None:
+        self._frames = frames
+        self._specs = specs
+        self.hb: Optional[HostBatch] = None
+        self.keys: Optional[np.ndarray] = None
+        self.pos = 0
+        self.advance_frame()
+
+    def advance_frame(self) -> None:
+        self.hb = next(self._frames, None)
+        self.pos = 0
+        self.keys = (encode_keys(self.hb, self._specs)
+                     if self.hb is not None else None)
+
+    @property
+    def done(self) -> bool:
+        return self.hb is None
+
+    def head(self) -> bytes:
+        return self.keys[self.pos]
+
+
+def host_nbytes(hb: HostBatch) -> int:
+    total = 0
+    for c in hb.cols:
+        total += _col_nbytes_host(c)
+    return total
+
+
+def _col_nbytes_host(c: _HostCol) -> int:
+    n = 0
+    if c.kind == "str":
+        n += c.data.size + 4 * len(c.lengths)
+    elif c.kind == "struct":
+        n += sum(_col_nbytes_host(ch) for ch in c.children)
+    elif c.kind == "num":
+        n += c.data.nbytes
+    if c.validity is not None:
+        n += len(c.validity)
+    return n
+
+
+def merge_sorted_host(frame_iters: List[Iterator[HostBatch]],
+                      specs: Sequence[SortSpec],
+                      emit_bytes: int) -> Iterator[HostBatch]:
+    """Merge k sorted runs of host frames into sorted HostBatches of
+    ~emit_bytes. Per iteration: pick the run with the smallest head key,
+    emit its rows <= every other head (one searchsorted), advance — all
+    numpy, no device dispatch (ref loser_tree.rs role)."""
+    cursors = [_Cursor(it, specs) for it in frame_iters]
+    acc: List[HostBatch] = []
+    acc_bytes = 0
+
+    def flush():
+        nonlocal acc, acc_bytes
+        if acc:
+            out = host_concat(acc)
+            acc, acc_bytes = [], 0
+            yield out
+
+    while True:
+        active = [c for c in cursors if not c.done]
+        if not active:
+            yield from flush()
+            return
+        cmin = min(active, key=lambda c: c.head())
+        others = [c.head() for c in active if c is not cmin]
+        if others:
+            bound = min(others)
+            j = int(np.searchsorted(cmin.keys[cmin.pos:], bound,
+                                    side="right"))
+            j = max(j, 1)  # head() <= bound by construction
+        else:
+            j = cmin.hb.num_rows - cmin.pos
+        idx = np.arange(cmin.pos, cmin.pos + j)
+        piece = host_take(cmin.hb, idx)
+        acc.append(piece)
+        acc_bytes += host_nbytes(piece)
+        cmin.pos += j
+        if cmin.pos >= cmin.hb.num_rows:
+            cmin.advance_frame()
+        if acc_bytes >= emit_bytes:
+            yield from flush()
+
+
+def host_to_pylike(hb: HostBatch):
+    """ColumnBatch.to_numpy()-shaped dict from a host batch (numerics as
+    arrays / object-with-None, strings as bytes-or-None lists, wide
+    decimals as python ints) — the ordered-collect path hands this to the
+    driver without a second device pull."""
+    out = {}
+    for f, c in zip(hb.schema.fields, hb.cols):
+        n = hb.num_rows
+        valid = c.validity if c.validity is not None else np.ones((n,), bool)
+        if f.dtype.wide_decimal:
+            from blaze_tpu.columnar import int128 as i128
+
+            hi = c.children[0].data.astype(np.int64)
+            lo = c.children[1].data.astype(np.int64)
+            ints = i128.ints_from_np(hi, lo)
+            out[f.name] = [ints[i] if valid[i] else None for i in range(n)]
+            continue
+        if c.kind == "struct":
+            subs = [host_to_pylike(HostBatch(
+                Schema([sf]), [ch], n))[sf.name]
+                for sf, ch in zip(f.dtype.fields, c.children)]
+            out[f.name] = [tuple(s[i] for s in subs) if valid[i] else None
+                           for i in range(n)]
+            continue
+        if c.kind == "str":
+            b, l = c.data, c.lengths
+            out[f.name] = [bytes(b[i, :l[i]]) if valid[i] else None
+                           for i in range(n)]
+            continue
+        if c.kind == "null":
+            out[f.name] = np.full((n,), None, object)
+            continue
+        d = c.data[:n]
+        if valid.all():
+            out[f.name] = d
+        else:
+            o = d.astype(object)
+            o[~valid] = None
+            out[f.name] = o
+    return out

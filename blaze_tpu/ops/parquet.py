@@ -98,7 +98,7 @@ class ParquetScanExec(Operator):
         self.partition_schema = partition_schema or Schema([])
         self.pruning_predicates = list(pruning_predicates)
         self.fs_resource_id = fs_resource_id
-        self.batch_rows = batch_rows or conf.batch_size
+        self.batch_rows = batch_rows  # None -> adaptive (execute time)
         self.raw_files = raw_files
 
         read_fields = [file_schema.fields[i] for i in self.projection]
@@ -125,6 +125,13 @@ class ParquetScanExec(Operator):
 
     def execute(self, ctx: ExecContext) -> BatchStream:
         def gen():
+            from blaze_tpu.ops.common import adaptive_batch_rows
+
+            # macro-batching: a fixed ~90ms dispatch round trip per batch
+            # on a remote-attached chip makes source batch size THE
+            # throughput lever; size to the byte target unless pinned
+            batch_rows = self.batch_rows or adaptive_batch_rows(
+                self._schema)
             names = [self.file_schema.fields[i].name
                      for i in self.projection]
             for path, part_values in self.files:
@@ -142,7 +149,7 @@ class ParquetScanExec(Operator):
                                      pf.num_row_groups - len(groups))
                     if not groups:
                         continue
-                    for rb in pf.iter_batches(batch_size=self.batch_rows,
+                    for rb in pf.iter_batches(batch_size=batch_rows,
                                               row_groups=groups,
                                               columns=names):
                         ctx.check_running()

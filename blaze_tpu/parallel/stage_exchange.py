@@ -85,7 +85,7 @@ def run_mesh_shuffle_stage(stage_plan: pb.PlanNode, stage_id: int,
     writer = stage_plan.shuffle_writer
     num_partitions = writer.partitioning.num_partitions
     devices = jax.devices()
-    if num_partitions < 2 or num_partitions > len(devices):
+    if num_partitions < 2:
         return False
     input_op = decode_plan(writer.input)
     key_idx = mesh_key_indices(writer, input_op.schema)
@@ -96,57 +96,107 @@ def run_mesh_shuffle_stage(stage_plan: pb.PlanNode, stage_id: int,
 
     schema = input_op.schema
     Pn = num_partitions
-    mesh = Mesh(np.array(devices[:Pn]), ("p",))
+    # P > D (VERDICT r4 #7): device d OWNS the contiguous partition block
+    # [d*k, (d+1)*k), k = ceil(P/D). With one device the "exchange" is
+    # purely local grouping — partitions stay in HBM with no all_to_all
+    # and no host round trip at all (the remote-attached single-chip
+    # deployment's fast path: the file exchange would pull every map
+    # output through the ~8 MB/s tunnel).
+    use_d = min(len(devices), Pn)
+    kpd = -(-Pn // use_d)
+    use_d = -(-Pn // kpd)  # drop devices left with no partitions
+    mesh = (Mesh(np.array(devices[:use_d]), ("p",)) if use_d > 1 else None)
     recv_parts: List[List[ColumnBatch]] = [[] for _ in range(Pn)]
     file_outputs: List[tuple] = []
 
+    def exchange_local(batch: ColumnBatch) -> bool:
+        """Single-device exchange: group by partition id on device, slice
+        per partition; one host pull (the bounds) per macro-batch."""
+        from blaze_tpu.ops.common import slice_batch
+        from blaze_tpu.parallel.shuffle import partition_ids
+
+        key = ("local_xchg", Pn, tuple(key_idx), batch.shape_key())
+
+        def make():
+            def run(b):
+                from blaze_tpu.ops.join import sort_batch_by_keys
+
+                pid = partition_ids(b, key_idx, Pn)
+                sb = sort_batch_by_keys(b, [pid.astype(jnp.uint32)])
+                bounds = jnp.searchsorted(
+                    jnp.sort(pid), jnp.arange(Pn + 1, dtype=jnp.int32))
+                return sb, bounds
+
+            return run
+
+        sb, bounds = jit_cache.get_or_compile(key, make)(batch)
+        bounds = np.asarray(bounds)
+        for p in range(Pn):
+            n = int(bounds[p + 1]) - int(bounds[p])
+            if n:
+                recv_parts[p].append(slice_batch(sb, int(bounds[p]), n))
+        return True
+
     def exchange_batch(batch: ColumnBatch) -> bool:
         """Exchange one batch over the mesh; False on quota overflow."""
+        if use_d == 1:
+            return exchange_local(batch)
         n = int(batch.num_rows)
-        per = max(1, -(-n // Pn))
+        per = max(1, -(-n // use_d))
         cap = bucket_capacity(per)
-        q = min(quota, cap) if quota else cap
+        # quota: rows one device may send one OWNER device (k partitions)
+        q = min(quota * kpd, cap) if quota else cap
         slices = [
             batch.take(jnp.arange(cap, dtype=jnp.int32) + i * per,
                        min(max(n - i * per, 0), per))
-            for i in range(Pn)
+            for i in range(use_d)
         ]
         cols = jax.tree.map(lambda *xs: jnp.concatenate(xs, 0),
                             *[b.columns for b in slices])
         num_rows = jnp.array([int(b.num_rows) for b in slices], jnp.int32)
 
-        key = ("mesh_xchg", Pn, cap, q, tuple(key_idx),
+        key = ("mesh_xchg", Pn, use_d, cap, q, tuple(key_idx),
                slices[0].shape_key())
 
         def make():
             def step(local_cols, local_num_rows):
+                from blaze_tpu.parallel.shuffle import (
+                    mesh_shuffle_batch_grouped,
+                )
+
                 b = ColumnBatch(schema, local_cols, local_num_rows[0], cap)
-                out, overflow = mesh_shuffle_batch(b, key_idx, "p", Pn,
-                                                   quota=q)
-                return out.columns, out.num_rows[None], overflow[None]
+                out, counts, overflow = mesh_shuffle_batch_grouped(
+                    b, key_idx, "p", Pn, kpd, quota=q)
+                return out.columns, counts[None], overflow[None]
 
             return jax.shard_map(step, mesh=mesh,
                                  in_specs=(P("p"), P("p")),
                                  out_specs=(P("p"), P("p"), P("p")))
 
         run = jit_cache.get_or_compile(key, make)
-        out_cols, out_rows, overflow = run(cols, num_rows)
-        if int(np.asarray(overflow)[0]) > 0:
+        out_cols, out_counts, overflow = run(cols, num_rows)
+        if int(np.asarray(overflow).sum()) > 0:
             return False
-        out_rows = np.asarray(out_rows)
-        recv_cap = Pn * q  # per-device received capacity
+        out_counts = np.asarray(out_counts)  # (use_d, kpd)
+        recv_cap = use_d * q  # per-device received capacity
         full = ColumnBatch(schema, out_cols, jnp.asarray(0, jnp.int32),
-                           Pn * recv_cap)
-        for p in range(Pn):
-            nrows = int(out_rows[p])
-            if nrows == 0:
-                continue
-            # compact to the rows' own capacity bucket: retaining the full
-            # Pn*q staging capacity per slice would pin
-            # O(batches * Pn^2 * q) padded rows in HBM across the stage
-            cap_p = bucket_capacity(nrows)
-            idx = jnp.arange(cap_p, dtype=jnp.int32) + p * recv_cap
-            recv_parts[p].append(full.take(idx, nrows))
+                           use_d * recv_cap)
+        for d in range(use_d):
+            off = 0
+            for j in range(kpd):
+                p = d * kpd + j
+                nrows = int(out_counts[d, j])
+                if p >= Pn or nrows == 0:
+                    off += nrows
+                    continue
+                # compact to the rows' own capacity bucket: retaining the
+                # full staging capacity per slice would pin
+                # O(batches * D^2 * q) padded rows in HBM across the stage
+                cap_p = bucket_capacity(nrows)
+                idx = jnp.arange(cap_p, dtype=jnp.int32) + \
+                    (d * recv_cap + off)
+                recv_parts[p].append(full.take(idx, nrows))
+                off += nrows
         return True
 
     def spill_batch_to_file(batch: ColumnBatch) -> None:
@@ -163,17 +213,26 @@ def run_mesh_shuffle_stage(stage_plan: pb.PlanNode, stage_id: int,
         file_outputs.append((data, index))
 
     # map side: stream every task's batches straight into the exchange
-    # (whole-stage single-dispatch where the subtree matches)
+    # (whole-stage single-dispatch where the subtree matches). Exchanged
+    # partitions stay PINNED in HBM until the consuming stage finishes,
+    # so the mesh path honors the memory budget: once pinned bytes pass
+    # half the budget, the remaining batches take the file path (the
+    # reduce side reads both transparently).
     from blaze_tpu.runtime.executor import execute_stage_or_plan
+    from blaze_tpu.runtime.memory import batch_nbytes, get_manager
 
+    budget = get_manager().total // 2
+    pinned = 0
     for task in range(ntasks):
         op = decode_plan(writer.input)  # fresh operator state per task
         for batch in execute_stage_or_plan(
                 op, ExecContext(partition=task, num_partitions=ntasks)):
             if int(batch.num_rows) == 0:
                 continue
-            if not exchange_batch(batch):
+            if pinned > budget or not exchange_batch(batch):
                 spill_batch_to_file(batch)
+            else:
+                pinned += batch_nbytes(batch)
 
     def _unshard(x):
         # Batches sliced out of the shard_map output stay committed
@@ -193,10 +252,18 @@ def run_mesh_shuffle_stage(stage_plan: pb.PlanNode, stage_id: int,
     def provider(partition: int):
         # defaulted extra args would miscount as task-context params in
         # _call_provider's arity dispatch — close over state instead
+        from blaze_tpu.ops.host_sort import host_supported
+        from blaze_tpu.ops.shuffle import read_shuffle_partition_host
+
         for b in recv_parts[partition]:
             yield jax.tree_util.tree_map(_unshard, b)
         for data, index in file_outputs:
-            yield from read_shuffle_partition(data, index, partition, schema)
+            if host_supported(schema):
+                yield from read_shuffle_partition_host(data, index,
+                                                       partition, schema)
+            else:
+                yield from read_shuffle_partition(data, index, partition,
+                                                  schema)
 
     if stats is not None:
         import os as _os

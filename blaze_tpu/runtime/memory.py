@@ -165,6 +165,13 @@ class SpillFile:
         self._fp.seek(0)
         return serde.read_batches(self._fp, self.schema)
 
+    def read_host(self):
+        """Frames as host numpy batches (serde.HostBatch) — the spill
+        merge consumes runs host-side (ops/host_sort.py)."""
+        self._fp.flush()
+        self._fp.seek(0)
+        yield from serde.read_batches_host(self._fp, self.schema)
+
     def close(self) -> None:
         if self._fp is not None:
             self._fp.close()

@@ -35,3 +35,54 @@ def run_task_serialized(task_def: bytes) -> bytes:
     for batch in execute_plan(plan, ctx):
         out += serde.serialize_batch(batch)
     return bytes(out)
+
+
+# Arrow C-stream payload type codes (consumed by native/src/arrow_stream.cpp)
+_ARROW_CODES = {}
+
+
+def _arrow_code(dtype):
+    from blaze_tpu.columnar.types import TypeKind as K
+
+    if dtype.wide_decimal:
+        return 13
+    return {
+        K.BOOLEAN: 1, K.INT8: 2, K.INT16: 3, K.INT32: 4, K.INT64: 5,
+        K.FLOAT32: 6, K.FLOAT64: 7, K.STRING: 8, K.BINARY: 9,
+        K.DATE: 10, K.TIMESTAMP: 11, K.DECIMAL: 12,
+    }.get(dtype.kind)
+
+
+def arrow_payload_header(schema) -> bytes:
+    """BTAS header: field names + type codes so the C++ stream can build
+    the ArrowSchema without parsing the plan protobuf."""
+    out = bytearray(b"BTAS")
+    out += struct.pack("<H", len(schema.fields))
+    for f in schema.fields:
+        name = f.name.encode()
+        code = _arrow_code(f.dtype)
+        if code is None:
+            raise ValueError(
+                f"arrow stream does not support {f.dtype.kind} columns")
+        out += struct.pack("<H", len(name)) + name
+        out += struct.pack("<BBii", code, 1 if f.nullable else 0,
+                           f.dtype.precision, f.dtype.scale)
+    return bytes(out)
+
+
+def run_task_arrow_payload(task_def: bytes) -> bytes:
+    """bn_call_arrow hook: BTAS schema header + the BTB1 result frames.
+
+    The C++ side (native/src/arrow_stream.cpp) turns this payload into a
+    standard Arrow C stream (ArrowArrayStream) that ANY Arrow host can
+    import zero-copy — the deployment contract of the reference
+    (blaze/src/rt.rs:76-80 hands the JVM an FFI_ArrowArrayStream consumed
+    by ArrowFFIStreamImportIterator.scala:63-75)."""
+    from blaze_tpu.plan import decode_task_definition
+
+    plan, td = decode_task_definition(task_def)
+    ctx = ExecContext(partition=td.partition_id)
+    out = bytearray(arrow_payload_header(plan.schema))
+    for batch in execute_plan(plan, ctx):
+        out += serde.serialize_batch(batch)
+    return bytes(out)

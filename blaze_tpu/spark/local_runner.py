@@ -217,7 +217,8 @@ def _run_shuffle_stage(stage: Stage, stages: List[Stage],
         slot.commit()
 
     resources.put(f"shuffle:{stage.stage_id}",
-                  lambda partition: shuffle_mgr.get_reader(handle, partition))
+                  lambda partition: shuffle_mgr.get_reader_host(handle,
+                                                                partition))
     return logical
 
 
@@ -230,45 +231,93 @@ def _run_broadcast_stage(stage: Stage) -> None:
                   lambda partition=0: iter(list(frames)))
 
 
+def _root_sort_split(op):
+    """(specs, limit, strip_depth) for a host-ordered collect, or None.
+
+    A root ORDER BY orders the driver COLLECT: the result is pulled to
+    host anyway, so the ordering happens host-side during materialization
+    (ops/host_sort.py) instead of compiling a full-input lax.sort. Shapes:
+    a fetch-less root SortExec, or a GlobalLimit over (LocalLimit*) over
+    a fetch-less SortExec. TakeOrdered (SortExec with fetch) keeps its
+    device top-k fold — it bounds the pull — and merges host-side."""
+    from blaze_tpu.ops.basic import GlobalLimitExec, LocalLimitExec
+    from blaze_tpu.ops.sort import SortExec
+
+    if isinstance(op, SortExec) and op.fetch is None:
+        return list(op.specs), None, 1
+    if isinstance(op, GlobalLimitExec):
+        child = op.children[0]
+        depth = 2
+        while (isinstance(child, LocalLimitExec)
+               and not isinstance(child, GlobalLimitExec)):
+            child = child.children[0]
+            depth += 1
+        if isinstance(child, SortExec) and child.fetch is None:
+            return list(child.specs), op.limit, depth
+    return None
+
+
 def _run_result_stage(stage: Stage, parts: int) -> ColumnBatch:
     """`parts` is the upstream exchange's partition count (_input_tasks) —
     NOT the global default: an 8-way repartition read with 4 tasks would
     silently drop half the shuffle partitions."""
-    op = decode_plan(stage.plan)
+    from blaze_tpu.columnar import serde
+    from blaze_tpu.ops import host_sort
+    from blaze_tpu.ops.basic import GlobalLimitExec
+    from blaze_tpu.ops.sort import SortExec, truncate
+    from blaze_tpu.ops.sort_keys import sort_batch
     from blaze_tpu.runtime.stage_compiler import try_run_stage
+
+    op = decode_plan(stage.plan)
+    split = (_root_sort_split(op)
+             if host_sort.host_supported(op.schema) else None)
+    strip = split[2] if split else 0
 
     batches: List[ColumnBatch] = []
     for p in range(parts):
         op_p = decode_plan(stage.plan)  # fresh operator state per task
+        for _ in range(strip):
+            op_p = op_p.children[0]
         task_ctx = ExecContext(partition=p, num_partitions=parts)
         staged = try_run_stage(op_p, task_ctx)
         if staged is not None:
             batches.append(staged)
             continue
         batches.extend(execute_plan(op_p, task_ctx))
+
+    if split is not None:
+        specs, limit, _ = split
+        if not batches:
+            return ColumnBatch.empty(op.schema)
+        # ordered collect: ONE pull per partition result, order + truncate
+        # on host, hand the driver the host view (no second pull)
+        hbs = [serde.to_host(b) for b in batches
+               if int(b.num_rows) > 0]
+        if not hbs:
+            return ColumnBatch.empty(op.schema)
+        hb = host_sort.host_concat(hbs)
+        perm = host_sort.sort_perm(hb, specs)
+        if limit is not None:
+            perm = perm[:limit]
+        hb = host_sort.host_take(hb, perm)
+        out = host_sort.host_to_device(hb)
+        out._host_numpy = host_sort.host_to_pylike(hb)
+        return out
+
     if not batches:
         return ColumnBatch.empty(op.schema)
     out = concat_batches(batches, op.schema)
-    # Ordered collect: a root SortExec sorts each partition; merging the
-    # sorted partitions gives the total order the query asked for (the
-    # analog of Spark's range-partitioned global sort collect). A global
-    # limit above the sort re-applies after the merge (TakeOrdered shape).
-    from blaze_tpu.ops.basic import GlobalLimitExec
-    from blaze_tpu.ops.sort import SortExec, truncate
-    from blaze_tpu.ops.sort_keys import sort_batch
-
+    # Ordered collect for the remaining shapes (device path): a root
+    # TakeOrdered (SortExec with fetch) sorted each partition with a
+    # bounded top-k; merging the sorted partitions gives the total order
+    # (the analog of Spark's range-partitioned global sort collect). A
+    # GlobalLimit above a Project (no sort below) is an UNORDERED limit.
     if parts > 1:
         if isinstance(op, SortExec):
             out = sort_batch(out, op.specs)
             if op.fetch:
                 out = truncate(out, op.fetch)
         elif isinstance(op, GlobalLimitExec):
-            # find the ordering below the limit, looking through
-            # schema-preserving ops. A Project in between is Spark's
-            # TakeOrderedAndProject shape, which the planner lowers to
-            # TakeOrderedExec (a SortExec) — a plain GlobalLimit above a
-            # Project is therefore an UNORDERED limit: any n rows satisfy
-            # it and no merge sort is owed.
             from blaze_tpu.ops.basic import LocalLimitExec
 
             child = op.children[0]

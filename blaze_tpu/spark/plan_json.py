@@ -433,15 +433,30 @@ def decode_plan_json(text: str, spark_version: str = None) -> SparkPlan:
     encodings differ across 3.0-3.5; None = the 3.3 dialect."""
     from blaze_tpu.spark.shims import for_version
 
+    from blaze_tpu.spark.shims import ShimError
+
     global _CURRENT_SHIM
     prev = _CURRENT_SHIM
-    _CURRENT_SHIM = for_version(spark_version)
     try:
+        _CURRENT_SHIM = for_version(spark_version)
         nodes = json.loads(text)
         if not isinstance(nodes, list) or not nodes:
             raise PlanJsonError("expected the TreeNode pre-order array")
         tree, _ = _build_tree(nodes, 0)
         return _decode_node(tree)
+    except PlanJsonError:
+        raise
+    except (ShimError, json.JSONDecodeError) as e:
+        # one error contract at this boundary: the embedding layer keys
+        # its native/fallback decision on PlanJsonError (tryConvert)
+        raise PlanJsonError(str(e)) from e
+    except (KeyError, IndexError, TypeError, ValueError,
+            AttributeError) as e:
+        # malformed/adversarial TreeNode JSON must never escape as a raw
+        # crash: live Catalyst variance (unknown nodes, dropped fields,
+        # junk values) demotes to fallback, it does not kill the task
+        raise PlanJsonError(
+            f"malformed plan JSON: {type(e).__name__}: {e}") from e
     finally:
         _CURRENT_SHIM = prev
 

@@ -150,6 +150,28 @@ class BlazeShuffleManager:
                     st.data_path, st.index_path, partition, handle.schema)
         return gen()
 
+    def get_reader_host(self, handle: ShuffleHandle, partition: int):
+        """Host-frame variant of get_reader: yields serde.HostBatch so
+        IpcReaderExec can coalesce all of a partition's frames into one
+        macro-batch device upload (ops/shuffle.py host coalescing).
+        Schemas with list storage fall back to device batches."""
+        from blaze_tpu.ops.host_sort import host_supported
+        from blaze_tpu.ops.shuffle import read_shuffle_partition_host
+
+        if not host_supported(handle.schema):
+            return self.get_reader(handle, partition)
+        statuses = self._map_outputs.get(handle.shuffle_id)
+        if statuses is None:
+            raise KeyError(f"shuffle {handle.shuffle_id} not registered")
+
+        def gen():
+            for st in statuses:
+                if st.partition_lengths[partition] == 0:
+                    continue
+                yield from read_shuffle_partition_host(
+                    st.data_path, st.index_path, partition, handle.schema)
+        return gen()
+
     def get_all_partitions_reader(self, handle: ShuffleHandle
                                   ) -> Iterator[ColumnBatch]:
         """Every partition of every map output — Spark's local-shuffle-

@@ -50,22 +50,50 @@ DD_SCHEMA = T.Schema([
 ITEM_SCHEMA = T.Schema([
     T.Field("i_item_sk", T.INT64),
     T.Field("i_category_id", T.INT32),
+    T.Field("i_category", T.STRING),
     T.Field("i_current_price", T.FLOAT64),
 ])
 
+_CATEGORIES = ["Books", "Children", "Electronics", "Home", "Jewelry",
+               "Men", "Music", "Shoes", "Sports", "Women"]
+
+
+def _zipf_keys(rng, n, lo, hi, a=1.3):
+    """Zipf-skewed keys over [lo, hi) — real TPC-DS fact keys are skewed
+    (hot items/customers); uniform keys hide collision-heavy paths."""
+    z = rng.zipf(a, n)
+    return lo + (z - 1) % (hi - lo)
+
+
+def _with_nulls(rng, values, frac=0.05):
+    """~frac nulls (pandas: float + NaN; parquet writes real nulls)."""
+    v = values.astype(np.float64)
+    v[rng.random(len(v)) < frac] = np.nan
+    return v
+
 
 def generate_tables(tmpdir: str, rows: int = 20_000, seed: int = 7):
-    """Write store_sales/date_dim/item parquet; returns (paths, frames)."""
+    """Write store_sales/date_dim/item parquet; returns (paths, frames).
+
+    Data realism (ref: the reference validates against real TPC-DS data,
+    tpcds.yml:122-126): ~5% nulls in every nullable measure column, a
+    string dim column (i_category) for LIKE/substr filters, and
+    Zipf-skewed fact keys (hot items dominate, as in real sales data).
+    """
     rng = np.random.default_rng(seed)
     n_dd, n_item = 730, 400
     ss = pd.DataFrame({
         "ss_sold_date_sk": rng.integers(0, n_dd, rows),
-        "ss_item_sk": rng.integers(1, n_item + 1, rows),
-        "ss_customer_sk": rng.integers(1, 500, rows),
+        "ss_item_sk": _zipf_keys(rng, rows, 1, n_item + 1),
+        "ss_customer_sk": _with_nulls(
+            rng, rng.integers(1, 500, rows), 0.03),
         "ss_store_sk": rng.integers(1, 8, rows),
-        "ss_quantity": rng.integers(1, 100, rows).astype(np.int32),
-        "ss_sales_price": np.round(rng.random(rows) * 200, 2),
-        "ss_ext_sales_price": np.round(rng.random(rows) * 1000, 2),
+        "ss_quantity": _with_nulls(
+            rng, rng.integers(1, 100, rows), 0.05),
+        "ss_sales_price": _with_nulls(
+            rng, np.round(rng.random(rows) * 200, 2), 0.05),
+        "ss_ext_sales_price": _with_nulls(
+            rng, np.round(rng.random(rows) * 1000, 2), 0.05),
     })
     dd = pd.DataFrame({
         "d_date_sk": np.arange(n_dd),
@@ -75,14 +103,41 @@ def generate_tables(tmpdir: str, rows: int = 20_000, seed: int = 7):
     item = pd.DataFrame({
         "i_item_sk": np.arange(1, n_item + 1),
         "i_category_id": rng.integers(1, 11, n_item).astype(np.int32),
+        "i_category": [_CATEGORIES[i % len(_CATEGORIES)]
+                       for i in range(n_item)],
         "i_current_price": np.round(rng.random(n_item) * 90 + 10, 2),
     })
+    schemas = {"store_sales": SS_SCHEMA, "date_dim": DD_SCHEMA,
+               "item": ITEM_SCHEMA}
     paths = {}
     for name, df in (("store_sales", ss), ("date_dim", dd), ("item", item)):
         path = f"{tmpdir}/{name}.parquet"
-        pq.write_table(pa.Table.from_pandas(df), path, row_group_size=4096)
+        pq.write_table(_to_arrow_typed(df, schemas[name]), path,
+                       row_group_size=65536)
         paths[name] = path
     return paths, {"store_sales": ss, "date_dim": dd, "item": item}
+
+
+def _to_arrow_typed(df: pd.DataFrame, schema: T.Schema) -> pa.Table:
+    """pandas -> arrow with the DECLARED column types: float-with-NaN
+    columns become nullable int64/int32 where the schema says integer
+    (pandas can't hold null ints natively)."""
+    from blaze_tpu.columnar.arrow_io import dtype_to_arrow
+
+    arrays = []
+    for f in schema.fields:
+        col = df[f.name]
+        at = dtype_to_arrow(f.dtype)
+        if pa.types.is_integer(at) and col.dtype.kind == "f":
+            mask = col.isna().to_numpy()
+            vals = np.where(mask, 0, col.to_numpy()).astype(np.int64)
+            arrays.append(pa.array(vals, type=at, mask=mask))
+        else:
+            arrays.append(pa.array(col, type=at))
+    return pa.Table.from_arrays(
+        arrays, schema=pa.schema(
+            [pa.field(f.name, dtype_to_arrow(f.dtype), f.nullable)
+             for f in schema.fields]))
 
 
 # ---------------------------------------------------------------------------
@@ -153,7 +208,7 @@ def q2_q06_core_agg(paths, frames, mode):
         ss = frames["store_sales"]
         f = ss[ss.ss_ext_sales_price > 100.0]
         g = f.groupby("ss_item_sk").agg(
-            total=("ss_ext_sales_price", "sum"),
+            total=("ss_ext_sales_price", lambda s: s.sum(min_count=1)),
             cnt=("ss_ext_sales_price", "count"),
             avg_price=("ss_sales_price", "mean")).reset_index()
         g = g.rename(columns={"ss_item_sk": "item"})
@@ -189,11 +244,13 @@ def q3_join_agg_sort(paths, frames, mode):
         ssd, ddd = frames["store_sales"], frames["date_dim"]
         m = ssd.merge(ddd[ddd.d_moy == 11], left_on="ss_sold_date_sk",
                       right_on="d_date_sk")
-        g = m.groupby(["ss_item_sk", "d_year"])[
-            "ss_ext_sales_price"].sum().reset_index()
+        g = m.groupby(["ss_item_sk", "d_year"])["ss_ext_sales_price"].agg(
+            lambda s: s.sum(min_count=1)).reset_index()
         g.columns = ["item", "year", "sumsales"]
+        # nulls-first to match the plan's (desc, nulls_first) spec
         return g.sort_values(["sumsales", "item"],
-                             ascending=[False, True]).reset_index(drop=True)
+                             ascending=[False, True],
+                             na_position="first").reset_index(drop=True)
 
     return srt, oracle
 
@@ -219,7 +276,8 @@ def q4_repartition_sort(paths, frames, mode):
                             "store": ss.ss_store_sk,
                             "price": ss.ss_ext_sales_price})
         return out.sort_values(["customer", "store", "price"],
-                               ascending=[True, True, False]
+                               ascending=[True, True, False],
+                               na_position="first"
                                ).reset_index(drop=True)
 
     return srt, oracle
@@ -259,10 +317,11 @@ def q5_multijoin_limit(paths, frames, mode):
                       right_on="d_date_sk")
         m = m.merge(itd, left_on="ss_item_sk", right_on="i_item_sk")
         g = m.groupby("i_category_id").agg(
-            rev=("ss_ext_sales_price", "sum"),
+            rev=("ss_ext_sales_price", lambda s: s.sum(min_count=1)),
             n=("ss_item_sk", "count")).reset_index()
         g.columns = ["cat", "rev", "n"]
-        return g.sort_values("rev", ascending=False).head(5).reset_index(
+        return g.sort_values("rev", ascending=False,
+                             na_position="first").head(5).reset_index(
             drop=True)
 
     return lim, oracle
@@ -327,6 +386,84 @@ def q7_left_outer_join(paths, frames, mode):
     return srt, oracle
 
 
+def q8_category_like(paths, frames, mode):
+    """String dim predicate: i_category LIKE 'S%' through the join, count
+    + revenue by category (STRING group key end-to-end)."""
+    ss = P.scan(SS_SCHEMA, [(paths["store_sales"], [])])
+    it = P.scan(ITEM_SCHEMA, [(paths["item"], [])])
+    itf = P.filter_(it, ir.Like(col("i_category"), b"S%"))
+    jschema = T.Schema(list(SS_SCHEMA.fields) + list(ITEM_SCHEMA.fields))
+    j = _join(ss, itf, [col("ss_item_sk")], [col("i_item_sk")], "inner",
+              jschema, mode)
+    aggs = [{"fn": "count", "args": [col("ss_item_sk")],
+             "dtype": T.INT64, "name": "n"},
+            {"fn": "sum", "args": [col("ss_ext_sales_price")],
+             "dtype": T.FLOAT64, "name": "rev"}]
+    partial = P.hash_agg(j, "partial", [col("i_category")], ["category"],
+                         aggs, T.Schema([T.Field("category", T.STRING)]))
+    x = P.shuffle_exchange(partial, [col("category")], 4)
+    final = P.hash_agg(
+        x, "final", [col("i_category")], ["category"], aggs,
+        T.Schema([T.Field("category", T.STRING), T.Field("n", T.INT64),
+                  T.Field("rev", T.FLOAT64)]))
+    srt = P.sort(final, [(col("category"), True, True)])
+
+    def oracle():
+        ssd, itd = frames["store_sales"], frames["item"]
+        f = itd[itd.i_category.str.startswith("S")]
+        m = ssd.merge(f, left_on="ss_item_sk", right_on="i_item_sk")
+        g = m.groupby("i_category").agg(
+            n=("ss_item_sk", "count"),
+            rev=("ss_ext_sales_price",
+                 lambda s: s.sum(min_count=1))).reset_index()
+        g.columns = ["category", "n", "rev"]
+        return g.sort_values("category").reset_index(drop=True)
+
+    return srt, oracle
+
+
+def q9_substr_group(paths, frames, mode):
+    """substr(i_category, 1, 3) as a computed STRING group key (the
+    LIKE/substr axis of real TPC-DS string processing, e.g. q08's
+    substr(ca_zip,1,5))."""
+    ss = P.scan(SS_SCHEMA, [(paths["store_sales"], [])])
+    it = P.scan(ITEM_SCHEMA, [(paths["item"], [])])
+    jschema = T.Schema(list(SS_SCHEMA.fields) + list(ITEM_SCHEMA.fields))
+    j = _join(ss, it, [col("ss_item_sk")], [col("i_item_sk")], "inner",
+              jschema, mode)
+    pschema = T.Schema([T.Field("cat3", T.STRING),
+                        T.Field("qty", T.FLOAT64)])
+    proj = P.project(
+        j,
+        [ir.ScalarFn("substring",
+                     (col("i_category"), lit(1), lit(3)), T.STRING),
+         ir.Cast(col("ss_quantity"), T.FLOAT64)],
+        ["cat3", "qty"], pschema)
+    aggs = [{"fn": "count", "args": [col("cat3")],
+             "dtype": T.INT64, "name": "n"},
+            {"fn": "avg", "args": [col("qty")],
+             "dtype": T.FLOAT64, "name": "avg_qty"}]
+    partial = P.hash_agg(proj, "partial", [col("cat3")], ["cat3"], aggs,
+                         T.Schema([T.Field("cat3", T.STRING)]))
+    x = P.shuffle_exchange(partial, [col("cat3")], 4)
+    final = P.hash_agg(
+        x, "final", [col("cat3")], ["cat3"], aggs,
+        T.Schema([T.Field("cat3", T.STRING), T.Field("n", T.INT64),
+                  T.Field("avg_qty", T.FLOAT64)]))
+    srt = P.sort(final, [(col("cat3"), True, True)])
+
+    def oracle():
+        ssd, itd = frames["store_sales"], frames["item"]
+        m = ssd.merge(itd, left_on="ss_item_sk", right_on="i_item_sk")
+        m = m.assign(cat3=m.i_category.str[:3])
+        g = m.groupby("cat3").agg(
+            n=("cat3", "count"),
+            avg_qty=("ss_quantity", "mean")).reset_index()
+        return g.sort_values("cat3").reset_index(drop=True)
+
+    return srt, oracle
+
+
 QUERIES: Dict[str, Callable] = {
     "q1_scan_filter_project": q1_scan_filter_project,
     "q2_q06_core_agg": q2_q06_core_agg,
@@ -335,6 +472,8 @@ QUERIES: Dict[str, Callable] = {
     "q5_multijoin_limit": q5_multijoin_limit,
     "q6_semi_join": q6_semi_join,
     "q7_left_outer_join": q7_left_outer_join,
+    "q8_category_like": q8_category_like,
+    "q9_substr_group": q9_substr_group,
 }
 
 # join-less queries run once (the axis changes nothing)
@@ -366,8 +505,14 @@ def _compare(got: pd.DataFrame, want: pd.DataFrame) -> Optional[str]:
             return f"missing column {c}"
         g = got[c].to_numpy()
         w = want[c].to_numpy()
-        if w.dtype.kind == "f" or g.dtype.kind == "f":
-            bad = ~np.isclose(g.astype(np.float64), w.astype(np.float64),
+        if _is_stringy(w):
+            gs = np.array([x.decode() if isinstance(x, bytes) else x
+                           for x in g], object)
+            bad = gs != w.astype(object)
+        elif w.dtype.kind == "f" or g.dtype.kind == "f" or \
+                w.dtype.kind == "O" or g.dtype.kind == "O":
+            # None/NaN-bearing numerics: object->float maps None to nan
+            bad = ~np.isclose(_as_f64(g), _as_f64(w),
                               rtol=1e-6, equal_nan=True)
         else:
             bad = g.astype(np.int64) != w.astype(np.int64)
@@ -376,6 +521,24 @@ def _compare(got: pd.DataFrame, want: pd.DataFrame) -> Optional[str]:
             return (f"column {c}: {int(bad.sum())} mismatches, first at row "
                     f"{i}: got={g[i]} want={w[i]}")
     return None
+
+
+def _is_stringy(w: np.ndarray) -> bool:
+    if w.dtype.kind in ("U", "S"):
+        return True
+    if w.dtype.kind == "O":
+        for x in w:
+            if x is None:
+                continue
+            return isinstance(x, (str, bytes))
+    return False
+
+
+def _as_f64(a: np.ndarray) -> np.ndarray:
+    if a.dtype.kind == "O":
+        return np.array([np.nan if x is None else float(x) for x in a],
+                        np.float64)
+    return a.astype(np.float64)
 
 
 def _to_pandas(batch) -> pd.DataFrame:

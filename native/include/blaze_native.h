@@ -88,10 +88,27 @@ int bn_init(int64_t mem_budget);
  * caller frees with bn_free_buffer. Returns 0 or negative error. */
 int bn_call(const uint8_t* task_def, int64_t len, uint8_t** out,
             int64_t* out_len);
+/* run a serialized TaskDefinition through an arbitrary
+ * blaze_tpu.runtime.native_entry function returning bytes */
+int bn_call_py(const uint8_t* task_def, int64_t len, const char* entry,
+               uint8_t** out, int64_t* out_len);
 /* last error message (thread-local), empty string if none */
 const char* bn_last_error(void);
 int bn_finalize(void);
 void bn_free_buffer(uint8_t* buf);
+
+/* ---- Arrow C stream export (ref blaze/src/rt.rs:76-80: results flow to
+ * the host as a standard FFI_ArrowArrayStream any Arrow runtime imports;
+ * consumed by ArrowFFIStreamImportIterator.scala:63-75) ---- */
+
+struct ArrowArrayStream; /* Arrow C stream interface (stable ABI) */
+
+/* run a TaskDefinition; expose results as an Arrow C stream */
+int bn_call_arrow(const uint8_t* task_def, int64_t len,
+                  struct ArrowArrayStream* out);
+/* build a stream over a BTAS payload (schema header + BTB1 frames) */
+int bn_arrow_stream_from_payload(const uint8_t* payload, int64_t len,
+                                 struct ArrowArrayStream* out);
 
 #ifdef __cplusplus
 }

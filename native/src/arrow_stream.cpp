@@ -1,0 +1,513 @@
+// Arrow C data/stream interface export for task results.
+//
+// Ref: the reference hands the JVM an FFI_ArrowArrayStream pointer and
+// batches flow zero-copy (blaze/src/rt.rs:76-80, consumed by
+// ArrowFFIStreamImportIterator.scala:63-75). This file gives bn_call the
+// same deployment contract: `bn_call_arrow` runs a serialized
+// TaskDefinition through the engine and exposes the result as a standard
+// ArrowArrayStream — a plain C struct ABI ANY Arrow host (pyarrow, JVM
+// arrow-c-data, arrow-rs) can import without this repo's deserializer.
+//
+// The engine returns a "BTAS" payload (blaze_tpu.runtime.native_entry
+// .run_task_arrow_payload): a schema header (field names + type codes)
+// followed by the BTB1 zstd frames; this file decodes both into Arrow
+// schema/array structures with malloc'd buffers and proper release
+// callbacks. Everything is little-endian (both formats specify LE).
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <zstd.h>
+
+#include "blaze_native.h"
+
+// ---- Arrow C data interface (stable ABI, declared per the Arrow spec) ----
+extern "C" {
+
+#ifndef ARROW_C_DATA_INTERFACE
+#define ARROW_C_DATA_INTERFACE
+
+#define ARROW_FLAG_NULLABLE 2
+
+struct ArrowSchema {
+  const char* format;
+  const char* name;
+  const char* metadata;
+  int64_t flags;
+  int64_t n_children;
+  struct ArrowSchema** children;
+  struct ArrowSchema* dictionary;
+  void (*release)(struct ArrowSchema*);
+  void* private_data;
+};
+
+struct ArrowArray {
+  int64_t length;
+  int64_t null_count;
+  int64_t offset;
+  int64_t n_buffers;
+  int64_t n_children;
+  const void** buffers;
+  struct ArrowArray** children;
+  struct ArrowArray* dictionary;
+  void (*release)(struct ArrowArray*);
+  void* private_data;
+};
+
+#endif  // ARROW_C_DATA_INTERFACE
+
+#ifndef ARROW_C_STREAM_INTERFACE
+#define ARROW_C_STREAM_INTERFACE
+
+struct ArrowArrayStream {
+  int (*get_schema)(struct ArrowArrayStream*, struct ArrowSchema* out);
+  int (*get_next)(struct ArrowArrayStream*, struct ArrowArray* out);
+  const char* (*get_last_error)(struct ArrowArrayStream*);
+  void (*release)(struct ArrowArrayStream*);
+  void* private_data;
+};
+
+#endif  // ARROW_C_STREAM_INTERFACE
+
+}  // extern "C"
+
+namespace {
+
+// ---- payload schema ----
+
+struct FieldDesc {
+  std::string name;
+  uint8_t code;      // native_entry._arrow_code
+  bool nullable;
+  int32_t precision;
+  int32_t scale;
+};
+
+struct StreamState {
+  std::vector<uint8_t> payload;
+  size_t cursor = 0;  // into payload, positioned at the next BTB1 frame
+  std::vector<FieldDesc> fields;
+  std::string last_error;
+};
+
+bool rd(const std::vector<uint8_t>& b, size_t& off, void* out, size_t n) {
+  if (off + n > b.size()) return false;
+  std::memcpy(out, b.data() + off, n);
+  off += n;
+  return true;
+}
+
+bool parse_header(StreamState* st) {
+  size_t off = 0;
+  char magic[4];
+  if (!rd(st->payload, off, magic, 4) || std::memcmp(magic, "BTAS", 4)) {
+    st->last_error = "bad BTAS payload magic";
+    return false;
+  }
+  uint16_t nfields = 0;
+  if (!rd(st->payload, off, &nfields, 2)) return false;
+  for (int i = 0; i < nfields; ++i) {
+    FieldDesc f;
+    uint16_t nlen = 0;
+    if (!rd(st->payload, off, &nlen, 2)) return false;
+    f.name.resize(nlen);
+    if (!rd(st->payload, off, f.name.data(), nlen)) return false;
+    uint8_t nullable = 0;
+    if (!rd(st->payload, off, &f.code, 1)) return false;
+    if (!rd(st->payload, off, &nullable, 1)) return false;
+    f.nullable = nullable != 0;
+    if (!rd(st->payload, off, &f.precision, 4)) return false;
+    if (!rd(st->payload, off, &f.scale, 4)) return false;
+    st->fields.push_back(std::move(f));
+  }
+  st->cursor = off;
+  return true;
+}
+
+// Arrow format string per type code (decimal formats are per-field)
+std::string format_for(const FieldDesc& f) {
+  switch (f.code) {
+    case 1: return "b";            // bool
+    case 2: return "c";            // int8
+    case 3: return "s";            // int16
+    case 4: return "i";            // int32
+    case 5: return "l";            // int64
+    case 6: return "f";            // float32
+    case 7: return "g";            // float64
+    case 8: return "u";            // utf8
+    case 9: return "z";            // binary
+    case 10: return "tdD";         // date32 [days]
+    case 11: return "tsu:";        // timestamp[us], no tz
+    case 12:                       // decimal (int64-backed, p<=18)
+    case 13:                       // wide decimal (int128 limbs)
+      return "d:" + std::to_string(f.precision) + "," +
+             std::to_string(f.scale);
+  }
+  return "";
+}
+
+// ---- schema export ----
+
+void release_schema(struct ArrowSchema* s) {
+  if (!s || !s->release) return;
+  for (int64_t i = 0; i < s->n_children; ++i) {
+    if (s->children[i] && s->children[i]->release)
+      s->children[i]->release(s->children[i]);
+    std::free(s->children[i]);
+  }
+  std::free(s->children);
+  std::free(const_cast<char*>(s->format));
+  std::free(const_cast<char*>(s->name));
+  s->release = nullptr;
+}
+
+char* dup_str(const std::string& s) {
+  char* p = static_cast<char*>(std::malloc(s.size() + 1));
+  std::memcpy(p, s.c_str(), s.size() + 1);
+  return p;
+}
+
+void fill_field_schema(struct ArrowSchema* out, const FieldDesc& f) {
+  std::memset(out, 0, sizeof(*out));
+  out->format = dup_str(format_for(f));
+  out->name = dup_str(f.name);
+  out->flags = f.nullable ? ARROW_FLAG_NULLABLE : 0;
+  out->release = release_schema;
+}
+
+int export_schema(StreamState* st, struct ArrowSchema* out) {
+  std::memset(out, 0, sizeof(*out));
+  out->format = dup_str("+s");  // struct-of-fields = record batch schema
+  out->name = dup_str("");
+  out->n_children = static_cast<int64_t>(st->fields.size());
+  out->children = static_cast<struct ArrowSchema**>(
+      std::malloc(sizeof(void*) * st->fields.size()));
+  for (size_t i = 0; i < st->fields.size(); ++i) {
+    out->children[i] = static_cast<struct ArrowSchema*>(
+        std::malloc(sizeof(struct ArrowSchema)));
+    fill_field_schema(out->children[i], st->fields[i]);
+  }
+  out->release = release_schema;
+  return 0;
+}
+
+// ---- array export ----
+
+struct ArrayPrivate {
+  std::vector<void*> allocs;  // every malloc'd buffer to free on release
+};
+
+void release_array(struct ArrowArray* a) {
+  if (!a || !a->release) return;
+  for (int64_t i = 0; i < a->n_children; ++i) {
+    if (a->children[i] && a->children[i]->release)
+      a->children[i]->release(a->children[i]);
+    std::free(a->children[i]);
+  }
+  std::free(a->children);
+  auto* priv = static_cast<ArrayPrivate*>(a->private_data);
+  if (priv) {
+    for (void* p : priv->allocs) std::free(p);
+    delete priv;
+  }
+  std::free(a->buffers);
+  a->release = nullptr;
+}
+
+void* alloc_tracked(ArrayPrivate* priv, size_t n) {
+  void* p = std::malloc(n ? n : 1);
+  priv->allocs.push_back(p);
+  return p;
+}
+
+// BTB1 column cursor over the decompressed frame payload
+struct Reader {
+  const uint8_t* p;
+  size_t len;
+  size_t off = 0;
+  bool read(void* out, size_t n) {
+    if (off + n > len) return false;
+    std::memcpy(out, p + off, n);
+    off += n;
+    return true;
+  }
+  const uint8_t* take(size_t n) {
+    if (off + n > len) return nullptr;
+    const uint8_t* q = p + off;
+    off += n;
+    return q;
+  }
+};
+
+// read the BTB1 bit-packed validity into an Arrow validity bitmap (same
+// packing: LSB-first) — direct copy; returns null_count via *nulls
+const void* read_validity(Reader& r, ArrayPrivate* priv, int64_t n,
+                          int64_t* nulls) {
+  uint8_t hasv = 0;
+  *nulls = 0;
+  if (!r.read(&hasv, 1)) return reinterpret_cast<const void*>(-1);
+  if (!hasv) return nullptr;
+  size_t nbytes = (n + 7) / 8;
+  const uint8_t* src = r.take(nbytes);
+  if (!src) return reinterpret_cast<const void*>(-1);
+  void* bitmap = alloc_tracked(priv, nbytes);
+  std::memcpy(bitmap, src, nbytes);
+  int64_t set = 0;
+  for (int64_t i = 0; i < n; ++i)
+    if (src[i >> 3] & (1u << (i & 7))) ++set;
+  *nulls = n - set;
+  return bitmap;
+}
+
+bool decode_column(Reader& r, const FieldDesc& f, int64_t n,
+                   struct ArrowArray* out, ArrayPrivate* priv);
+
+bool decode_numeric(Reader& r, const FieldDesc& f, int64_t n,
+                    struct ArrowArray* out, ArrayPrivate* priv,
+                    const void* validity, int64_t nulls) {
+  size_t item = 0;
+  switch (f.code) {
+    case 1: item = 1; break;  // bool stored as u8 bytes in BTB1
+    case 2: item = 1; break;
+    case 3: item = 2; break;
+    case 4: case 10: item = 4; break;
+    case 5: case 11: case 12: item = 8; break;
+    case 6: item = 4; break;
+    case 7: item = 8; break;
+    default: return false;
+  }
+  const uint8_t* src = r.take(item * n);
+  if (!src) return false;
+  out->n_buffers = 2;
+  out->buffers = static_cast<const void**>(std::malloc(sizeof(void*) * 2));
+  out->buffers[0] = validity;
+  if (f.code == 1) {
+    // Arrow bool is bit-packed
+    size_t nbytes = (n + 7) / 8;
+    uint8_t* bits = static_cast<uint8_t*>(alloc_tracked(priv, nbytes));
+    std::memset(bits, 0, nbytes);
+    for (int64_t i = 0; i < n; ++i)
+      if (src[i]) bits[i >> 3] |= (1u << (i & 7));
+    out->buffers[1] = bits;
+  } else if (f.code == 12) {
+    // int64-backed decimal -> Arrow decimal128: sign-extend each value
+    uint8_t* vals = static_cast<uint8_t*>(alloc_tracked(priv, 16 * n));
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t v;
+      std::memcpy(&v, src + 8 * i, 8);
+      int64_t hi = v < 0 ? -1 : 0;
+      std::memcpy(vals + 16 * i, &v, 8);
+      std::memcpy(vals + 16 * i + 8, &hi, 8);
+    }
+    out->buffers[1] = vals;
+  } else {
+    void* data = alloc_tracked(priv, item * n);
+    std::memcpy(data, src, item * n);
+    out->buffers[1] = data;
+  }
+  out->length = n;
+  out->null_count = nulls;
+  return true;
+}
+
+bool decode_string(Reader& r, const FieldDesc& f, int64_t n,
+                   struct ArrowArray* out, ArrayPrivate* priv,
+                   const void* validity, int64_t nulls) {
+  (void)f;
+  uint32_t total = 0;
+  if (!r.read(&total, 4)) return false;
+  const uint8_t* lens = r.take(4ull * n);
+  if (!lens) return false;
+  const uint8_t* payload = r.take(total);
+  if (!payload && total) return false;
+  int32_t* offsets =
+      static_cast<int32_t*>(alloc_tracked(priv, 4 * (n + 1)));
+  offsets[0] = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    uint32_t l;
+    std::memcpy(&l, lens + 4 * i, 4);
+    offsets[i + 1] = offsets[i] + static_cast<int32_t>(l);
+  }
+  void* data = alloc_tracked(priv, total);
+  if (total) std::memcpy(data, payload, total);
+  out->n_buffers = 3;
+  out->buffers = static_cast<const void**>(std::malloc(sizeof(void*) * 3));
+  out->buffers[0] = validity;
+  out->buffers[1] = offsets;
+  out->buffers[2] = data;
+  out->length = n;
+  out->null_count = nulls;
+  return true;
+}
+
+bool decode_wide_decimal(Reader& r, const FieldDesc& f, int64_t n,
+                         struct ArrowArray* out, ArrayPrivate* priv,
+                         const void* validity, int64_t nulls) {
+  (void)f;
+  // BTB1 stores wide decimals as a struct of (hi, lo) int64 limb columns,
+  // each with its own (absent) validity header
+  uint8_t hasv = 0;
+  if (!r.read(&hasv, 1)) return false;
+  if (hasv && !r.take((n + 7) / 8)) return false;
+  const uint8_t* hi = r.take(8ull * n);
+  if (!hi) return false;
+  if (!r.read(&hasv, 1)) return false;
+  if (hasv && !r.take((n + 7) / 8)) return false;
+  const uint8_t* lo = r.take(8ull * n);
+  if (!lo) return false;
+  uint8_t* vals = static_cast<uint8_t*>(alloc_tracked(priv, 16 * n));
+  for (int64_t i = 0; i < n; ++i) {
+    std::memcpy(vals + 16 * i, lo + 8 * i, 8);      // little-endian low
+    std::memcpy(vals + 16 * i + 8, hi + 8 * i, 8);  // then high limb
+  }
+  out->n_buffers = 2;
+  out->buffers = static_cast<const void**>(std::malloc(sizeof(void*) * 2));
+  out->buffers[0] = validity;
+  out->buffers[1] = vals;
+  out->length = n;
+  out->null_count = nulls;
+  return true;
+}
+
+bool decode_column(Reader& r, const FieldDesc& f, int64_t n,
+                   struct ArrowArray* out, ArrayPrivate* priv) {
+  int64_t nulls = 0;
+  const void* validity = read_validity(r, priv, n, &nulls);
+  if (validity == reinterpret_cast<const void*>(-1)) return false;
+  switch (f.code) {
+    case 8: case 9:
+      return decode_string(r, f, n, out, priv, validity, nulls);
+    case 13:
+      return decode_wide_decimal(r, f, n, out, priv, validity, nulls);
+    default:
+      return decode_numeric(r, f, n, out, priv, validity, nulls);
+  }
+}
+
+int decode_next_frame(StreamState* st, struct ArrowArray* out) {
+  std::memset(out, 0, sizeof(*out));
+  if (st->cursor >= st->payload.size()) {
+    out->release = nullptr;  // end of stream
+    return 0;
+  }
+  size_t off = st->cursor;
+  char magic[4];
+  uint32_t raw_len = 0, comp_len = 0;
+  if (!rd(st->payload, off, magic, 4) || std::memcmp(magic, "BTB1", 4) ||
+      !rd(st->payload, off, &raw_len, 4) ||
+      !rd(st->payload, off, &comp_len, 4) ||
+      off + comp_len > st->payload.size()) {
+    st->last_error = "bad BTB1 frame header";
+    return EINVAL;
+  }
+  std::vector<uint8_t> raw(raw_len);
+  size_t got = ZSTD_decompress(raw.data(), raw_len,
+                               st->payload.data() + off, comp_len);
+  if (ZSTD_isError(got) || got != raw_len) {
+    st->last_error = "zstd decompress failed";
+    return EINVAL;
+  }
+  st->cursor = off + comp_len;
+
+  Reader r{raw.data(), raw.size()};
+  uint32_t n = 0;
+  uint16_t ncols = 0;
+  if (!r.read(&n, 4) || !r.read(&ncols, 2) ||
+      ncols != st->fields.size()) {
+    st->last_error = "frame schema mismatch";
+    return EINVAL;
+  }
+
+  auto* priv = new ArrayPrivate();
+  out->length = n;
+  out->null_count = 0;
+  out->n_buffers = 1;
+  out->buffers = static_cast<const void**>(std::malloc(sizeof(void*)));
+  out->buffers[0] = nullptr;  // struct validity
+  out->n_children = ncols;
+  out->children = static_cast<struct ArrowArray**>(
+      std::malloc(sizeof(void*) * ncols));
+  out->private_data = priv;
+  out->release = release_array;
+  for (int i = 0; i < ncols; ++i) {
+    out->children[i] = static_cast<struct ArrowArray*>(
+        std::malloc(sizeof(struct ArrowArray)));
+    std::memset(out->children[i], 0, sizeof(struct ArrowArray));
+    auto* cpriv = new ArrayPrivate();
+    out->children[i]->private_data = cpriv;
+    out->children[i]->release = release_array;
+    if (!decode_column(r, st->fields[i], n, out->children[i], cpriv)) {
+      st->last_error = "column decode failed (field " +
+                       st->fields[i].name + ")";
+      out->n_children = i + 1;  // release what exists
+      release_array(out);
+      std::memset(out, 0, sizeof(*out));
+      return EINVAL;
+    }
+  }
+  return 0;
+}
+
+// ---- stream vtable ----
+
+int stream_get_schema(struct ArrowArrayStream* s, struct ArrowSchema* out) {
+  return export_schema(static_cast<StreamState*>(s->private_data), out);
+}
+
+int stream_get_next(struct ArrowArrayStream* s, struct ArrowArray* out) {
+  return decode_next_frame(static_cast<StreamState*>(s->private_data), out);
+}
+
+const char* stream_get_last_error(struct ArrowArrayStream* s) {
+  auto* st = static_cast<StreamState*>(s->private_data);
+  return st->last_error.empty() ? nullptr : st->last_error.c_str();
+}
+
+void stream_release(struct ArrowArrayStream* s) {
+  if (!s || !s->release) return;
+  delete static_cast<StreamState*>(s->private_data);
+  s->release = nullptr;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Build an ArrowArrayStream over a BTAS payload (schema header + BTB1
+// frames). Takes ownership of a COPY of the payload.
+int bn_arrow_stream_from_payload(const uint8_t* payload, int64_t len,
+                                 struct ArrowArrayStream* out) {
+  auto* st = new StreamState();
+  st->payload.assign(payload, payload + len);
+  if (!parse_header(st)) {
+    delete st;
+    return -1;
+  }
+  out->get_schema = stream_get_schema;
+  out->get_next = stream_get_next;
+  out->get_last_error = stream_get_last_error;
+  out->release = stream_release;
+  out->private_data = st;
+  return 0;
+}
+
+// Run a serialized TaskDefinition and expose the results as an Arrow C
+// stream (the rt.rs:76-80 deployment contract). Negative on error; see
+// bn_last_error.
+int bn_call_arrow(const uint8_t* task_def, int64_t len,
+                  struct ArrowArrayStream* out) {
+  uint8_t* payload = nullptr;
+  int64_t payload_len = 0;
+  int rc = bn_call_py(task_def, len, "run_task_arrow_payload", &payload,
+                      &payload_len);
+  if (rc != 0) return rc;
+  rc = bn_arrow_stream_from_payload(payload, payload_len, out);
+  bn_free_buffer(payload);
+  return rc;
+}
+
+}  // extern "C"

@@ -141,8 +141,8 @@ int bn_init(int64_t mem_budget) {
   return rc;
 }
 
-int bn_call(const uint8_t* task_def, int64_t len, uint8_t** out,
-            int64_t* out_len) {
+int bn_call_py(const uint8_t* task_def, int64_t len, const char* entry,
+               uint8_t** out, int64_t* out_len) {
   if (!load_py_api()) {
     g_last_error = "python runtime not available";
     return -1;
@@ -157,7 +157,7 @@ int bn_call(const uint8_t* task_def, int64_t len, uint8_t** out,
     g_py.GILState_Release(gil);
     return -2;
   }
-  void* fn = g_py.Object_GetAttrString(mod, "run_task_serialized");
+  void* fn = g_py.Object_GetAttrString(mod, entry);
   if (!fn) {
     capture_py_error();
     g_py.Dec(mod);
@@ -174,7 +174,7 @@ int bn_call(const uint8_t* task_def, int64_t len, uint8_t** out,
     ssize_t sz = g_py.Bytes_Size(res);
     char* data = g_py.Bytes_AsString(res);
     if (sz < 0 || !data) {
-      g_last_error = "run_task_serialized must return bytes";
+      g_last_error = "task entry must return bytes";
       rc = -5;
     } else {
       *out = static_cast<uint8_t*>(std::malloc(sz));
@@ -188,6 +188,11 @@ int bn_call(const uint8_t* task_def, int64_t len, uint8_t** out,
   g_py.Dec(mod);
   g_py.GILState_Release(gil);
   return rc;
+}
+
+int bn_call(const uint8_t* task_def, int64_t len, uint8_t** out,
+            int64_t* out_len) {
+  return bn_call_py(task_def, len, "run_task_serialized", out, out_len);
 }
 
 int bn_finalize(void) {

@@ -1,0 +1,94 @@
+"""Host-side row-encoded keys + spill-run merge (ops/host_sort.py)."""
+
+import io
+
+import numpy as np
+import pytest
+
+from blaze_tpu.columnar import serde
+from blaze_tpu.columnar import types as T
+from blaze_tpu.columnar.batch import ColumnBatch
+from blaze_tpu.ops import host_sort
+from blaze_tpu.ops.sort_keys import SortSpec
+
+
+def _host(d, schema, validity=None):
+    b = ColumnBatch.from_numpy(d, schema, validity=validity)
+    return serde.deserialize_batch_host(serde.serialize_batch(b), schema)
+
+
+def test_merge_mixed_validity_runs():
+    """Regression (code review): a nullable column's key width must not
+    depend on whether a given FRAME carries a validity array — one run
+    saw no nulls (validity None), the other did; the merge must still
+    interleave in order."""
+    schema = T.Schema([T.Field("v", T.INT64)])
+    run_a = _host({"v": np.array([1, 5], np.int64)}, schema)
+    run_b = _host({"v": np.array([2, 3], np.int64)}, schema,
+                  validity={"v": np.array([True, True])})
+    out = list(host_sort.merge_sorted_host(
+        [iter([run_a]), iter([run_b])], [SortSpec(0)], 1 << 20))
+    merged = np.concatenate([hb.cols[0].data for hb in out])
+    assert list(merged) == [1, 2, 3, 5]
+
+
+def test_merge_with_nulls_and_strings():
+    schema = T.Schema([T.Field("s", T.STRING), T.Field("v", T.FLOAT64)])
+    a = _host({"s": [b"apple", b"pear"], "v": np.array([1.0, 2.0])},
+              schema, validity={"v": np.array([True, False])})
+    b = _host({"s": [b"banana", b"zoo"], "v": np.array([0.5, 9.0])},
+              schema)
+    specs = [SortSpec(0, True, True)]
+    # pre-sort each run by s, then merge
+    pa_ = host_sort.host_take(a, host_sort.sort_perm(a, specs))
+    pb_ = host_sort.host_take(b, host_sort.sort_perm(b, specs))
+    out = list(host_sort.merge_sorted_host(
+        [iter([pa_]), iter([pb_])], specs, 1 << 20))
+    merged = host_sort.host_concat(out)
+    got = host_to_strings(merged, 0)
+    assert got == [b"apple", b"banana", b"pear", b"zoo"]
+
+
+def host_to_strings(hb, col):
+    c = hb.cols[col]
+    return [bytes(c.data[i, :c.lengths[i]]) for i in range(hb.num_rows)]
+
+
+def test_sort_perm_matches_device_order():
+    """Host byte-key order == device lax.sort order for mixed dtypes with
+    nulls (exact equivalence on the CPU backend: both use IEEE f64)."""
+    rng = np.random.default_rng(5)
+    n = 500
+    schema = T.Schema([T.Field("k", T.INT32), T.Field("f", T.FLOAT64),
+                       T.Field("s", T.STRING)])
+    d = {"k": rng.integers(-50, 50, n).astype(np.int32),
+         "f": np.round(rng.random(n) * 10 - 5, 3),
+         "s": [bytes(rng.choice([b"aa", b"ab", b"zz", b"a", b""]))
+               for _ in range(n)]}
+    validity = {"f": rng.random(n) > 0.2}
+    b = ColumnBatch.from_numpy(d, schema, validity=validity)
+    specs = [SortSpec(1, False, True), SortSpec(0, True, False),
+             SortSpec(2, True, True)]
+    from blaze_tpu.ops.sort_keys import sort_batch
+
+    want = sort_batch(b, specs).to_numpy()
+    hb = serde.deserialize_batch_host(serde.serialize_batch(b), schema)
+    got = host_sort.host_take(hb, host_sort.sort_perm(hb, specs))
+    gk = got.cols[0].data
+    assert list(gk) == [int(x) for x in np.asarray(want["k"])]
+    gf = [None if got.cols[1].validity is not None
+          and not got.cols[1].validity[i] else float(got.cols[1].data[i])
+          for i in range(n)]
+    wf = [None if x is None else float(x) for x in want["f"]]
+    assert gf == wf
+
+
+def test_host_supported_rejects_nested_list():
+    """Regression (code review): a STRUCT containing a LIST must keep the
+    device paths — host decode cannot slice list storage."""
+    inner = T.Schema([T.Field("xs", T.list_of(T.INT64))])
+    st = T.DataType(T.TypeKind.STRUCT, fields=tuple(inner.fields))
+    schema = T.Schema([T.Field("s", st)])
+    assert not host_sort.host_supported(schema)
+    assert host_sort.host_supported(
+        T.Schema([T.Field("v", T.INT64), T.Field("s", T.STRING)]))

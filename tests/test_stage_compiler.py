@@ -405,3 +405,92 @@ def test_fallback_with_join_source(rng):
                                   want["n"].loc[ks])
     np.testing.assert_allclose([float(x) for x in d["rev"]],
                                want["rev"].loc[ks], rtol=1e-9)
+
+
+MM_CALLS = [AggCall("min", (col("v"),), T.FLOAT64, "mn"),
+            AggCall("max", (col("v"),), T.FLOAT64, "mx"),
+            AggCall("min", (col("n"),), T.INT32, "imn"),
+            AggCall("max", (col("n"),), T.INT32, "imx"),
+            AggCall("first_ignores_null", (col("v"),), T.FLOAT64, "fst"),
+            AggCall("sum", (col("v"),), T.FLOAT64, "sv")]
+
+
+def _mm_plan(batches, modes=(AggMode.PARTIAL, AggMode.FINAL)):
+    node = MemorySourceExec(batches, SCHEMA)
+    node = FilterExec(node, [ir.Binary(BinOp.GE, col("v"),
+                                       ir.Literal(T.FLOAT64, -1.0))])
+    for mode in modes:
+        node = AggExec(node, [col("k")], ["k"], MM_CALLS, mode)
+    return node
+
+
+def test_minmax_first_stage_matches_streaming(rng):
+    """min/max/first ride dense segment carriers in the whole-stage
+    program (VERDICT r4 #1b); results must equal the streaming path."""
+    batches = _batches(rng, 3, 500, null_frac=0.25)
+    plan = _mm_plan(batches)
+    got = collect(plan).to_numpy()
+    assert plan.metrics["stage_compiled"] == 1
+    conf.enable_stage_compiler = False
+    try:
+        want = collect(_mm_plan(batches)).to_numpy()
+    finally:
+        conf.enable_stage_compiler = True
+    assert list(np.asarray(got["k"])) == list(np.asarray(want["k"]))
+    for name in ("mn", "mx", "imn", "imx", "fst"):
+        g, w = got[name], want[name]
+        for a, b in zip(g, w):
+            if b is None:
+                assert a is None, (name, a, b)
+            else:
+                np.testing.assert_allclose(float(a), float(b), rtol=1e-9)
+
+    # pandas oracle for min/max (first is order-dependent; streaming
+    # comparison above covers it)
+    frames = []
+    for b in batches:
+        d = b.to_numpy()
+        frames.append(pd.DataFrame(
+            {"k": np.asarray(d["k"]),
+             "v": [None if x is None else float(x) for x in d["v"]],
+             "n": np.asarray(d["n"])}))
+    df = pd.concat(frames)
+    df = df[df.v.astype(float).fillna(-1e30) >= -1.0]
+    want_pd = df.groupby("k").agg(mn=("v", "min"), mx=("v", "max"),
+                                  imn=("n", "min"), imx=("n", "max"))
+    ks = np.asarray(got["k"])
+    for i, k in enumerate(ks):
+        np.testing.assert_allclose(float(got["mn"][i]),
+                                   want_pd.loc[k, "mn"], rtol=1e-9)
+        np.testing.assert_allclose(float(got["mx"][i]),
+                                   want_pd.loc[k, "mx"], rtol=1e-9)
+        assert int(got["imn"][i]) == int(want_pd.loc[k, "imn"])
+        assert int(got["imx"][i]) == int(want_pd.loc[k, "imx"])
+
+
+def test_minmax_partial_state_columns(rng):
+    """Partial-only min/max stage emits [val, has] typed state columns the
+    FINAL merge consumes (shuffle map side)."""
+    batches = _batches(rng, 2, 400, null_frac=0.3)
+    partial = _mm_plan(batches, modes=(AggMode.PARTIAL,))
+    got = collect(partial)
+    assert partial.metrics["stage_compiled"] == 1
+    conf.enable_stage_compiler = False
+    try:
+        want = collect(_mm_plan(batches, modes=(AggMode.PARTIAL,)))
+    finally:
+        conf.enable_stage_compiler = True
+    gd, wd = got.to_numpy(), want.to_numpy()
+    assert set(gd.keys()) == set(wd.keys())
+    # group order may differ (dense slots vs sort); compare sorted by key
+    gk, wk = np.argsort(np.asarray(gd["k"])), np.argsort(np.asarray(wd["k"]))
+    for name in gd:
+        g = np.asarray(gd[name], dtype=object)[gk]
+        w = np.asarray(wd[name], dtype=object)[wk]
+        for a, b in zip(g, w):
+            if b is None or a is None:
+                assert (a is None) == (b is None), (name, a, b)
+            elif isinstance(b, (bool, np.bool_)):
+                assert bool(a) == bool(b), (name, a, b)
+            else:
+                np.testing.assert_allclose(float(a), float(b), rtol=1e-9)
