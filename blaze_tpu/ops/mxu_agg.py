@@ -59,13 +59,29 @@ import numpy as np
 Array = jax.Array
 
 CHUNK_BITS = 8
-F64_CHUNKS = 6          # 46-bit effective precision (see _float_digits)
 I64_CHUNKS = 8          # full int64 (|v| < 2^62; sums exact within 2^53)
 MAX_RANGE = 1 << 16
 _GL = 128
 
-# balanced-digit biases: digits of (v + BIAS) are the balanced digits + 128
-_BIAS6 = np.int64(128 * ((1 << 48) - 1) // 255)     # 6-chunk (f64 path)
+
+def f64_chunks() -> int:
+    """Float-sum digit plane count (conf.float_sum_digit_planes): 5 =
+    38-bit digitization of the per-stage max (default), 6 = 46-bit (the
+    emulated-f64 mantissa class). Clamped to 7 — the signed-int64 bias
+    arithmetic of _float_words caps at 2^56-scale magnitudes (int sums
+    use the exact uint64 8-chunk path separately). Callers must key
+    compiled programs on this value — it is a trace-time static."""
+    from blaze_tpu.config import conf
+
+    return max(4, min(int(conf.float_sum_digit_planes), 7))
+
+
+def _bias_f(nch: int) -> np.int64:
+    """Balanced-digit bias for an nch-chunk float path: digits of
+    (v + bias) are the balanced digits + 128."""
+    return np.int64(128 * ((1 << (CHUNK_BITS * nch)) - 1) // 255)
+
+
 _BIAS8 = np.uint64(128 * ((1 << 64) - 1) // 255)    # 8-chunk (i64 path)
 
 # pallas fused path (TPU only): the XLA formulation materializes the
@@ -84,7 +100,17 @@ def _pick_tile(n: int, gh: int, pgl: int):
     T=2048 and P=33 @ any T fail — i.e. accumulator alone must stay
     <= ~16M and the combined total <= ~20M. T floors at 1024 (the
     smaller-tile regime is untested-territory that ALSO failed at
-    P=29/T=512); T=4096 measured fastest where it fits."""
+    P=29/T=512); T=4096 measured fastest where it fits.
+
+    A double-buffered producer/consumer split (build tile i+1's operands
+    while tile i's dot runs — PROFILE_r04 remaining-headroom item) was
+    built and MEASURED SLOWER in round 5: the extra scratch pushes
+    T=4096 past the 16M scoped-vmem limit (16.62M), and at T=2048 the
+    pipelined kernel ran 7.5ms vs the serial kernel's 5.4ms per 2^21-row
+    batch (P=7). The serial kernel already runs at ~91% of the s8 matmul
+    roofline (5.4ms vs 4.9ms floor = 2*n*R*P / 394 TOPS) — round 4's
+    "19% MXU" figure divided by a mistaken 80ms/rep floor; the correct
+    floor for 64 batches at P=7 is ~313ms/rep."""
     acc2 = 2 * gh * pgl * 4
     if acc2 > 16 << 20:
         return None
@@ -264,20 +290,23 @@ def _accumulate_planes(keys: Array, valid: Array, words, recipe, gh: int,
 
 def _float_words(v: Array, ok: Array, fixed_s=None):
     """Balanced base-256 digitization of round(v * 2^s), as i32 word
-    columns + recipe entries (6 planes).
+    columns + recipe entries (f64_chunks() planes — 5 by default, the
+    conf.float_sum_digit_planes precision policy).
 
-    s scales the batch max to 46 bits: |scaled| <= 2^46 stays inside the
-    asymmetric balanced-6-digit range (-128*(2^48-1)/255 ..
-    127*(2^48-1)/255). Returns (words, entries, s, bad) — bad is True
-    when any contributing value is non-finite (digits would be garbage;
-    caller must fall back).
+    s scales the batch max to 8*nch-2 bits: |scaled| stays inside the
+    asymmetric balanced-digit range (-128*(2^(8nch)-1)/255 ..
+    127*(2^(8nch)-1)/255). Returns (words, entries, s, bad) — bad is
+    True when any contributing value is non-finite (digits would be
+    garbage; caller must fall back).
 
     fixed_s: a STATIC scale chosen by the caller (the stage compiler
     probes a per-stage scale the way it probes key ranges, so every
     batch shares one scale and the scan carry stays in integer space —
     no per-batch emulated-f64 multiply-accumulate). bad then also trips
-    when a value overflows the fixed scale's 46-bit headroom, driving
-    the caller's re-probe/fallback loop."""
+    when a value overflows the fixed scale's headroom, driving the
+    caller's re-probe/fallback loop."""
+    nch = f64_chunks()
+    cap_bits = float(CHUNK_BITS * nch - 2)
     finite = jnp.isfinite(v)
     bad = jnp.any(ok & ~finite)
     v = jnp.where(ok & finite, v, 0.0).astype(jnp.float64)
@@ -286,7 +315,7 @@ def _float_words(v: Array, ok: Array, fixed_s=None):
         maxv = jnp.max(absv)
         exp = jnp.floor(jnp.log2(jnp.maximum(maxv, 1e-300))) + 1.0
         # clamp so exp2(s) stays finite when the batch max is 0/denormal
-        s = jnp.minimum((CHUNK_BITS * F64_CHUNKS - 2) - exp, 1000.0)
+        s = jnp.minimum(cap_bits - exp, 1000.0)
     else:
         s = jnp.asarray(fixed_s, jnp.float64)
         # overflow must be tested in the FLOAT domain, before the cast:
@@ -294,16 +323,16 @@ def _float_words(v: Array, ok: Array, fixed_s=None):
         # cvttsd2si yields int64_min for BOTH signs), and
         # |int64_min| is itself negative — a post-cast abs-compare
         # would stay silent exactly when the data overflowed
-        bad = bad | jnp.any(ok & (absv > jnp.exp2(46.0 - s)))
+        bad = bad | jnp.any(ok & (absv > jnp.exp2(cap_bits - s)))
     scaled = jnp.round(v * jnp.exp2(s)).astype(jnp.int64)
-    u = scaled + _BIAS6
+    u = scaled + _bias_f(nch)
     # i32 halves: int64 shifts lower to 2x-i32 emulation on TPU, and the
     # pallas kernel wants lane-compact i32 columns anyway
     lo = (u & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32).view(jnp.int32)
-    hi = (u >> 32).astype(jnp.int32)   # < 2^16, non-negative
+    hi = (u >> 32).astype(jnp.int32)   # non-negative
     words = [lo, hi]
-    entries = [("digit", 0, 0), ("digit", 0, 8), ("digit", 0, 16),
-               ("digit", 0, 24), ("digit", 1, 0), ("digit", 1, 8)]
+    entries = ([("digit", 0, sh) for sh in (0, 8, 16, 24)[:min(nch, 4)]]
+               + [("digit", 1, sh) for sh in (0, 8, 16, 24)[:nch - 4]])
     return words, entries, s, bad
 
 
@@ -451,7 +480,7 @@ def finalize(acc: Array, layout, rng: int, scales=None):
             outs.append(jnp.round(plane).astype(jnp.int64))
             continue
         if kind == "sumf":
-            nch = F64_CHUNKS
+            nch = f64_chunks()
             flat = _recombine(acc.astype(jnp.float64), start, nch
                               ).reshape(gh * _GL)[:rng]
             if scales is not None and si in scales:

@@ -319,15 +319,17 @@ def try_run_stage(root: Operator, ctx: ExecContext, deferred: bool = False,
         kmins_v, kmaxs_v, vmaxs_v, bad_v = probe(*batches)
         if bool(bad_v):
             return None  # null grouping keys: dense slots can't hold them
-        # fixed float scales: 44-bit headroom over the probed max (2
-        # spare bits, so values drifting up to 4x on later data still
-        # digitize; beyond that the in-program overflow flag re-probes)
+        # fixed float scales: 2 spare bits of headroom under the digit
+        # capacity (8*planes-2) over the probed max, so values drifting
+        # up to 4x on later data still digitize; beyond that the
+        # in-program overflow flag re-probes
+        cap_bits = 8.0 * mxu_agg.f64_chunks() - 4.0
         scales = []
         for j, ci in enumerate(float_calls):
             vmax = float(np.asarray(vmaxs_v)[j])
             exp = (math.floor(math.log2(vmax)) + 1.0
                    if vmax > 0.0 else -996.0)
-            scales.append((ci, min(44.0 - exp, 1000.0)))
+            scales.append((ci, min(cap_bits - exp, 1000.0)))
         spans, kmins = [], []
         for lo, hi in zip(np.asarray(kmins_v), np.asarray(kmaxs_v)):
             # power-of-two headroom per key: exact spans would invalidate
@@ -374,7 +376,7 @@ def try_run_stage(root: Operator, ctx: ExecContext, deferred: bool = False,
             if has_validity[i]:
                 n_planes += 1
             if call.fn in ("sum", "avg"):
-                n_planes += (mxu_agg.F64_CHUNKS if sum_is_float[i]
+                n_planes += (mxu_agg.f64_chunks() if sum_is_float[i]
                              else mxu_agg.I64_CHUNKS)
 
         # map the probed per-CALL fixed scales onto SPEC indices (the
@@ -693,8 +695,9 @@ def try_run_stage(root: Operator, ctx: ExecContext, deferred: bool = False,
             strides.append(acc)
             acc *= sp
         strides = list(reversed(strides))
+        # float_sum_digit_planes is a trace-time static of the program
         key = ("stage", root.plan_key(), shape0, len(batches),
-               spans, kmins, scales)
+               spans, kmins, scales, mxu_agg.f64_chunks())
         fn = jit_cache.get_or_compile(key, make)
         out, flags = fn(*batches)
         if deferred:

@@ -479,6 +479,8 @@ def _convert_smj(plan: SparkPlan) -> pb.PlanNode:
         on.right.CopyFrom(encode_expr(rkey))
     jt = plan.attrs["join_type"]
     j.join_type = _JOIN_TYPE[jt]
+    if jt == "existence":
+        j.existence_name = plan.attrs.get("existence_name", "exists")
     cond = plan.attrs.get("condition")
     if cond is not None:
         if jt != "inner" and not conf.enable_smj_inequality_join:
@@ -501,6 +503,8 @@ def _convert_bhj(plan: SparkPlan) -> pb.PlanNode:
         on.left.CopyFrom(encode_expr(lkey))
         on.right.CopyFrom(encode_expr(rkey))
     j.join_type = _JOIN_TYPE[plan.attrs["join_type"]]
+    if plan.attrs["join_type"] == "existence":
+        j.existence_name = plan.attrs.get("existence_name", "exists")
     # ref :420-434 — the reference rewrites build-side-left plans by
     # flipping children + join type; our engine takes build_is_left directly
     j.build_is_left = plan.attrs.get("build_side", "right") == "left"

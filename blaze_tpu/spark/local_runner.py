@@ -132,7 +132,7 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
                 shuffle_bytes[stage.stage_id] = logical
                 run_info["file_stages"] += 1
             elif stage.kind == "broadcast":
-                _run_broadcast_stage(stage)
+                _run_broadcast_stage(stage, stages)
                 run_info["broadcast_stages"] += 1
             else:
                 parts = _input_tasks(stage, stages, fallback=num_partitions)
@@ -222,13 +222,45 @@ def _run_shuffle_stage(stage: Stage, stages: List[Stage],
     return logical
 
 
-def _run_broadcast_stage(stage: Stage) -> None:
+def _run_broadcast_stage(stage: Stage, stages: List[Stage]) -> None:
+    # a broadcast stage runs ONE task but must see its upstream shuffles'
+    # WHOLE output — a plan like broadcast(final_agg(exchange(...)))
+    # would otherwise read only partition 0 and broadcast a quarter of
+    # the relation (caught by the tpcds q01 catalogue cell)
+    _rewrite_shuffle_readers_all(stage.plan, stages)
     frames: List[bytes] = []
     resources.put(f"broadcast_sink:{stage.stage_id}", frames.append)
     op = decode_plan(stage.plan)
     list(execute_plan(op, ExecContext(partition=0, num_partitions=1)))
     resources.put(f"broadcast:{stage.stage_id}",
                   lambda partition=0: iter(list(frames)))
+
+
+def _rewrite_shuffle_readers_all(node: pb.PlanNode,
+                                 stages: List[Stage]) -> None:
+    """Point every shuffle ipc_reader under `node` at the chained
+    all-partitions resource (spark/aqe.py registers it on demand)."""
+    from blaze_tpu.spark.aqe import _all_partitions_resource
+
+    which = node.WhichOneof("node")
+    if which is None:
+        return
+    if which == "ipc_reader":
+        rid = node.ipc_reader.provider_resource_id
+        if rid.startswith("shuffle:") and not rid.endswith(":all"):
+            sid = int(rid.split(":", 1)[1])
+            node.ipc_reader.provider_resource_id = \
+                _all_partitions_resource(rid, stages[sid].num_partitions)
+        return
+    inner = getattr(node, which)
+    for fd, val in inner.ListFields():
+        if fd.message_type is not None and \
+                fd.message_type.name == "PlanNode":
+            if fd.is_repeated:
+                for child in val:
+                    _rewrite_shuffle_readers_all(child, stages)
+            else:
+                _rewrite_shuffle_readers_all(val, stages)
 
 
 def _root_sort_split(op):

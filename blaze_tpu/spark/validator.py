@@ -548,20 +548,32 @@ def _to_pandas(batch) -> pd.DataFrame:
 
 def run_matrix(tmpdir: str, rows: int = 20_000,
                queries: Optional[List[str]] = None,
-               spill_budget: Optional[int] = None) -> List[Result]:
+               spill_budget: Optional[int] = None,
+               suite: str = "core") -> List[Result]:
     """spill_budget: when set, MemManager is (re)initialized to this many
     bytes before every cell so sort/agg/shuffle spill fires IN QUERY
     CONTEXT (the reference fuzz-gates a 1.23M-row external sort under
     MemManager::init(10000), sort_exec.rs:954) — each Result then records
-    the spill counters the run produced."""
+    the spill counters the run produced.
+
+    suite: "core" = the BASELINE config shapes in this module;
+    "tpcds" = the hand-constructed TPC-DS q01-q10 catalogue
+    (spark/tpcds.py, the north-star queries)."""
     from blaze_tpu.runtime import memory as M
 
-    paths, frames = generate_tables(tmpdir, rows=rows)
+    if suite == "tpcds":
+        from blaze_tpu.spark import tpcds
+
+        paths, frames = tpcds.generate_tables(tmpdir, rows=rows)
+        catalogue, joinless = tpcds.QUERIES, tpcds.JOINLESS
+    else:
+        paths, frames = generate_tables(tmpdir, rows=rows)
+        catalogue, joinless = QUERIES, _JOINLESS
     results: List[Result] = []
-    for name, build in QUERIES.items():
+    for name, build in catalogue.items():
         if queries and name not in queries:
             continue
-        modes = ["bhj"] if name in _JOINLESS else ["bhj", "smj"]
+        modes = ["bhj"] if name in joinless else ["bhj", "smj"]
         for mode in modes:
             t0 = time.time()
             mgr = M.init(spill_budget) if spill_budget else M.get_manager()

@@ -28,14 +28,26 @@ def main() -> int:
                     "sort/agg/shuffle spill in query context)")
     ap.add_argument("--json-out", type=str, default="",
                     help="also write the per-cell results as JSON")
+    ap.add_argument("--suite", type=str, default="core",
+                    choices=["core", "tpcds", "all"],
+                    help="core = BASELINE config shapes; tpcds = the "
+                    "hand-constructed TPC-DS q01-q10 catalogue")
     args = ap.parse_args()
 
     from blaze_tpu.spark.validator import print_report, run_matrix
 
     queries = [q for q in args.queries.split(",") if q] or None
+    suites = (["core", "tpcds"] if args.suite == "all" else [args.suite])
+    results = []
+    import os
+
     with tempfile.TemporaryDirectory(prefix="blaze_tpu_validate_") as tmp:
-        results = run_matrix(tmp, rows=args.rows, queries=queries,
-                             spill_budget=args.spill_budget or None)
+        for suite in suites:
+            os.makedirs(f"{tmp}/{suite}", exist_ok=True)
+            results += run_matrix(f"{tmp}/{suite}", rows=args.rows,
+                                  queries=queries,
+                                  spill_budget=args.spill_budget or None,
+                                  suite=suite)
     ok = print_report(results)
     if args.json_out:
         import dataclasses
