@@ -244,19 +244,22 @@ class _AggState:
         self.state_bytes += self._M.batch_nbytes(s)
 
     def add_raw(self, work: ColumnBatch) -> None:
-        self.raw.append(work)
-        self.raw_rows += int(work.num_rows)
-        self.raw_bytes += self._M.batch_nbytes(work)
-        if self.raw_rows >= self.op.collapse_threshold:
-            self._collapse_all()
-        self.manager.update_mem_used(self)
+        # op_lock: serialize against host-driven release() (bn_spill)
+        with self.manager.op_lock:
+            self.raw.append(work)
+            self.raw_rows += int(work.num_rows)
+            self.raw_bytes += self._M.batch_nbytes(work)
+            if self.raw_rows >= self.op.collapse_threshold:
+                self._collapse_all()
+            self.manager.update_mem_used(self)
 
     def add_state(self, batch: ColumnBatch) -> None:
-        self._push_state(batch)
-        self.states_external = True
-        if len(self.states) >= 16:
-            self._collapse_all()
-        self.manager.update_mem_used(self)
+        with self.manager.op_lock:
+            self._push_state(batch)
+            self.states_external = True
+            if len(self.states) >= 16:
+                self._collapse_all()
+            self.manager.update_mem_used(self)
 
     def merged(self) -> ColumnBatch:
         self._collapse_all()

@@ -250,8 +250,10 @@ class _NativeWriterState:
         return before - self.mem_used()
 
     def push(self, p: int, frame: bytes) -> None:
-        self._w.push(p, frame)
-        self.manager.update_mem_used(self)
+        # op_lock: serialize against host-driven release() (bn_spill)
+        with self.manager.op_lock:
+            self._w.push(p, frame)
+            self.manager.update_mem_used(self)
 
     def commit(self, data_path: str, index_path: str) -> List[int]:
         return list(self._w.commit(data_path, index_path))
@@ -309,9 +311,10 @@ class _WriterBuffers:
         return freed
 
     def push(self, p: int, frame: bytes) -> None:
-        self.buffers[p].append(frame)
-        self.bytes += len(frame)
-        self.manager.update_mem_used(self)
+        with self.manager.op_lock:
+            self.buffers[p].append(frame)
+            self.bytes += len(frame)
+            self.manager.update_mem_used(self)
 
     def drain(self, p: int):
         for off, ln in self._spill_segs[p]:

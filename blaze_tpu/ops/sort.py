@@ -131,9 +131,12 @@ class ExternalSorter:
         return freed
 
     def add(self, batch: ColumnBatch) -> None:
-        self.pending.append(batch)
-        self.pending_bytes += self._M.batch_nbytes(batch)
-        self.manager.update_mem_used(self)
+        # op_lock: a host-driven release() (bn_spill) must not run
+        # spill() between the append and the accounting update
+        with self.manager.op_lock:
+            self.pending.append(batch)
+            self.pending_bytes += self._M.batch_nbytes(batch)
+            self.manager.update_mem_used(self)
 
     def finish(self):
         try:

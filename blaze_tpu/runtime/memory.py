@@ -46,6 +46,12 @@ class MemManager:
         self.total = total or conf.memory_budget or (1 << 30)
         self._consumers: List[MemConsumer] = []
         self._lock = threading.Lock()
+        # serializes consumer-STATE mutation against host-driven spills
+        # (bn_spill runs on a host thread while a task thread may be
+        # mid-add on the same consumer): consumers hold it while adding
+        # state, release() holds it while spilling. RLock so a task
+        # thread's own add -> update_mem_used -> spill chain re-enters.
+        self.op_lock = threading.RLock()
         self.spill_count = 0
         self.spilled_bytes = 0
 
@@ -108,6 +114,31 @@ class MemManager:
         if freed > 0:
             self.spill_count += 1
             self.spilled_bytes += freed
+
+    def release(self, bytes_needed: int) -> int:
+        """Host-driven reclamation (ref OnHeapSpillManager.scala:61-144:
+        Spark's memory manager can force executor spill state to disk
+        under heap pressure; the C ABI exposes this as bn_spill so the
+        embedding layer can reclaim without killing the task). Spills
+        the largest consumers first until `bytes_needed` is freed; a
+        consumer that yields nothing is skipped, not a stop condition
+        (smaller spillable consumers behind it must still drain).
+        Returns bytes actually freed."""
+        freed = 0
+        with self.op_lock:
+            with self._lock:
+                candidates = sorted(list(self._consumers),
+                                    key=lambda c: -c.mem_used())
+            for c in candidates:
+                if freed >= bytes_needed:
+                    break
+                if c.mem_used() <= 0:
+                    continue
+                got = c.spill()
+                self._note_spill(got)
+                if got > 0:
+                    freed += got
+        return freed
 
 
 _global = MemManager()

@@ -26,6 +26,19 @@ def init(mem_budget_bytes: bytes) -> None:
         memory.init(budget)
 
 
+def spill(bytes_needed_le: bytes) -> bytes:
+    """bn_spill hook: the HOST (the JVM's memory manager in deployment)
+    asks the engine to release memory — operator state spills to disk
+    and the freed byte count returns (little-endian i64). Ref:
+    OnHeapSpillManager.scala:61-144, where Spark-tracked spill pages
+    drop to disk under heap pressure."""
+    from blaze_tpu.runtime import memory
+
+    (needed,) = struct.unpack("<q", bytes_needed_le)
+    freed = memory.get_manager().release(max(int(needed), 0))
+    return struct.pack("<q", freed)
+
+
 def run_task_serialized(task_def: bytes) -> bytes:
     from blaze_tpu.plan import decode_task_definition
 

@@ -84,7 +84,7 @@ void bn_shuffle_free(bn_shuffle_writer* w);
 /* initialize the engine (idempotent): memory budget in bytes */
 int bn_init(int64_t mem_budget);
 /* run a serialized TaskDefinition through the Python engine; on success
- * *out/*out_len hold a malloc'd concatenation of BTB1 result frames the
+ * out/out_len hold a malloc'd concatenation of BTB1 result frames the
  * caller frees with bn_free_buffer. Returns 0 or negative error. */
 int bn_call(const uint8_t* task_def, int64_t len, uint8_t** out,
             int64_t* out_len);
@@ -92,6 +92,10 @@ int bn_call(const uint8_t* task_def, int64_t len, uint8_t** out,
  * blaze_tpu.runtime.native_entry function returning bytes */
 int bn_call_py(const uint8_t* task_def, int64_t len, const char* entry,
                uint8_t** out, int64_t* out_len);
+/* host-driven memory reclamation: ask the engine to spill operator
+ * state until `bytes_needed` is freed (ref OnHeapSpillManager's
+ * pressure-driven spill-to-disk). Returns bytes freed, or -1. */
+int64_t bn_spill(int64_t bytes_needed);
 /* last error message (thread-local), empty string if none */
 const char* bn_last_error(void);
 int bn_finalize(void);

@@ -195,6 +195,23 @@ int bn_call(const uint8_t* task_def, int64_t len, uint8_t** out,
   return bn_call_py(task_def, len, "run_task_serialized", out, out_len);
 }
 
+int64_t bn_spill(int64_t bytes_needed) {
+  // host-driven memory reclamation (ref OnHeapSpillManager.scala:61-144
+  // — Spark's memory manager forces spill state to disk under pressure)
+  uint8_t* out = nullptr;
+  int64_t out_len = 0;
+  int rc = bn_call_py(reinterpret_cast<const uint8_t*>(&bytes_needed),
+                      sizeof(bytes_needed), "spill", &out, &out_len);
+  if (rc != 0 || out_len != sizeof(int64_t)) {
+    if (out) bn_free_buffer(out);
+    return -1;
+  }
+  int64_t freed;
+  std::memcpy(&freed, out, sizeof(freed));
+  bn_free_buffer(out);
+  return freed;
+}
+
 int bn_finalize(void) {
   g_last_error.clear();
   return 0;

@@ -253,3 +253,39 @@ def test_arrow_stream_nulls_and_decimal(native):
     assert table.column("d").to_pylist() == [
         Decimal("1.25"), Decimal("-2.50"), Decimal("0.00"),
         Decimal("9.99")]
+
+
+def test_native_spill_hook(native):
+    """bn_spill: the HOST asks the engine to release memory (the
+    OnHeapSpillManager pressure contract, OnHeapSpillManager.scala:
+    61-144) — registered operator state spills and the freed byte count
+    crosses the C ABI."""
+    import ctypes
+
+    from blaze_tpu.columnar import types as T
+    from blaze_tpu.columnar.batch import ColumnBatch
+    from blaze_tpu.ops.sort import ExternalSorter
+    from blaze_tpu.ops.sort_keys import SortSpec
+    from blaze_tpu.runtime import memory as M
+
+    mgr = M.init(1 << 30)  # roomy budget: nothing spills on its own
+    schema = T.Schema([T.Field("v", T.INT64)])
+    sorter = ExternalSorter(schema, [SortSpec(0)], mgr)
+    try:
+        sorter.add(ColumnBatch.from_numpy(
+            {"v": np.arange(5000, dtype=np.int64)}, schema))
+        held = sorter.mem_used()
+        assert held > 0
+        lib = native._load()
+        lib.bn_spill.restype = ctypes.c_int64
+        lib.bn_spill.argtypes = [ctypes.c_int64]
+        freed = lib.bn_spill(1)
+        assert freed >= held
+        assert sorter.mem_used() == 0
+        assert len(sorter.runs) == 1  # state moved to a disk run
+        out = list(sorter.finish())
+        total = sum(int(b.num_rows) for b in out)
+        assert total == 5000
+    finally:
+        sorter.abort()
+        M.init(1 << 30)
