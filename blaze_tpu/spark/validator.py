@@ -595,7 +595,17 @@ def run_matrix(tmpdir: str, rows: int = 20_000,
                                       - sb0))
             except Exception:
                 results.append(Result(name, mode, False, time.time() - t0,
-                                      error=traceback.format_exc(limit=8)))
+                                      error=traceback.format_exc(limit=8),
+                                      spill_count=mgr.spill_count - sc0,
+                                      spilled_bytes=mgr.spilled_bytes
+                                      - sb0))
+            r = results[-1]
+            # incremental progress: long matrices run under timeouts in
+            # background shells — per-cell lines must not be lost to a
+            # buffered final report
+            print(f"[cell] {r.query} {r.mode} "
+                  f"{'PASS' if r.ok else 'FAIL'} {r.seconds:.1f}s "
+                  f"spills={r.spill_count}", flush=True)
     return results
 
 
