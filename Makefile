@@ -29,7 +29,7 @@ test-fast:
 	  --ignore=tests/test_validator.py
 
 validate:
-	$(PYENV) python validate.py
+	$(PYENV) python validate.py --suite all
 
 validate-fast:
 	$(PYENV) python validate.py \

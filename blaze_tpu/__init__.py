@@ -37,17 +37,19 @@ jax.config.update("jax_enable_x64", True)
 import os as _os
 
 _cache_dir = _os.environ.get("BLAZE_TPU_XLA_CACHE", "")
-if _cache_dir != "off":
-    # partition by platform: XLA:CPU AOT artifacts bake host machine
-    # features, and a chip-attached process (whose compiles run on the
-    # axon helper machine) must not share cache entries with local
-    # CPU-mesh runs (observed: "+prefer-no-scatter is not supported on
-    # the host machine ... could lead to SIGILL")
-    _plat = ("cpu" if "cpu" in _os.environ.get("JAX_PLATFORMS", "")
-             else "dev")
+_cpu_only = _os.environ.get("JAX_PLATFORMS", "").strip() == "cpu"
+if _cache_dir != "off" and (_cache_dir or not _cpu_only):
+    # Default-on for accelerator platforms only: TPU executables are
+    # machine-independent, but XLA:CPU AOT artifacts bake the COMPILING
+    # machine's features — and chip-attached sessions route even CPU
+    # compiles through the remote axon helper, poisoning a shared dir
+    # for local CPU-mesh runs (observed: "+prefer-no-scatter is not
+    # supported on the host machine ... could lead to SIGILL"). CPU
+    # compiles are cheap; the once-ever win is the 15-75s TPU compiles.
+    # An EXPLICIT BLAZE_TPU_XLA_CACHE=<dir> is honored on any platform.
     jax.config.update(
         "jax_compilation_cache_dir",
-        _cache_dir or _os.path.expanduser(f"~/.cache/blaze_tpu_xla_{_plat}"))
+        _cache_dir or _os.path.expanduser("~/.cache/blaze_tpu_xla_dev"))
     # cache EVERY program: on a remote-attached chip even a "fast" 0.5s
     # compile is 5x a dispatch, and the engine's many small per-shape
     # programs (slices, concats, probes) add up to tens of seconds/query

@@ -157,12 +157,11 @@ def _match(root: Operator):
     for call in partial.aggs:
         if call.fn not in _AGG_FNS or len(call.inputs) != 1:
             return None
-        if call.fn in _PLANE_FNS and call.dtype.kind == TypeKind.DECIMAL:
-            return None  # decimal finalize (avg floor-div) not wired yet
+        if call.dtype.wide_decimal:
+            return None  # int128 limb planes keep the streaming path
         if call.fn in _MM_FNS + _FIRST_FNS:
-            if (call.dtype.wide_decimal
-                    or call.dtype.kind not in _MM_VALUE_KINDS):
-                return None  # strings/wide decimals keep the streaming path
+            if call.dtype.kind not in _MM_VALUE_KINDS:
+                return None  # strings keep the streaming path
     if not getattr(partial, "_work_jit", True):
         return None
     m = _walk_chain(partial.children[0])
@@ -636,6 +635,15 @@ def try_run_stage(root: Operator, ctx: ExecContext, deferred: bool = False,
                 if out_mode_final:
                     if call.fn == "avg":
                         ok = cnt > 0
+                        if call.dtype.kind == TypeKind.DECIMAL:
+                            # decimal avg: unscaled floor-div at the
+                            # planned result scale (ops/agg.py finalize)
+                            q = jnp.where(ok,
+                                          outs[si] // jnp.maximum(cnt, 1),
+                                          0)
+                            cols.append(Column(call.dtype, _pad(q, cap),
+                                               _pad(ok, cap)))
+                            continue
                         v = outs[si].astype(jnp.float64) / \
                             jnp.maximum(cnt, 1).astype(jnp.float64)
                         cols.append(Column(T.FLOAT64,

@@ -519,3 +519,41 @@ def test_float_digit_plane_knob_precision(rng):
         np.testing.assert_allclose(got5, want, rtol=4e-8, atol=1e-4)
     finally:
         conf.float_sum_digit_planes = old
+
+
+def test_decimal_aggs_whole_stage(rng):
+    """int64-backed decimal sum/avg/min ride the dense MXU path (exact
+    int digit planes; avg = unscaled floor-div like the streaming
+    finalize). Wide decimals (p>18) keep the streaming path."""
+    dec = T.decimal(12, 2)
+    schema = T.Schema([T.Field("k", T.INT64), T.Field("d", dec)])
+    calls = [AggCall("sum", (col("d"),), dec, "s"),
+             AggCall("avg", (col("d"),), dec, "a"),
+             AggCall("min", (col("d"),), dec, "mn"),
+             AggCall("count", (col("d"),), T.INT64, "c")]
+    batches = []
+    for _ in range(3):
+        n = 400
+        batches.append(ColumnBatch.from_numpy(
+            {"k": rng.integers(0, 50, n).astype(np.int64),
+             "d": rng.integers(-10**6, 10**6, n)},
+            schema,
+            validity={"d": rng.random(n) > 0.2}, capacity=1024))
+    node = MemorySourceExec(batches, schema)
+    for mode in (AggMode.PARTIAL, AggMode.FINAL):
+        node = AggExec(node, [col("k")], ["k"], calls, mode)
+    got = collect(node)
+    assert node.metrics["stage_compiled"] == 1
+    conf.enable_stage_compiler = False
+    try:
+        node2 = MemorySourceExec(batches, schema)
+        for mode in (AggMode.PARTIAL, AggMode.FINAL):
+            node2 = AggExec(node2, [col("k")], ["k"], calls, mode)
+        want = collect(node2)
+    finally:
+        conf.enable_stage_compiler = True
+    gd, wd = got.to_numpy(), want.to_numpy()
+    assert list(np.asarray(gd["k"])) == list(np.asarray(wd["k"]))
+    for name in ("s", "a", "mn", "c"):
+        assert [None if x is None else int(x) for x in gd[name]] == \
+            [None if x is None else int(x) for x in wd[name]], name

@@ -12,8 +12,17 @@ Exit code 0 iff every (query, join-mode) cell passes.
 """
 
 import argparse
+import os
 import sys
 import tempfile
+
+# honor an explicit JAX_PLATFORMS=cpu BEFORE blaze imports: the
+# .axon_site hook otherwise force-selects an attached TPU, which makes
+# "CPU mesh" gate runs silently ride (or hang on) the chip tunnel
+if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 
 def main() -> int:
@@ -39,8 +48,6 @@ def main() -> int:
     queries = [q for q in args.queries.split(",") if q] or None
     suites = (["core", "tpcds"] if args.suite == "all" else [args.suite])
     results = []
-    import os
-
     with tempfile.TemporaryDirectory(prefix="blaze_tpu_validate_") as tmp:
         for suite in suites:
             os.makedirs(f"{tmp}/{suite}", exist_ok=True)
