@@ -50,8 +50,6 @@ accumulate of agg_tables.rs:360-430 (SURVEY.md §7b).
 
 from __future__ import annotations
 
-from typing import Tuple
-
 import jax
 import jax.numpy as jnp
 import numpy as np

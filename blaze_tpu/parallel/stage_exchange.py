@@ -27,8 +27,6 @@ from blaze_tpu.columnar.batch import ColumnBatch, bucket_capacity
 from blaze_tpu.columnar.types import Schema
 from blaze_tpu.exprs import ir
 from blaze_tpu.ops.base import ExecContext
-from blaze_tpu.ops.common import concat_batches
-from blaze_tpu.parallel.shuffle import mesh_shuffle_batch
 from blaze_tpu.plan import plan_pb2 as pb
 from blaze_tpu.runtime import resources
 from blaze_tpu.runtime.executor import execute_plan
