@@ -7,7 +7,7 @@
 
 PYENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
-.PHONY: check check-fast test test-fast validate validate-fast
+.PHONY: check check-fast test test-fast validate validate-fast warm
 
 check: test validate
 	@echo "CHECK OK — safe to commit"
@@ -34,3 +34,11 @@ validate:
 validate-fast:
 	$(PYENV) python validate.py \
 	  --queries q2_q06_core_agg,q3_join_agg_sort
+
+# Pre-warm the persistent compile caches (runtime/compile_service):
+# replays the shape manifest + the TPC-DS catalogue into the XLA cache.
+# Drop JAX_PLATFORMS=cpu (run bare `python -m ...`) to warm an attached
+# chip; override scale/budget via WARM_ARGS.
+WARM_ARGS = --rows 20000 --budget-seconds 1800
+warm:
+	$(PYENV) python -m blaze_tpu.runtime.compile_service --warm $(WARM_ARGS)

@@ -36,9 +36,22 @@ jax.config.update("jax_enable_x64", True)
 # pure execution). Opt out with BLAZE_TPU_XLA_CACHE=off.
 import os as _os
 
-_cache_dir = _os.environ.get("BLAZE_TPU_XLA_CACHE", "")
-_cpu_only = _os.environ.get("JAX_PLATFORMS", "").strip() == "cpu"
-if _cache_dir != "off" and (_cache_dir or not _cpu_only):
+# The axon site hook (/root/.axon_site) force-sets jax_platforms=axon,cpu
+# at `import jax`, overriding JAX_PLATFORMS; honor an explicit CPU request
+# centrally so every entry point (pytest, validate.py, `python -m
+# blaze_tpu.runtime.compile_service`) resolves to the platform the user
+# asked for, not the hook's attached chip.
+if "cpu" == _os.environ.get("JAX_PLATFORMS", "").strip():
+    jax.config.update("jax_platforms", "cpu")
+
+_cache_env = _os.environ.get("BLAZE_TPU_XLA_CACHE", "")
+# Resolve the backend the process will ACTUALLY use (initializes the
+# backend; falls back down the platform list if an attached chip's tunnel
+# is out) — an env-string match gets this wrong exactly when the resolved
+# platform differs from the requested one.
+_XLA_PLATFORM = jax.default_backend()
+_XLA_CACHE_DIR = None
+if _cache_env != "off" and (_cache_env or _XLA_PLATFORM != "cpu"):
     # Default-on for accelerator platforms only: TPU executables are
     # machine-independent, but XLA:CPU AOT artifacts bake the COMPILING
     # machine's features — and chip-attached sessions route even CPU
@@ -47,9 +60,12 @@ if _cache_dir != "off" and (_cache_dir or not _cpu_only):
     # supported on the host machine ... could lead to SIGILL"). CPU
     # compiles are cheap; the once-ever win is the 15-75s TPU compiles.
     # An EXPLICIT BLAZE_TPU_XLA_CACHE=<dir> is honored on any platform.
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        _cache_dir or _os.path.expanduser("~/.cache/blaze_tpu_xla_dev"))
+    # The dir is partitioned per resolved platform so cpu- and
+    # chip-compiled artifacts never share a namespace.
+    _XLA_CACHE_DIR = _os.path.join(
+        _cache_env or _os.path.expanduser("~/.cache/blaze_tpu_xla_dev"),
+        _XLA_PLATFORM)
+    jax.config.update("jax_compilation_cache_dir", _XLA_CACHE_DIR)
     # cache EVERY program: on a remote-attached chip even a "fast" 0.5s
     # compile is 5x a dispatch, and the engine's many small per-shape
     # programs (slices, concats, probes) add up to tens of seconds/query

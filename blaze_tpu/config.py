@@ -48,13 +48,14 @@ class BlazeConf:
     # 256x256 byte decomposition); stages whose keys exceed it fall back
     dense_agg_range: int = 1 << 16
     # precision policy for FLOAT sums on the MXU digit-plane path: each
-    # plane is one base-256 digit, so 5 planes digitize to 38 bits of
-    # the per-stage max magnitude (relative sum error ~2^-38 per value;
-    # well inside the 1e-6 class the TPU's emulated f64 already is) and
-    # cut one-hot matmul FLOPs ~14% vs 6 planes. Raise to 6 (46-bit,
-    # the emulated-f64 mantissa class) or up to 7 for stricter
-    # accumulation (int sums always use the exact 8-chunk int64 path).
-    float_sum_digit_planes: int = 5
+    # plane is one base-256 digit of the per-stage max magnitude. The
+    # default 6 planes digitize to 46 bits — the TPU's emulated-f64
+    # mantissa class, so float sums stay in the same precision class as
+    # every other f64 op. Lowering to 5 (38-bit, relative sum error
+    # ~2^-38 per value) is a documented opt-in perf setting that cuts
+    # one-hot matmul FLOPs ~14%; raise to 7 for stricter accumulation
+    # (int sums always use the exact 8-chunk int64 path).
+    float_sum_digit_planes: int = 6
     # external-sort spill frame rows: merge cost is one dispatch trio
     # per pooled frame, so bigger frames amortize the fixed per-dispatch
     # overhead (~90ms each on the remote-attached chip)
@@ -72,6 +73,13 @@ class BlazeConf:
     # in under this many bytes becomes a broadcast join (Spark's
     # autoBroadcastJoinThreshold analog; 0 disables)
     aqe_broadcast_threshold: int = 10 << 20
+    # compile-service shape canonicalization (runtime/compile_service.py):
+    # above canonical_pow2_limit, power-of-two capacity buckets collapse
+    # onto power-of-four rungs anchored at the limit, halving the large
+    # end of the compiled-program shape space. At or below the limit
+    # shapes are identical to the plain pow2 buckets.
+    enable_compile_canonicalization: bool = True
+    canonical_pow2_limit: int = 1 << 14
     # JAX profiler trace output dir ("" disables) — runtime/tracing.py
     profiler_dir: str = os.environ.get("BLAZE_TPU_PROFILE_DIR", "")
     # per-operator enable flags (tier b, spark.blaze.enable.<op>)

@@ -40,7 +40,7 @@ from blaze_tpu.ops.base import BatchStream, ExecContext, Operator, count_stream
 from blaze_tpu.ops.common import concat_batches
 from blaze_tpu.ops.sort import truncate
 from blaze_tpu.ops.sort_keys import SortSpec, sort_batch
-from blaze_tpu.runtime import jit_cache
+from blaze_tpu.runtime import compile_service, jit_cache
 
 AGG_BUF_PREFIX = "#9223372036854775807"  # ref agg/mod.rs:38
 
@@ -408,6 +408,7 @@ class AggExec(Operator):
     def _collapse(self, batches: List[ColumnBatch], raw_input: bool
                   ) -> ColumnBatch:
         big = batches[0] if len(batches) == 1 else concat_batches(batches)
+        big = compile_service.canonical_batch(big, "agg_collapse")
         key = ("agg_collapse", raw_input, self.plan_key(), big.shape_key())
 
         def make():

@@ -22,11 +22,20 @@ as a fixed-width ``S`` column that numpy compares with memcmp.
 
 Order equivalence with the device encoder is exact for ints, dates,
 timestamps, bools, strings (same 8-word prefix + length tiebreak) and
-decimals. float64 differs on TPU only: the device orders by the
-double-double (f32 hi, f32 lo) decomposition, the host by exact IEEE
-total order — the host order REFINES the device order for every value
-the emulated f64 can represent, so merged runs interleave device ties in
-exact IEEE order (NaN-above-inf and -0.0 == 0.0 match Spark on both).
+decimals. float64 needs care on TPU: the device orders by the
+double-double (f32 hi, f32 lo) decomposition, which is COARSER than IEEE
+total order — distinct f64s whose dd images coincide (|value| relative
+differences below ~2^-46, e.g. long decimal fractions differing past the
+dd mantissa) form one device TIE CLASS in arbitrary relative order
+inside each device-sorted run. Host keys must therefore compare at the
+SAME dd resolution when merging device-sorted runs: a finer (exact
+IEEE) host key would consider such runs *unsorted* and the k-way merge
+would emit rows out of order (observed as cross-frame inversions of dd
+ties). `encode_keys` canonicalizes f64 planes to the dd image of the
+device encoder (bits64.f64_total_order_keys) whenever the backend sorts
+f64 at dd resolution; dd ties then merge in stable run order. On
+backends with native 64-bit bitcast (CPU) both sides use exact IEEE
+total order (NaN-above-inf and -0.0 == 0.0 match Spark either way).
 """
 
 from __future__ import annotations
@@ -67,6 +76,34 @@ def _f32_total_order(x: np.ndarray) -> np.ndarray:
     return np.where(neg, ~u, u ^ _I32_MIN)
 
 
+_F64_EXACT: Optional[bool] = None
+
+
+def _device_sorts_f64_exact() -> bool:
+    """Whether the device encoder orders f64 by exact IEEE total order
+    (64-bit bitcast available) or by the double-double decomposition.
+    Cached: the answer is a property of the resolved backend."""
+    global _F64_EXACT
+    if _F64_EXACT is None:
+        from blaze_tpu.columnar.bits64 import backend_has_bitcast64
+
+        _F64_EXACT = bool(backend_has_bitcast64())
+    return _F64_EXACT
+
+
+def _f64_dd_parts(x: np.ndarray) -> List[np.ndarray]:
+    """Numpy mirror of bits64._dd_split + per-limb f32 total order: the
+    host key for merging DEVICE-sorted runs must compare at the device's
+    dd resolution (see module docstring — a finer key would see dd tie
+    classes as inversions and merge out of order)."""
+    hi = x.astype(np.float32)
+    with np.errstate(invalid="ignore"):
+        lo = (x - hi.astype(np.float64)).astype(np.float32)
+    lo = np.where(np.isfinite(hi), lo, np.float32(0.0))
+    lo = np.where(np.isnan(x), np.float32(np.nan), lo)
+    return [_be(_f32_total_order(hi)), _be(_f32_total_order(lo))]
+
+
 def _value_parts(c: _HostCol, kind: TypeKind, wide: bool,
                  n: int) -> List[np.ndarray]:
     """Big-endian byte planes whose concatenated order is the ascending
@@ -90,7 +127,10 @@ def _value_parts(c: _HostCol, kind: TypeKind, wide: bool,
     if kind == TypeKind.BOOLEAN:
         return [c.data.astype(np.uint8).reshape(-1, 1)]
     if kind == TypeKind.FLOAT64:
-        return [_be(_f64_total_order(c.data.astype(np.float64)))]
+        x = c.data.astype(np.float64)
+        if not _device_sorts_f64_exact():
+            return _f64_dd_parts(x)
+        return [_be(_f64_total_order(x))]
     if kind == TypeKind.FLOAT32:
         return [_be(_f32_total_order(c.data.astype(np.float32)))]
     if kind in (TypeKind.INT64, TypeKind.TIMESTAMP, TypeKind.DECIMAL):

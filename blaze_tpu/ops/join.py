@@ -49,7 +49,7 @@ from blaze_tpu.ops import segment as seg
 from blaze_tpu.ops.base import BatchStream, ExecContext, Operator, count_stream
 from blaze_tpu.ops.common import concat_batches
 from blaze_tpu.ops.sort_keys import SortSpec, encode_column, sort_batch
-from blaze_tpu.runtime import jit_cache
+from blaze_tpu.runtime import compile_service, jit_cache
 
 Array = jax.Array
 
@@ -360,10 +360,13 @@ class HashJoinLikeExec(Operator):
         build_side_semi = (self.build_is_left and jt in (
             JoinType.LEFT_SEMI, JoinType.LEFT_ANTI, JoinType.EXISTENCE))
 
-        # materialize the build side
+        # materialize the build side; canonical capacity rung so the
+        # buildsort/match program pair compiles per rung, not per raw size
         build_batches = list(build_op.execute(ctx))
         if build_batches:
             build = concat_batches(build_batches, build_op.schema)
+            build = compile_service.canonical_batch(
+                build, "join_build", raw_rows=int(build.num_rows))
         else:
             build = ColumnBatch.empty(build_op.schema)
 

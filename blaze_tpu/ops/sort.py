@@ -21,15 +21,19 @@ from blaze_tpu.config import conf
 from blaze_tpu.ops.base import BatchStream, ExecContext, Operator, count_stream
 from blaze_tpu.ops.common import concat_batches
 from blaze_tpu.ops.sort_keys import SortSpec, sort_batch
-from blaze_tpu.runtime import jit_cache
+from blaze_tpu.runtime import compile_service, jit_cache
 
 
 def sorted_batch_jit(batch: ColumnBatch, specs: Sequence[SortSpec],
                      plan_key: tuple = ()) -> ColumnBatch:
     """Jit-cached whole-batch sort. The cache key deliberately omits the
     plan: the kernel depends only on specs + batch layout, so identical
-    sorts across different plans share one compilation."""
+    sorts across different plans share one compilation — and the shape is
+    host-reconstructible, so the compile service records a replay payload
+    for manifest-driven pre-warming."""
+    batch = compile_service.canonical_batch(batch, "sort_kernel")
     key = ("sort_kernel", tuple(s.key() for s in specs), batch.shape_key())
+    compile_service.record_sort_shape(key, batch, specs)
     fn = jit_cache.get_or_compile(
         key, lambda: (lambda b: sort_batch(b, specs)))
     return fn(batch)

@@ -153,7 +153,10 @@ def mesh_shuffle_batch_grouped(batch: ColumnBatch,
     """
     P, k = num_partitions, parts_per_device
     pid = partition_ids(batch, key_indices, P)
-    dsize = lax.axis_size(axis_name)
+    # lax.axis_size is newer-jax only; psum of a literal 1 is evaluated
+    # statically at trace time on every version, same result
+    dsize = (lax.axis_size(axis_name) if hasattr(lax, "axis_size")
+             else lax.psum(1, axis_name))
     # owner device of each row; padding rows carry the sentinel group D
     owner = jnp.where(pid >= P, jnp.int32(dsize), pid // k)
     received, overflow = staged_all_to_all(batch, owner, axis_name, dsize,

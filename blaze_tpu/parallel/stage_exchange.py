@@ -23,6 +23,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+# jax.shard_map graduated from jax.experimental in newer releases; take
+# whichever this jax provides so the exchange runs on both
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+else:  # pragma: no cover - version-dependent
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 from blaze_tpu.columnar.batch import ColumnBatch, bucket_capacity
 from blaze_tpu.columnar.types import Schema
 from blaze_tpu.exprs import ir
@@ -167,9 +174,9 @@ def run_mesh_shuffle_stage(stage_plan: pb.PlanNode, stage_id: int,
                     b, key_idx, "p", Pn, kpd, quota=q)
                 return out.columns, counts[None], overflow[None]
 
-            return jax.shard_map(step, mesh=mesh,
-                                 in_specs=(P("p"), P("p")),
-                                 out_specs=(P("p"), P("p"), P("p")))
+            return _shard_map(step, mesh=mesh,
+                              in_specs=(P("p"), P("p")),
+                              out_specs=(P("p"), P("p"), P("p")))
 
         run = jit_cache.get_or_compile(key, make)
         out_cols, out_counts, overflow = run(cols, num_rows)

@@ -208,4 +208,11 @@ _pack_pins: dict = {}
 
 
 def metric_tree(root: Operator) -> MetricNode:
-    return MetricNode.from_operator(root)
+    from blaze_tpu.runtime import compile_service
+
+    node = MetricNode.from_operator(root)
+    # process-global compile counters ride along as an extra child (no
+    # handler of its own: embedders that only set the root handler are
+    # unaffected; tree-walking embedders get the compile telemetry)
+    node.children = list(node.children) + [compile_service.telemetry_node()]
+    return node

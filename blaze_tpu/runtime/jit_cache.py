@@ -11,6 +11,7 @@ structural key — same plan + same shape bucket => zero recompiles.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, Dict, Hashable, Tuple
 
 import jax
@@ -18,6 +19,24 @@ import jax
 _lock = threading.Lock()
 _cache: Dict[Hashable, Callable] = {}
 _stats = {"hits": 0, "misses": 0}
+# single observer slot (runtime/compile_service registers its shape
+# registry here): called as observer(event, key, ns) with event in
+# {"hit", "miss", "compiled"} — outside _lock, exceptions swallowed.
+_observer = None
+
+
+def set_observer(fn) -> None:
+    global _observer
+    _observer = fn
+
+
+def _notify(event: str, key: Hashable, ns: int = 0) -> None:
+    obs = _observer
+    if obs is not None:
+        try:
+            obs(event, key, ns)
+        except Exception:
+            pass
 
 
 def get_or_compile(key: Hashable, make_fn: Callable[[], Callable],
@@ -32,13 +51,43 @@ def get_or_compile(key: Hashable, make_fn: Callable[[], Callable],
         fn = _cache.get(key)
         if fn is not None:
             _stats["hits"] += 1
-            return fn
+    if fn is not None:
+        _notify("hit", key)
+        return fn
+    with _lock:
         _stats["misses"] += 1
+    _notify("miss", key)
     built = jax.jit(make_fn(), **jit_kwargs) if jit else make_fn()
     if jit:
         built = _with_stale_exec_retry(key, built, make_fn, jit_kwargs)
+        built = _with_first_call_timer(key, built)
     with _lock:
         return _cache.setdefault(key, built)
+
+
+def _with_first_call_timer(key, fn):
+    """Report the first invocation's wall time as this key's compile cost.
+
+    jax compiles lazily at the first jitted call, so the first-call wall
+    clock is trace + XLA build (+ the first dispatch enqueue; the result
+    is NOT blocked on — blocking here would serialize the engine's async
+    dispatch pipelines, and compile time dwarfs enqueue time anyway).
+    """
+    import functools
+
+    done = []
+
+    @functools.wraps(fn)
+    def timed(*args, **kwargs):
+        if done:
+            return fn(*args, **kwargs)
+        done.append(True)
+        t0 = time.perf_counter_ns()
+        out = fn(*args, **kwargs)
+        _notify("compiled", key, time.perf_counter_ns() - t0)
+        return out
+
+    return timed
 
 
 def _with_stale_exec_retry(key, fn, make_fn, jit_kwargs):

@@ -37,6 +37,12 @@ class MetricsSet:
     def timer(self, name: str = "elapsed_compute_ns"):
         return _Timer(self, name)
 
+    def snapshot(self) -> Dict[str, int]:
+        """Point-in-time copy of the counters; compile-service task
+        scopes diff two snapshots to attribute process-global deltas
+        (compile_count/compile_ns/...) to one task's MetricsSet."""
+        return dict(self.values)
+
     def __getitem__(self, name: str) -> int:
         return self.values.get(name, 0)
 

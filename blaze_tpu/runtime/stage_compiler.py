@@ -40,7 +40,7 @@ from blaze_tpu.ops.agg import (
     AggExec, AggMode, result_field, state_fields,
 )
 from blaze_tpu.ops.base import ExecContext, MapLikeOp, Operator
-from blaze_tpu.runtime import jit_cache
+from blaze_tpu.runtime import compile_service, jit_cache
 
 _GROUP_KINDS = (TypeKind.INT8, TypeKind.INT16, TypeKind.INT32,
                 TypeKind.INT64, TypeKind.DATE)
@@ -187,6 +187,7 @@ def try_run_stage(root: Operator, ctx: ExecContext, deferred: bool = False,
     came back clean (a discarded stage never ran to completion)."""
     if not conf.enable_stage_compiler:
         return None
+    compile_service.note_stage_attempt()
     m = _match(root)
     if m is None:
         # chain_ok=False (the shuffle drivers): an agg-less chain stage
@@ -218,7 +219,10 @@ def try_run_stage(root: Operator, ctx: ExecContext, deferred: bool = False,
         # source already drained: fall back WITH the captured batches
         return _fallback(root, batches, source, ctx)
 
-    batches = tuple(batches)
+    # canonical batch-count rung: pad the tuple with zero-row copies so
+    # len(batches) — a static axis of every stage program key below —
+    # collapses onto few rungs instead of one program per scan length
+    batches = compile_service.pad_batch_list(tuple(batches), "stage_agg")
     max_R = int(conf.dense_agg_range)
 
     nkeys = len(partial.group_exprs)
@@ -731,6 +735,7 @@ def try_run_stage(root: Operator, ctx: ExecContext, deferred: bool = False,
                 for op in filter(None, (final, partial, *chain)):
                     op.metrics.add("output_batches", 1)
                 root.metrics.add("stage_compiled", 1)
+                compile_service.note_stage_compiled()
 
             return out, flags, retry, commit_metrics
         flags_np = np.asarray(flags)
@@ -749,6 +754,7 @@ def try_run_stage(root: Operator, ctx: ExecContext, deferred: bool = False,
         op.metrics.add("output_batches", 1)
     root.metrics.add("output_rows", nrows)
     root.metrics.add("stage_compiled", 1)
+    compile_service.note_stage_compiled()
     return out
 
 
@@ -778,6 +784,7 @@ def _run_chain_stage(root: Operator, chain: List[MapLikeOp],
     if any(b.shape_key() != shape0 for b in batches[1:]):
         return _fallback(root, list(batches), source, ctx)
 
+    batches = compile_service.pad_batch_list(batches, "stage_chain")
     key = ("stage_chain", root.plan_key(), shape0, len(batches))
 
     def make():
@@ -809,6 +816,7 @@ def _run_chain_stage(root: Operator, chain: List[MapLikeOp],
         op.metrics.add("output_batches", 1)
     root.metrics.add("output_rows", int(out.num_rows))
     root.metrics.add("stage_compiled", 1)
+    compile_service.note_stage_compiled()
     return out
 
 

@@ -48,4 +48,9 @@ def metric_report(root) -> str:
             walk(c, depth + 1)
 
     walk(root, 0)
+    from blaze_tpu.runtime import compile_service
+
+    summary = compile_service.telemetry_summary()
+    if summary:
+        lines.append(summary)
     return "\n".join(lines)

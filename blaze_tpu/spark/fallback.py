@@ -327,7 +327,9 @@ def _round_half_up(a, d):
         # java BigDecimal.setScale has unbounded precision; the default
         # 28-digit context raises InvalidOperation for |x| >= ~1e26.
         # 400 covers the full double range (1e308) at any target scale.
-        with decimal.localcontext(prec=400):
+        # (localcontext(prec=...) kwargs need 3.11+; set it on the copy.)
+        with decimal.localcontext() as ctx:
+            ctx.prec = 400
             return float(decimal.Decimal(repr(x)).quantize(
                 q, rounding=decimal.ROUND_HALF_UP))
 

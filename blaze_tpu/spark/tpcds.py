@@ -971,3 +971,16 @@ QUERIES: Dict[str, Callable] = {
 
 # single-channel/global-agg queries where the join axis changes nothing
 JOINLESS: set = {"q09"}
+
+
+def warm_cells(queries=None, modes=("bhj", "smj")):
+    """The catalogue's enumerated (query, join-mode) shape cells — the
+    pre-warm driver (runtime/compile_service) replays these to populate
+    the persistent compile caches with every program shape the catalogue
+    touches. Joinless queries enumerate one mode (the axis is inert)."""
+    names = list(queries) if queries else sorted(QUERIES)
+    for name in names:
+        if name not in QUERIES:
+            raise KeyError(f"unknown catalogue query: {name}")
+        for mode in (modes[:1] if name in JOINLESS else modes):
+            yield name, mode

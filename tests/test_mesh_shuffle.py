@@ -11,7 +11,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from blaze_tpu.parallel.stage_exchange import _shard_map as shard_map
 
 from blaze_tpu.columnar import types as T
 from blaze_tpu.columnar.batch import ColumnBatch

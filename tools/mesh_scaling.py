@@ -46,7 +46,6 @@ def main() -> None:
     kpd = -(-P // D)
     rng = np.random.default_rng(3)
     n = D * ROWS
-    cols, _ = None, None
     batch = ColumnBatch.from_numpy(
         {"k": rng.integers(0, 1 << 20, n).astype(np.int64),
          "v": rng.random(n)}, SCHEMA, capacity=n)
@@ -59,8 +58,10 @@ def main() -> None:
             b, [0], "p", P, kpd, quota=ROWS * kpd)
         return out.columns, counts[None], overflow[None]
 
-    inner = jax.shard_map(step, mesh=mesh, in_specs=(PS("p"), PS("p")),
-                          out_specs=(PS("p"), PS("p"), PS("p")))
+    from blaze_tpu.parallel.stage_exchange import _shard_map
+
+    inner = _shard_map(step, mesh=mesh, in_specs=(PS("p"), PS("p")),
+                       out_specs=(PS("p"), PS("p"), PS("p")))
 
     def scan_n(reps):
         def run(cols, num_rows):
@@ -85,8 +86,7 @@ def main() -> None:
     t = time.time(); np.asarray(f1(*args)); d1 = time.time() - t
     t = time.time(); np.asarray(f2(*args)); d2 = time.time() - t
     per = (d2 - d1) / 10
-    row_bytes = 16 + 1  # i64 + f64 er, 8+8; validity-free
-    total_bytes = D * ROWS * 16
+    total_bytes = D * ROWS * 16  # i64 + f64, validity-free
     print(json.dumps({
         "devices": D, "partitions": P, "rows_per_device": ROWS,
         "exchange_ms": round(per * 1e3, 2),
