@@ -7,7 +7,8 @@
 
 PYENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
-.PHONY: check check-fast test test-fast validate validate-fast warm
+.PHONY: check check-fast check-faults test test-fast validate \
+	validate-fast warm
 
 check: test validate
 	@echo "CHECK OK — safe to commit"
@@ -34,6 +35,13 @@ validate:
 validate-fast:
 	$(PYENV) python validate.py \
 	  --queries q2_q06_core_agg,q3_join_agg_sort
+
+# Chaos soak: sweep every fault-injection point x kind over the
+# validator mini-catalogue; every armed run must recover to the pandas
+# oracle (or fail classified) and leave no orphans/leaked reservations.
+# Emits FAULTS_r06.json.
+check-faults:
+	$(PYENV) python tools/chaos_soak.py --json-out FAULTS_r06.json
 
 # Pre-warm the persistent compile caches (runtime/compile_service):
 # replays the shape manifest + the TPC-DS catalogue into the XLA cache.

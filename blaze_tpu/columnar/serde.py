@@ -61,6 +61,7 @@ except ModuleNotFoundError:  # pragma: no cover - environment-dependent
 from blaze_tpu.columnar.batch import ColumnBatch, bucket_capacity
 from blaze_tpu.columnar.types import Schema, TypeKind
 from blaze_tpu.config import conf
+from blaze_tpu.runtime import faults
 
 MAGIC = b"BTB1"
 
@@ -85,6 +86,8 @@ class HostBatch:
 
     def serialize(self, lo: int = 0, hi: Optional[int] = None,
                   level: Optional[int] = None) -> bytes:
+        if conf.fault_injection_spec:
+            faults.inject("serde.encode")
         hi = self.num_rows if hi is None else hi
         n = max(hi - lo, 0)
         out = io.BytesIO()
@@ -153,6 +156,8 @@ def _host_col(col, n: int) -> _HostCol:
 
 
 def to_host(batch: ColumnBatch) -> HostBatch:
+    if conf.fault_injection_spec:
+        faults.inject("device.get")
     n = int(batch.num_rows)
     return HostBatch(batch.schema, [_host_col(c, n) for c in batch.columns],
                      n)
@@ -169,6 +174,8 @@ def serialize_slice(hb: HostBatch, lo: int, hi: int) -> bytes:
 
     if native.available() and all(c.kind in ("num", "str", "null")
                                   for c in hb.cols):
+        if conf.fault_injection_spec:
+            faults.inject("serde.encode")
         return native.serialize_host_batch(hb, lo, hi, conf.zstd_level)
     return hb.serialize(lo, hi)
 
@@ -188,6 +195,8 @@ def _read_exact(fp: BinaryIO, n: int) -> bytes:
 
 def deserialize_batch(buf: bytes, schema: Schema,
                       capacity: Optional[int] = None) -> ColumnBatch:
+    if conf.fault_injection_spec:
+        faults.inject("serde.decode")
     if buf[:4] != MAGIC:
         raise ValueError("bad batch frame magic")
     raw_len, comp_len = struct.unpack("<II", buf[4:12])
@@ -199,6 +208,8 @@ def deserialize_batch(buf: bytes, schema: Schema,
 def read_batch(fp: BinaryIO, schema: Schema,
                capacity: Optional[int] = None) -> Optional[ColumnBatch]:
     """Read one frame; None at clean EOF."""
+    if conf.fault_injection_spec:
+        faults.inject("serde.decode")
     head = fp.read(12)
     if not head:
         return None
@@ -223,6 +234,8 @@ def read_batch_host(fp: BinaryIO, schema: Schema) -> Optional[HostBatch]:
     """Decode one frame to host numpy columns (no device upload) — the
     spill-merge and host-coalescing paths (ops/host_sort.py) stay entirely
     on the host until one bulk upload."""
+    if conf.fault_injection_spec:
+        faults.inject("serde.decode")
     head = fp.read(12)
     if not head:
         return None

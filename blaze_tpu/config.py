@@ -82,6 +82,20 @@ class BlazeConf:
     canonical_pow2_limit: int = 1 << 14
     # JAX profiler trace output dir ("" disables) — runtime/tracing.py
     profiler_dir: str = os.environ.get("BLAZE_TPU_PROFILE_DIR", "")
+    # -- execution resilience (runtime/faults.py, runtime/executor.py) --
+    # fault-injection spec ({} disables; see faults.py docstring for the
+    # {"seed": ..., "points": {...}} shape). Install via faults.install()
+    # so the deterministic schedule state resets with the spec.
+    fault_injection_spec: Dict[str, Any] = dataclasses.field(
+        default_factory=dict)
+    # bounded per-task retries for RetryableError-classified failures
+    max_task_retries: int = 2
+    # base backoff before retry i is ~retry_backoff_ms * 2^i (+-25% jitter)
+    retry_backoff_ms: int = 10
+    # resource-exhaustion degradation ladder: halve macro-batch ->
+    # force MemManager spill -> route the task to the CPU fallback
+    # interpreter. Off = resource errors get plain bounded retries.
+    enable_degradation_ladder: bool = True
     # per-operator enable flags (tier b, spark.blaze.enable.<op>)
     enable_ops: Dict[str, bool] = dataclasses.field(default_factory=dict)
 

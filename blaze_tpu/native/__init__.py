@@ -76,6 +76,11 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.bn_init.restype = ctypes.c_int
     lib.bn_init.argtypes = [ctypes.c_int64]
     lib.bn_last_error.restype = ctypes.c_char_p
+    try:  # older .so builds predate the category symbol
+        lib.bn_last_error_category.restype = ctypes.c_int
+        lib.bn_last_error_category.argtypes = []
+    except AttributeError:
+        pass
     lib.bn_free_buffer.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
     for name, argtypes in [
         ("bn_hash_i32", [ctypes.c_void_p] * 2 + [ctypes.c_int64,
@@ -97,6 +102,33 @@ def _load() -> Optional[ctypes.CDLL]:
 
 def available() -> bool:
     return _load() is not None
+
+
+def last_error_category() -> int:
+    """bn_last_error_category wire code for this thread's last native
+    failure (0 when the loaded .so predates the symbol)."""
+    lib = _load()
+    try:
+        return int(lib.bn_last_error_category())
+    except AttributeError:
+        return 0
+
+
+def _native_error(what: str, rc: int) -> Exception:
+    """Map the C ABI error category onto the faults taxonomy so the
+    executor's resilience ladder treats native failures (retry, degrade,
+    abort) exactly like Python-side ones."""
+    from blaze_tpu.runtime import faults
+
+    lib = _load()
+    msg = f"{what} failed ({rc}): {lib.bn_last_error().decode()}"
+    cat = faults.NATIVE_CODE_CATEGORIES.get(last_error_category())
+    if cat == "killed":
+        from blaze_tpu.ops.base import TaskKilledError
+
+        return TaskKilledError(msg)
+    cls = faults.CATEGORY_CLASSES.get(cat)
+    return cls(msg) if cls is not None else RuntimeError(msg)
 
 
 def _ptr(a: Optional[np.ndarray]):
@@ -210,8 +242,7 @@ def call_arrow(task_def: bytes):
     stream = _ArrowArrayStream()
     rc = lib.bn_call_arrow(task_def, len(task_def), ctypes.byref(stream))
     if rc != 0:
-        raise RuntimeError(
-            f"bn_call_arrow failed ({rc}): {lib.bn_last_error().decode()}")
+        raise _native_error("bn_call_arrow", rc)
     return pa.RecordBatchReader._import_from_c(ctypes.addressof(stream))
 
 
@@ -237,8 +268,7 @@ def call_native(task_def: bytes) -> bytes:
     rc = lib.bn_call(task_def, len(task_def), ctypes.byref(out),
                      ctypes.byref(out_len))
     if rc != 0:
-        raise RuntimeError(
-            f"bn_call failed ({rc}): {lib.bn_last_error().decode()}")
+        raise _native_error("bn_call", rc)
     try:
         return ctypes.string_at(out, out_len.value)
     finally:
@@ -259,7 +289,7 @@ class NativeShuffleWriter:
         rc = self._lib.bn_shuffle_push(self._w, partition, frame,
                                        len(frame))
         if rc != 0:
-            raise RuntimeError(f"bn_shuffle_push failed: {rc}")
+            raise _native_error("bn_shuffle_push", rc)
 
     def mem_used(self) -> int:
         return self._lib.bn_shuffle_mem_used(self._w)
@@ -267,14 +297,14 @@ class NativeShuffleWriter:
     def spill(self) -> None:
         rc = self._lib.bn_shuffle_spill(self._w)
         if rc != 0:
-            raise RuntimeError(f"bn_shuffle_spill failed: {rc}")
+            raise _native_error("bn_shuffle_spill", rc)
 
     def commit(self, data_path: str, index_path: str) -> List[int]:
         lengths = (ctypes.c_int64 * self.P)()
         rc = self._lib.bn_shuffle_commit(self._w, data_path.encode(),
                                          index_path.encode(), lengths)
         if rc != 0:
-            raise RuntimeError(f"bn_shuffle_commit failed: {rc}")
+            raise _native_error("bn_shuffle_commit", rc)
         return list(lengths)
 
     def close(self) -> None:

@@ -212,7 +212,7 @@ class _AggState:
         if not self.states:
             return 0
         freed = self.state_bytes
-        sf = self._M.SpillFile(self.op._state_schema)
+        sf = self._M.SpillFile(self.op._state_schema, manager=self.manager)
         for s in self.states:
             sf.write(truncate(s, max(int(s.num_rows), 1)))
         self.spills.append(sf)

@@ -106,11 +106,15 @@ def count_stream(op: Operator, stream: BatchStream) -> BatchStream:
     byte/row-size statistics — each operator's output stream IS its
     parent's input stream, so one output-side hook covers the plan."""
     from blaze_tpu.config import conf
+    from blaze_tpu.runtime import faults
 
     stats = conf.enable_input_batch_statistics
     if stats:
         from blaze_tpu.runtime.memory import batch_nbytes
+    fault_point = "op." + op.name()  # chaos injection at the op boundary
     for batch in stream:
+        if conf.fault_injection_spec:
+            faults.inject(fault_point)
         op.metrics.add("output_batches", 1)
         op.metrics.add("output_rows", int(batch.num_rows))
         if stats:

@@ -302,7 +302,11 @@ def host_to_device(hb: HostBatch, capacity: Optional[int] = None):
     import jax.numpy as jnp
 
     from blaze_tpu.columnar.batch import ColumnBatch, bucket_capacity
+    from blaze_tpu.config import conf
+    from blaze_tpu.runtime import faults
 
+    if conf.fault_injection_spec:
+        faults.inject("device.put")
     n = hb.num_rows
     cap = capacity or bucket_capacity(n)
     cols = [_upload_col(c, f, n, cap)

@@ -151,8 +151,10 @@ class ShuffleWriterExec(Operator):
                 self.children[0].plan_key())
 
     def execute(self, ctx: ExecContext) -> BatchStream:
-        from blaze_tpu.runtime import memory as M
+        from blaze_tpu.runtime import artifacts, memory as M
 
+        # reclaim dead writers' .inprogress. temps before producing our own
+        artifacts.sweep_orphans([os.path.dirname(self.data_path) or "."])
         state = _make_writer_state(self.partitioning.num_partitions,
                                    M.get_manager(ctx))
         keys_jit = not any(ir.contains_host_fn(e)
@@ -197,7 +199,9 @@ class ShuffleWriterExec(Operator):
             with self.metrics.timer():
                 os.makedirs(os.path.dirname(self.data_path) or ".",
                             exist_ok=True)
-                lengths = state.commit(self.data_path, self.index_path)
+                # crash-atomic: stage temps, fsync, rename data-then-index
+                lengths = artifacts.commit_shuffle_pair(
+                    state.commit, self.data_path, self.index_path)
             self.metrics.add("shuffle_bytes_written", int(sum(lengths)))
             self.metrics.add("spill_count", state.spill_chunks)
         finally:

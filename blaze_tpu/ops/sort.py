@@ -103,7 +103,7 @@ class ExternalSorter:
         if not self.pending:
             return 0
         freed = self.pending_bytes
-        run = self._M.SpillFile(self.schema)
+        run = self._M.SpillFile(self.schema, manager=self.manager)
         big = concat_batches(self.pending, self.schema)
         sb = sorted_batch_jit(big, self.specs)
         # frame granularity bounds the merge's iteration count (one

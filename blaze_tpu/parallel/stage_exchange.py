@@ -92,6 +92,11 @@ def run_mesh_shuffle_stage(stage_plan: pb.PlanNode, stage_id: int,
     devices = jax.devices()
     if num_partitions < 2:
         return False
+    from blaze_tpu.config import conf
+    from blaze_tpu.runtime import faults
+
+    if conf.fault_injection_spec:
+        faults.inject("exchange.stage")
     input_op = decode_plan(writer.input)
     key_idx = mesh_key_indices(writer, input_op.schema)
     if key_idx is None or not key_idx:

@@ -1,0 +1,164 @@
+"""Crash-atomic artifact commit + orphan sweeping.
+
+A killed task must never leave a partial `.data`/`.index` visible to a
+reader (the reference gets this from Spark's IndexShuffleBlockResolver,
+which writes `.index.<uuid>`/`.data.<uuid>` tempfiles and renames into
+place). Same protocol here for every file artifact the engine commits:
+
+  stage    write the full payload to `<final>.inprogress.<pid>.<seq>`
+  publish  fsync the temp, then os.replace() onto the final name
+           (data before index for shuffle pairs, so a visible index
+           always points at complete data)
+  sweep    task setup removes `.inprogress.` temps (and `blz<pid>-*.spill`
+           spill files) whose writing process is dead — a SIGKILL mid-
+           commit orphans the temp, never the final name.
+
+The `shuffle.commit` injection point sits between staging and publishing:
+the chaos harness kills exactly the window the protocol protects.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import re
+from typing import Callable, List, Sequence
+
+from blaze_tpu.runtime import faults
+
+ORPHAN_TAG = ".inprogress."
+_SPILL_RE = re.compile(r"^blz(\d+)-.*\.spill$")
+_seq = itertools.count()
+
+
+def stage_path(final_path: str) -> str:
+    """Temp path for `final_path`, unique per (process, call), carrying
+    the writer pid so the sweeper can tell live commits from orphans."""
+    return f"{final_path}{ORPHAN_TAG}{os.getpid()}.{next(_seq)}"
+
+
+def _fsync_path(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def publish(tmp_path: str, final_path: str, fsync: bool = True) -> None:
+    """Atomically rename a staged temp onto its final name."""
+    if fsync:
+        _fsync_path(tmp_path)
+    os.replace(tmp_path, final_path)
+
+
+def commit_file(write_fn: Callable[[str], None], final_path: str,
+                fsync: bool = True) -> None:
+    """stage -> write_fn(tmp) -> publish; temp removed on any failure."""
+    tmp = stage_path(final_path)
+    try:
+        write_fn(tmp)
+        publish(tmp, final_path, fsync=fsync)
+    except BaseException:
+        _unlink_quiet(tmp)
+        raise
+
+
+def commit_shuffle_pair(write_fn, data_path: str, index_path: str):
+    """Commit a map task's `.data`/`.index` pair crash-atomically.
+
+    `write_fn(tmp_data, tmp_index) -> lengths` produces both files (the
+    Python or C++ writer backend). Publish order is data first, then the
+    fsync'd index: readers locate segments through the index, so the
+    index must never name data that isn't fully in place. The
+    `shuffle.commit` fault point fires between staging and publishing —
+    a fault (or kill) there leaves only `.inprogress.` temps behind,
+    which the next task's sweep reclaims."""
+    tmp_data = stage_path(data_path)
+    tmp_index = stage_path(index_path)
+    try:
+        lengths = write_fn(tmp_data, tmp_index)
+        _fsync_path(tmp_data)
+        _fsync_path(tmp_index)
+        faults.inject("shuffle.commit")
+        os.replace(tmp_data, data_path)
+        os.replace(tmp_index, index_path)
+        return lengths
+    except BaseException:
+        _unlink_quiet(tmp_data)
+        _unlink_quiet(tmp_index)
+        raise
+
+
+def _unlink_quiet(path: str) -> None:
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except (PermissionError, OSError):
+        return True
+    return True
+
+
+def _orphan_pid(name: str) -> int:
+    """Writer pid embedded in an artifact temp or spill file name; -1
+    when the name doesn't parse (treated as live — never delete what we
+    don't understand)."""
+    if ORPHAN_TAG in name:
+        tail = name.rsplit(ORPHAN_TAG, 1)[1]
+        pid = tail.split(".", 1)[0]
+        return int(pid) if pid.isdigit() else -1
+    m = _SPILL_RE.match(name)
+    if m:
+        return int(m.group(1))
+    return -1
+
+
+def sweep_orphans(directories: Sequence[str], include_self: bool = False
+                  ) -> List[str]:
+    """Remove dead writers' leftovers from `directories`; returns removed
+    paths. `include_self` additionally reclaims THIS process's temps —
+    only safe at points where no commit is in flight (test harnesses)."""
+    removed: List[str] = []
+    if isinstance(directories, str):
+        directories = [directories]
+    for d in directories:
+        try:
+            names = os.listdir(d)
+        except OSError:
+            continue
+        for name in names:
+            pid = _orphan_pid(name)
+            if pid < 0:
+                continue
+            if _pid_alive(pid) and not (include_self and pid == os.getpid()):
+                continue
+            path = os.path.join(d, name)
+            _unlink_quiet(path)
+            removed.append(path)
+    if removed:
+        faults.TELEMETRY.add("orphans_swept", len(removed))
+    return removed
+
+
+def find_orphans(directories: Sequence[str]) -> List[str]:
+    """List artifact temps / spill leftovers without removing them (the
+    chaos gate asserts this is empty after every run)."""
+    found: List[str] = []
+    if isinstance(directories, str):
+        directories = [directories]
+    for d in directories:
+        try:
+            names = os.listdir(d)
+        except OSError:
+            continue
+        found.extend(os.path.join(d, n) for n in names
+                     if _orphan_pid(n) >= 0)
+    return found

@@ -14,8 +14,89 @@ from typing import Callable, Iterator, List, Optional
 
 from blaze_tpu.columnar.batch import ColumnBatch
 from blaze_tpu.ops.base import BatchStream, ExecContext, MapLikeOp, Operator, count_stream
-from blaze_tpu.runtime import jit_cache
+from blaze_tpu.runtime import faults, jit_cache
 from blaze_tpu.runtime.metrics import MetricNode
+
+
+def run_task_with_resilience(attempt: Callable[[], object], *,
+                             what: str = "task",
+                             run_info: Optional[dict] = None,
+                             fallback: Optional[Callable[[], object]] = None,
+                             ctx: Optional[ExecContext] = None):
+    """Drive one task attempt through the resilience ladder.
+
+    `attempt` must be a FULL re-runnable unit of work (decode plan ->
+    execute -> commit): every operator here rebuilds its state per
+    attempt and artifact commits are crash-atomic (runtime/artifacts.py),
+    so re-running after a failure is safe — the Spark task-retry model,
+    executed in-engine.
+
+    Policy by error category (faults.classify):
+      retryable  bounded retries (conf.max_task_retries) with exponential
+                 backoff + jitter (faults.backoff_ms)
+      resource   the degradation ladder (conf.enable_degradation_ladder):
+                 rung 1 halves conf.target_batch_bytes for the remaining
+                 attempts, rung 2 forces a MemManager release (self-spill
+                 of every consumer), rung 3 reroutes the task to
+                 `fallback` (the CPU row interpreter in the local runner).
+                 Ladder off => treated as plain retryable.
+      plan/fatal relayed immediately (original exception type preserved)
+      killed     relayed immediately, never counted as an engine error
+
+    Rungs and retries are recorded in the process-global resilience
+    telemetry and, when given, in `run_info` ("retries", "degradations",
+    "degraded.<rung>", "ladder_rung", "errors.<category>")."""
+    from blaze_tpu.config import conf
+    from blaze_tpu.runtime import memory
+
+    retries = 0
+    rung = 0
+    saved_target = None
+    try:
+        while True:
+            try:
+                return attempt()
+            except Exception as e:  # noqa: BLE001 — classify-and-decide
+                cat = faults.classify(e)
+                if cat == "killed":
+                    raise
+                faults.note_error(cat, run_info)
+                ladder = cat == "resource" and conf.enable_degradation_ladder
+                if ladder:
+                    if rung == 0:
+                        rung = 1
+                        saved_target = conf.target_batch_bytes
+                        conf.target_batch_bytes = max(
+                            saved_target // 2, 1 << 20)
+                        faults.note_degradation("halve_batch", run_info)
+                        _note_rung(run_info, rung)
+                        continue
+                    if rung == 1:
+                        rung = 2
+                        memory.get_manager(ctx).release(1 << 62)
+                        faults.note_degradation("force_spill", run_info)
+                        _note_rung(run_info, rung)
+                        continue
+                    if rung == 2 and fallback is not None:
+                        rung = 3
+                        faults.note_degradation("fallback", run_info)
+                        _note_rung(run_info, rung)
+                        return fallback()
+                elif cat in ("retryable", "resource") and \
+                        retries < conf.max_task_retries:
+                    faults.note_retry(run_info)
+                    faults._sleep(faults.backoff_ms(retries) / 1000.0)
+                    retries += 1
+                    continue
+                raise faults.ensure_classified(e) from e
+    finally:
+        if saved_target is not None:
+            conf.target_batch_bytes = saved_target
+
+
+def _note_rung(run_info: Optional[dict], rung: int) -> None:
+    if run_info is not None:
+        run_info["ladder_rung"] = max(run_info.get("ladder_rung", 0), rung)
 
 
 def _fused_chain(op: MapLikeOp) -> tuple:
@@ -211,8 +292,9 @@ def metric_tree(root: Operator) -> MetricNode:
     from blaze_tpu.runtime import compile_service
 
     node = MetricNode.from_operator(root)
-    # process-global compile counters ride along as an extra child (no
-    # handler of its own: embedders that only set the root handler are
-    # unaffected; tree-walking embedders get the compile telemetry)
-    node.children = list(node.children) + [compile_service.telemetry_node()]
+    # process-global compile + resilience counters ride along as extra
+    # children (no handler of their own: embedders that only set the root
+    # handler are unaffected; tree-walking embedders get the telemetry)
+    node.children = list(node.children) + [compile_service.telemetry_node(),
+                                           faults.telemetry_node()]
     return node

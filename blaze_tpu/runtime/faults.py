@@ -1,0 +1,369 @@
+"""Fault injection + error taxonomy + resilience telemetry.
+
+The reference engine survives in production because failure is a relayed,
+retried, *ordinary* event: every native panic/OOM crosses the FFI boundary
+as a classified error and Spark's task retry / speculative execution does
+the rest (SURVEY §5.3). This module gives the TPU engine the same posture,
+plus what the reference never had — a deterministic chaos harness:
+
+  taxonomy   RetryableError / ResourceExhaustedError / PlanError /
+             FatalError, with `classify()` mapping raw JAX/XLA/OS errors
+             (device OOM, transient I/O, plan-shape bugs) onto it. The C
+             ABI mirrors the categories as integer codes
+             (NATIVE_CATEGORY_CODES <-> bn_last_error_category).
+
+  injection  named injection points at op boundaries, serde encode/decode,
+             spill write/read, jit compile, device put/get, the mesh stage
+             exchange and the shuffle commit. Enabled ONLY via
+             `conf.fault_injection_spec`; when the spec is empty the
+             production cost of a point is one attribute load + truthiness
+             check. Trigger semantics per point: fire on the nth call,
+             fail the first N calls then succeed, or fire with probability
+             p from a per-point rng seeded by (spec seed, point) — so a
+             schedule replays bit-identically for the same seed regardless
+             of how points interleave.
+
+  telemetry  process-global counters (faults injected, retries,
+             degradations, fallback routes, per-category errors) exported
+             as a MetricNode by executor.metric_tree and one summary line
+             by tracing.metric_report, with per-run deltas copied into the
+             local runner's run_info.
+
+Spec shape (see README "Failure handling & chaos testing"):
+
+    conf.fault_injection_spec = {
+        "seed": 7,
+        "points": {
+            "serde.encode":  {"kind": "io",  "nth": 3},
+            "spill.write":   {"kind": "oom", "prob": 0.2},
+            "op.FilterExec": {"kind": "retryable", "fail_times": 2},
+            "op":            {"kind": "oom", "nth": 5},   # any operator
+        },
+    }
+
+Install specs through `install()` (it resets the deterministic schedule
+state); point names are hierarchical and a rule for a prefix ("op")
+matches every point beneath it ("op.FilterExec").
+"""
+
+from __future__ import annotations
+
+import errno
+import random
+import time
+from typing import Dict, List, Optional, Tuple
+
+from blaze_tpu.config import conf
+from blaze_tpu.runtime.metrics import MetricNode, MetricsSet
+
+# ---------------------------------------------------------------------------
+# Error taxonomy
+# ---------------------------------------------------------------------------
+
+
+class FaultError(RuntimeError):
+    """Base of the engine's classified errors. `category` drives the
+    executor's resilience ladder; `injected` marks chaos-harness faults."""
+
+    category = "fatal"
+    injected = False
+    point: Optional[str] = None
+
+
+class RetryableError(FaultError):
+    """Transient: a bounded retry with backoff is expected to succeed
+    (lost device tunnel round trip, interrupted I/O, flaky fetch)."""
+
+    category = "retryable"
+
+
+class ResourceExhaustedError(RetryableError):
+    """Device/host memory pressure: retryable only after shedding load —
+    the degradation ladder (halve batch -> force spill -> CPU fallback)
+    applies, not a plain retry."""
+
+    category = "resource"
+
+
+class PlanError(FaultError, NotImplementedError):
+    """Deterministic plan-shape failure (unsupported operator/expression,
+    malformed plan): retrying is pointless, rerouting to the fallback
+    interpreter may not be. Subclasses NotImplementedError so existing
+    callers that probe for unsupported-feature errors keep working."""
+
+    category = "plan"
+
+
+class FatalError(FaultError):
+    """Non-retryable engine/runtime failure; relayed upward unchanged."""
+
+    category = "fatal"
+
+
+CATEGORY_CLASSES = {
+    "retryable": RetryableError,
+    "resource": ResourceExhaustedError,
+    "plan": PlanError,
+    "fatal": FatalError,
+}
+
+# wire codes shared with the C ABI (bn_last_error_category); keep in sync
+# with native/include/blaze_native.h
+NATIVE_CATEGORY_CODES = {
+    "none": 0, "retryable": 1, "resource": 2, "plan": 3, "fatal": 4,
+    "killed": 5,
+}
+NATIVE_CODE_CATEGORIES = {v: k for k, v in NATIVE_CATEGORY_CODES.items()}
+
+_OOM_MARKERS = (
+    "RESOURCE_EXHAUSTED", "Out of memory", "out of memory", "OOM",
+    "Resource exhausted", "failed to allocate", "Allocation failure",
+    "Attempting to allocate",
+)
+_TRANSIENT_MARKERS = (
+    "DEADLINE_EXCEEDED", "UNAVAILABLE", "Connection reset",
+    "Socket closed", "connection closed", "transient",
+    "temporarily unavailable",
+)
+_TRANSIENT_ERRNOS = {errno.EINTR, errno.EAGAIN, errno.EIO, errno.ETIMEDOUT,
+                     errno.ECONNRESET, errno.EPIPE, errno.ENETRESET,
+                     errno.ECONNABORTED}
+
+
+def classify(exc: BaseException) -> str:
+    """Map any exception onto a taxonomy category name.
+
+    "killed" (task-kill cooperation) is its own category: never retried,
+    never wrapped — the embedding layer asked for the interruption."""
+    from blaze_tpu.ops.base import TaskKilledError
+
+    if isinstance(exc, TaskKilledError):
+        return "killed"
+    if isinstance(exc, FaultError):
+        return exc.category
+    if isinstance(exc, MemoryError):
+        return "resource"
+    msg = str(exc)
+    if any(m in msg for m in _OOM_MARKERS):
+        return "resource"
+    if isinstance(exc, OSError):
+        if exc.errno in _TRANSIENT_ERRNOS:
+            return "retryable"
+        return "fatal"
+    if any(m in msg for m in _TRANSIENT_MARKERS):
+        return "retryable"
+    if isinstance(exc, NotImplementedError):
+        return "plan"
+    return "fatal"
+
+
+def ensure_classified(exc: BaseException) -> BaseException:
+    """Wrap an exhausted-recovery error into its taxonomy class.
+
+    Fatal stays UNWRAPPED: a ValueError a test (or an embedder) matches on
+    must keep its type — classification there is observational (counters,
+    bn_last_error_category), not a type change."""
+    if isinstance(exc, FaultError):
+        return exc
+    cat = classify(exc)
+    cls = CATEGORY_CLASSES.get(cat)
+    if cls is None or cat == "fatal":
+        return exc
+    wrapped = cls(f"{type(exc).__name__}: {exc}")
+    wrapped.__cause__ = exc
+    return wrapped
+
+
+# ---------------------------------------------------------------------------
+# Injection registry
+# ---------------------------------------------------------------------------
+
+# every instrumented point (prefixes; "op" covers "op.<OperatorName>").
+# tools/chaos_soak.py sweeps this list.
+KNOWN_POINTS = (
+    "op",
+    "serde.encode",
+    "serde.decode",
+    "spill.write",
+    "spill.read",
+    "jit.compile",
+    "device.put",
+    "device.get",
+    "exchange.stage",
+    "shuffle.commit",
+)
+
+_counters: Dict[str, int] = {}
+_rngs: Dict[str, random.Random] = {}
+injection_log: List[Tuple[str, int]] = []  # (point, per-rule call index)
+_default_jitter = random.Random()
+_sleep = time.sleep  # patchable in tests
+
+TELEMETRY = MetricsSet()
+TELEMETRY.values.clear()  # drop the operator-stream defaults; counters only
+
+
+def install(spec: Optional[dict]) -> None:
+    """Set `conf.fault_injection_spec` and reset the deterministic
+    schedule state (per-point counters, rngs, the injection log)."""
+    conf.fault_injection_spec = spec or {}
+    reset()
+
+
+def reset() -> None:
+    """Restart the injection schedule (counters/rngs/log) for the current
+    spec; same seed => bit-identical schedule on replay."""
+    _counters.clear()
+    _rngs.clear()
+    injection_log.clear()
+    seed = (conf.fault_injection_spec or {}).get("seed")
+    if seed is not None:
+        _rngs["__jitter__"] = random.Random(_mix(seed, "__jitter__"))
+
+
+def reset_telemetry() -> None:
+    TELEMETRY.values.clear()
+
+
+def _mix(seed, key: str) -> int:
+    h = 1469598103934665603  # FNV-1a over the key, folded with the seed
+    for b in key.encode():
+        h = ((h ^ b) * 1099511628211) & ((1 << 64) - 1)
+    return (h ^ (int(seed) * 0x9E3779B97F4A7C15)) & ((1 << 64) - 1)
+
+
+def _rule_for(points: dict, point: str):
+    """Longest-prefix rule lookup over dot-separated point names."""
+    p = point
+    while True:
+        rule = points.get(p)
+        if rule is not None:
+            return p, rule
+        i = p.rfind(".")
+        if i < 0:
+            return None, None
+        p = p[:i]
+
+
+def inject(point: str) -> None:
+    """Raise a classified fault at `point` if the active spec says so.
+
+    Disabled path (empty spec — production): one truthiness check."""
+    spec = conf.fault_injection_spec
+    if not spec:
+        return
+    points = spec.get("points")
+    if not points:
+        return
+    key, rule = _rule_for(points, point)
+    if rule is None:
+        return
+    n = _counters[key] = _counters.get(key, 0) + 1
+    if "nth" in rule:
+        fire = n == int(rule["nth"])
+    elif "fail_times" in rule:
+        fire = n <= int(rule["fail_times"])
+    elif "prob" in rule:
+        rng = _rngs.get(key)
+        if rng is None:
+            rng = _rngs[key] = random.Random(
+                _mix(spec.get("seed", 0), key))
+        fire = rng.random() < float(rule["prob"])
+    else:
+        fire = True
+    if not fire:
+        return
+    TELEMETRY.add("faults_injected", 1)
+    TELEMETRY.add(f"injected.{key}", 1)
+    injection_log.append((point, n))
+    kind = rule.get("kind", "retryable")
+    cls = {"io": RetryableError, "oom": ResourceExhaustedError}.get(
+        kind) or CATEGORY_CLASSES.get(kind, RetryableError)
+    exc = cls(f"injected fault at {point} (call #{n}, kind={kind})")
+    exc.injected = True
+    exc.point = point
+    raise exc
+
+
+def stats() -> Dict[str, int]:
+    return dict(TELEMETRY.values)
+
+
+# ---------------------------------------------------------------------------
+# Retry backoff
+# ---------------------------------------------------------------------------
+
+
+def backoff_ms(attempt: int) -> float:
+    """Exponential backoff with +-25% jitter: base * 2^attempt * U[.75,1.25].
+    The jitter rng is seeded from the fault spec's seed when one is
+    installed, so chaos replays sleep identically."""
+    base = max(float(conf.retry_backoff_ms), 0.0)
+    rng = _rngs.get("__jitter__", _default_jitter)
+    return base * (2.0 ** attempt) * (0.75 + 0.5 * rng.random())
+
+
+# ---------------------------------------------------------------------------
+# Telemetry plumbing (metric_tree node + run_info deltas)
+# ---------------------------------------------------------------------------
+
+
+def note_error(category: str, run_info: Optional[dict] = None) -> None:
+    TELEMETRY.add(f"errors.{category}", 1)
+    if run_info is not None:
+        k = f"errors.{category}"
+        run_info[k] = run_info.get(k, 0) + 1
+
+
+def note_retry(run_info: Optional[dict] = None) -> None:
+    TELEMETRY.add("retries", 1)
+    if run_info is not None:
+        run_info["retries"] = run_info.get("retries", 0) + 1
+
+
+def note_degradation(rung: str, run_info: Optional[dict] = None) -> None:
+    TELEMETRY.add("degradations", 1)
+    TELEMETRY.add(f"degraded.{rung}", 1)
+    if run_info is not None:
+        run_info["degradations"] = run_info.get("degradations", 0) + 1
+        k = f"degraded.{rung}"
+        run_info[k] = run_info.get(k, 0) + 1
+        if rung == "fallback":
+            run_info["task_fallbacks"] = run_info.get("task_fallbacks",
+                                                      0) + 1
+            TELEMETRY.add("task_fallbacks", 1)
+
+
+def run_info_delta(before: Dict[str, int],
+                   run_info: Optional[dict]) -> None:
+    """Copy global-counter deltas since `before` (a TELEMETRY.snapshot())
+    into a run_info dict — counters the injection sites can't reach
+    directly (faults_injected fires deep inside serde/spill/jit)."""
+    if run_info is None:
+        return
+    after = TELEMETRY.snapshot()
+    for k in ("faults_injected", "orphans_swept"):
+        d = after.get(k, 0) - before.get(k, 0)
+        if d:
+            run_info[k] = run_info.get(k, 0) + d
+
+
+def telemetry_node() -> MetricNode:
+    """Resilience counters as a MetricNode child (executor.metric_tree
+    appends it next to the compile-service node; handler stays None)."""
+    return MetricNode(TELEMETRY, [])
+
+
+def telemetry_summary() -> str:
+    """One-line summary for tracing.metric_report ('' when idle)."""
+    v = TELEMETRY.values
+    keys = ("retries", "degradations", "task_fallbacks", "faults_injected")
+    if not any(v.get(k) for k in keys):
+        return ""
+    cats = " ".join(f"{k.split('.', 1)[1]}={n}"
+                    for k, n in sorted(v.items())
+                    if k.startswith("errors.") and n)
+    return ("resilience: retries={retries} degradations={degradations} "
+            "fallbacks={task_fallbacks} faults_injected={faults_injected}"
+            .format(**{k: v.get(k, 0) for k in keys})
+            + (f" [{cats}]" if cats else ""))

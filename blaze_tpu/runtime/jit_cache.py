@@ -57,6 +57,9 @@ def get_or_compile(key: Hashable, make_fn: Callable[[], Callable],
     with _lock:
         _stats["misses"] += 1
     _notify("miss", key)
+    from blaze_tpu.runtime import faults
+
+    faults.inject("jit.compile")
     built = jax.jit(make_fn(), **jit_kwargs) if jit else make_fn()
     if jit:
         built = _with_stale_exec_retry(key, built, make_fn, jit_kwargs)

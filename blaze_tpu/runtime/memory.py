@@ -21,6 +21,7 @@ from __future__ import annotations
 import os
 import tempfile
 import threading
+import weakref
 from typing import BinaryIO, Iterator, List, Optional
 
 from blaze_tpu.columnar import serde
@@ -54,6 +55,14 @@ class MemManager:
         self.op_lock = threading.RLock()
         self.spill_count = 0
         self.spilled_bytes = 0
+        # host spill pages (SpillFile frames buffered but not yet synced
+        # to disk) tracked SEPARATELY from _consumers: they count toward
+        # the budget but must not join the fair_share() denominator —
+        # a spill file is a sink, not a spillable consumer. Weak refs so
+        # tracking never keeps a dropped file (and its tempfile) alive.
+        self._spill_files: List[weakref.ref] = []
+        self.host_spill_bytes = 0
+        self.host_spill_files = 0
 
     # -- registry --
     def register(self, consumer: MemConsumer) -> None:
@@ -65,9 +74,39 @@ class MemManager:
             if consumer in self._consumers:
                 self._consumers.remove(consumer)
 
+    def track_spill(self, sf: "SpillFile") -> None:
+        with self._lock:
+            self._spill_files.append(weakref.ref(sf))
+        self.host_spill_files += 1
+
+    def untrack_spill(self, sf: "SpillFile") -> None:
+        with self._lock:
+            self._spill_files = [r for r in self._spill_files
+                                 if r() is not None and r() is not sf]
+
+    def _live_spill_files(self) -> List["SpillFile"]:
+        with self._lock:
+            live = [(r, r()) for r in self._spill_files]
+            self._spill_files = [r for r, sf in live if sf is not None]
+            return [sf for _, sf in live if sf is not None]
+
     # -- accounting --
     def mem_used(self) -> int:
-        return sum(c.mem_used() for c in self._consumers)
+        return sum(c.mem_used() for c in self._consumers) \
+            + self.spill_pages_pending()
+
+    def spill_pages_pending(self) -> int:
+        """Bytes written to tracked spill files but not yet synced to
+        disk — host buffer pages the budget must account for."""
+        return sum(sf.pending_bytes for sf in self._live_spill_files())
+
+    def flush_spill_pages(self) -> int:
+        """Sync every tracked spill file's buffered frames to disk;
+        returns the pending bytes released back to the budget."""
+        freed = 0
+        for sf in self._live_spill_files():
+            freed += sf.flush_pages()
+        return freed
 
     def fair_share(self) -> int:
         n = max(len(self._consumers), 1)
@@ -83,6 +122,12 @@ class MemManager:
         force spills, which its own fuzztests also rely on).
         """
         used = self.mem_used()
+        if used <= self.total:
+            return
+        # cheapest reclaim first: sync buffered spill pages to disk —
+        # accounting then matches the consumer-only view, so consumer
+        # spill decisions are unchanged when no pages were pending
+        used -= self.flush_spill_pages()
         if used <= self.total:
             return
         over = used - self.total
@@ -138,6 +183,8 @@ class MemManager:
                 self._note_spill(got)
                 if got > 0:
                     freed += got
+            if freed < bytes_needed:
+                freed += self.flush_spill_pages()
         return freed
 
 
@@ -176,30 +223,64 @@ class SpillFile:
     """A sequence of serialized batches in a host tempfile (ref FileSpill,
     onheap_spill.rs:26-75; format = the zstd batch frames)."""
 
-    def __init__(self, schema: Schema, dir: Optional[str] = None) -> None:
+    def __init__(self, schema: Schema, dir: Optional[str] = None,
+                 manager: Optional[MemManager] = None) -> None:
         self.schema = schema
         d = dir or conf.spill_dir
         os.makedirs(d, exist_ok=True)
-        fd, self.path = tempfile.mkstemp(suffix=".spill", dir=d)
+        # pid-tagged name: runtime/artifacts.sweep_orphans reclaims
+        # spill files whose owning process died mid-task
+        fd, self.path = tempfile.mkstemp(
+            prefix=f"blz{os.getpid()}-", suffix=".spill", dir=d)
         self._fp: Optional[BinaryIO] = os.fdopen(fd, "w+b")
         self.bytes_written = 0
         self.num_batches = 0
+        # frames written but not yet synced to disk: host buffer pages
+        # that count against the owning manager's budget
+        self.pending_bytes = 0
+        self._manager = manager
+        if manager is not None:
+            manager.track_spill(self)
 
     def write(self, batch: ColumnBatch) -> int:
+        from blaze_tpu.runtime import faults
+
+        if conf.fault_injection_spec:
+            faults.inject("spill.write")
         n = serde.write_batch(self._fp, batch)
         self.bytes_written += n
         self.num_batches += 1
+        self.pending_bytes += n
+        if self._manager is not None:
+            self._manager.host_spill_bytes += n
         return n
 
+    def flush_pages(self) -> int:
+        """Sync buffered frames to disk; returns pending bytes released."""
+        freed = self.pending_bytes
+        if self._fp is not None and freed:
+            self._fp.flush()
+            os.fsync(self._fp.fileno())
+        self.pending_bytes = 0
+        return freed
+
     def read(self) -> Iterator[ColumnBatch]:
-        self._fp.flush()
+        from blaze_tpu.runtime import faults
+
+        if conf.fault_injection_spec:
+            faults.inject("spill.read")
+        self.flush_pages()
         self._fp.seek(0)
         return serde.read_batches(self._fp, self.schema)
 
     def read_host(self):
         """Frames as host numpy batches (serde.HostBatch) — the spill
         merge consumes runs host-side (ops/host_sort.py)."""
-        self._fp.flush()
+        from blaze_tpu.runtime import faults
+
+        if conf.fault_injection_spec:
+            faults.inject("spill.read")
+        self.flush_pages()
         self._fp.seek(0)
         yield from serde.read_batches_host(self._fp, self.schema)
 
@@ -207,6 +288,9 @@ class SpillFile:
         if self._fp is not None:
             self._fp.close()
             self._fp = None
+            self.pending_bytes = 0
+            if self._manager is not None:
+                self._manager.untrack_spill(self)
             try:
                 os.unlink(self.path)
             except OSError:

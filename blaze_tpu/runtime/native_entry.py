@@ -13,8 +13,27 @@ from __future__ import annotations
 import struct
 
 from blaze_tpu.columnar import serde
+from blaze_tpu.runtime import faults
 from blaze_tpu.runtime.executor import execute_plan
 from blaze_tpu.ops.base import ExecContext
+
+
+def error_category_code(exc: BaseException) -> int:
+    """faults category -> NATIVE_CATEGORY_CODES wire code for `exc`
+    (what bn_last_error_category reports after a failed bn_call)."""
+    return faults.NATIVE_CATEGORY_CODES.get(faults.classify(exc), 4)
+
+
+def exception_for_code(code: int, msg: str = "") -> Exception:
+    """Inverse mapping: rebuild a taxonomy exception from a wire code
+    (hosts that only see the int reconstruct the Python-side class)."""
+    cat = faults.NATIVE_CODE_CATEGORIES.get(code, "fatal")
+    if cat == "killed":
+        from blaze_tpu.ops.base import TaskKilledError
+
+        return TaskKilledError(msg or "task killed")
+    cls = faults.CATEGORY_CLASSES.get(cat, faults.FatalError)
+    return cls(msg or f"native error category {cat}")
 
 
 def init(mem_budget_bytes: bytes) -> None:
@@ -42,12 +61,18 @@ def spill(bytes_needed_le: bytes) -> bytes:
 def run_task_serialized(task_def: bytes) -> bytes:
     from blaze_tpu.plan import decode_task_definition
 
-    plan, td = decode_task_definition(task_def)
-    ctx = ExecContext(partition=td.partition_id)
-    out = bytearray()
-    for batch in execute_plan(plan, ctx):
-        out += serde.serialize_batch(batch)
-    return bytes(out)
+    try:
+        plan, td = decode_task_definition(task_def)
+        ctx = ExecContext(partition=td.partition_id)
+        out = bytearray()
+        for batch in execute_plan(plan, ctx):
+            out += serde.serialize_batch(batch)
+        return bytes(out)
+    except Exception as e:  # noqa: BLE001 — classified for the C ABI
+        # the faults taxonomy must cross the boundary labelled: the C++
+        # layer reads `category` off the exception instance to fill
+        # bn_last_error_category for the host scheduler
+        raise faults.ensure_classified(e) from e
 
 
 # Arrow C-stream payload type codes (consumed by native/src/arrow_stream.cpp)
@@ -93,9 +118,12 @@ def run_task_arrow_payload(task_def: bytes) -> bytes:
     by ArrowFFIStreamImportIterator.scala:63-75)."""
     from blaze_tpu.plan import decode_task_definition
 
-    plan, td = decode_task_definition(task_def)
-    ctx = ExecContext(partition=td.partition_id)
-    out = bytearray(arrow_payload_header(plan.schema))
-    for batch in execute_plan(plan, ctx):
-        out += serde.serialize_batch(batch)
-    return bytes(out)
+    try:
+        plan, td = decode_task_definition(task_def)
+        ctx = ExecContext(partition=td.partition_id)
+        out = bytearray(arrow_payload_header(plan.schema))
+        for batch in execute_plan(plan, ctx):
+            out += serde.serialize_batch(batch)
+        return bytes(out)
+    except Exception as e:  # noqa: BLE001 — classified for the C ABI
+        raise faults.ensure_classified(e) from e

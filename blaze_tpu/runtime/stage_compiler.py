@@ -187,6 +187,12 @@ def try_run_stage(root: Operator, ctx: ExecContext, deferred: bool = False,
     came back clean (a discarded stage never ran to completion)."""
     if not conf.enable_stage_compiler:
         return None
+    if conf.fault_injection_spec:
+        # whole-stage dispatch bypasses the streaming executor's per-op
+        # boundaries — give chaos specs the same "op" point here
+        from blaze_tpu.runtime import faults
+
+        faults.inject("op." + type(root).__name__)
     compile_service.note_stage_attempt()
     m = _match(root)
     if m is None:

@@ -48,9 +48,10 @@ def metric_report(root) -> str:
             walk(c, depth + 1)
 
     walk(root, 0)
-    from blaze_tpu.runtime import compile_service
+    from blaze_tpu.runtime import compile_service, faults
 
-    summary = compile_service.telemetry_summary()
-    if summary:
-        lines.append(summary)
+    for summary in (compile_service.telemetry_summary(),
+                    faults.telemetry_summary()):
+        if summary:
+            lines.append(summary)
     return "\n".join(lines)

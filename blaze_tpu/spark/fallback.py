@@ -483,7 +483,12 @@ def _op_ipc_reader(plan: SparkPlan, part: int, nparts: int) -> pd.DataFrame:
                             ExecContext(partition=part, num_partitions=nparts))
     frames = []
     for item in source:
-        if hasattr(item, "num_rows") and hasattr(item, "to_numpy"):
+        if isinstance(item, serde.HostBatch):
+            # shuffle get_reader_host yields host frames; no device trip
+            from blaze_tpu.ops import host_sort
+
+            frames.append(pd.DataFrame(host_sort.host_to_pylike(item)))
+        elif hasattr(item, "num_rows") and hasattr(item, "to_numpy"):
             frames.append(pd.DataFrame(item.to_numpy()))  # ColumnBatch
         elif isinstance(item, pa.RecordBatch):
             frames.append(item.to_pandas())

@@ -27,8 +27,8 @@ from blaze_tpu.ops.base import ExecContext
 from blaze_tpu.ops.common import concat_batches
 from blaze_tpu.plan import decode_plan
 from blaze_tpu.plan import plan_pb2 as pb
-from blaze_tpu.runtime import resources
-from blaze_tpu.runtime.executor import execute_plan
+from blaze_tpu.runtime import artifacts, faults, resources
+from blaze_tpu.runtime.executor import execute_plan, run_task_with_resilience
 from blaze_tpu.spark.convert_strategy import apply_strategy
 from blaze_tpu.spark.plan_model import SparkPlan
 from blaze_tpu.spark.stages import Stage, plan_stages
@@ -70,6 +70,12 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
     run_info.setdefault("mesh_stages", 0)
     run_info.setdefault("file_stages", 0)
     run_info.setdefault("broadcast_stages", 0)
+    from blaze_tpu.config import conf
+
+    # task setup reclaims dead writers' leftovers (artifact temps in the
+    # work dirs via BlazeShuffleManager, spill files here)
+    artifacts.sweep_orphans([conf.spill_dir])
+    telemetry_before = faults.TELEMETRY.snapshot()
     apply_strategy(root)
     from blaze_tpu.spark import converters, fallback
 
@@ -119,27 +125,42 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
                     )
 
                     stats: Dict[str, int] = {}
-                    if run_mesh_shuffle_stage(
+                    # a transient/resource failure on the mesh degrades to
+                    # the file exchange (same row multisets by design);
+                    # plan/fatal/killed relay — another transport won't fix
+                    # a broken plan
+                    try:
+                        mesh_ok = run_mesh_shuffle_stage(
                             stage.plan, stage.stage_id,
                             _input_tasks(stage, stages), quota=mesh_quota,
-                            work_dir=work_dir, stats=stats):
+                            work_dir=work_dir, stats=stats)
+                    except Exception as e:  # noqa: BLE001 — classified below
+                        cat = faults.classify(e)
+                        if cat in ("killed", "fatal", "plan"):
+                            raise
+                        faults.note_error(cat, run_info)
+                        faults.note_degradation("mesh_to_file", run_info)
+                        mesh_ok = False
+                    if mesh_ok:
                         shuffle_bytes[stage.stage_id] = stats.get("bytes", 0)
                         run_info["mesh_stages"] += 1
                         continue
-                logical = _run_shuffle_stage(stage, stages, shuffle_mgr)
+                logical = _run_shuffle_stage(stage, stages, shuffle_mgr,
+                                             run_info)
                 # logical (uncompressed) bytes: the mesh path reports the
                 # same unit, so the AQE threshold is transport-independent
                 shuffle_bytes[stage.stage_id] = logical
                 run_info["file_stages"] += 1
             elif stage.kind == "broadcast":
-                _run_broadcast_stage(stage, stages)
+                _run_broadcast_stage(stage, stages, run_info)
                 run_info["broadcast_stages"] += 1
             else:
                 parts = _input_tasks(stage, stages, fallback=num_partitions)
-                out = _run_result_stage(stage, parts)
+                out = _run_result_stage(stage, parts, run_info)
                 return _merge_fallback_root_sort(root, out, parts)
         raise AssertionError("no result stage produced")
     finally:
+        faults.run_info_delta(telemetry_before, run_info)
         # release per-query registry entries: FFI export subtrees and the
         # shuffle/broadcast providers (the mesh path's providers pin full
         # capacity-padded HBM batches — leaking them across queries would
@@ -193,11 +214,18 @@ def _schema_of_reader(node: pb.PlanNode):
 
 
 def _run_shuffle_stage(stage: Stage, stages: List[Stage],
-                       shuffle_mgr) -> int:
+                       shuffle_mgr, run_info=None) -> int:
     """Runs the map tasks through the shuffle manager (register ->
     per-task writer slot -> commit MapStatus -> reduce-side reader
     resource); returns the stage's total LOGICAL output bytes
-    (uncompressed, live rows only — the AQE statistic)."""
+    (uncompressed, live rows only — the AQE statistic).
+
+    Each map task is a re-runnable resilience unit: the writer's
+    crash-atomic commit means a failed attempt left no final files, so a
+    retry simply re-executes. The ladder's last rung re-runs the task's
+    map subtree (stage.source) on the row interpreter, feeding the native
+    shuffle writer through an ipc_reader — the committed file format is
+    identical either way."""
     ntasks = _input_tasks(stage, stages)
     # the reader schema is the writer's input schema
     reader_schema = decode_plan(stage.plan.shuffle_writer.input).schema
@@ -210,9 +238,19 @@ def _run_shuffle_stage(stage: Stage, stages: List[Stage],
         slot = shuffle_mgr.get_writer(handle, task)
         node.shuffle_writer.data_file = slot.data_path
         node.shuffle_writer.index_file = slot.index_path
-        op = decode_plan(node)
-        list(execute_plan(op, ExecContext(partition=task,
-                                          num_partitions=ntasks)))
+
+        def attempt(node=node, task=task):
+            op = decode_plan(node)  # fresh operator state per attempt
+            list(execute_plan(op, ExecContext(partition=task,
+                                              num_partitions=ntasks)))
+            return op
+
+        fb = (None if stage.source is None else
+              lambda node=node, task=task: _fallback_shuffle_task(
+                  stage, node, task, ntasks))
+        op = run_task_with_resilience(
+            attempt, what=f"shuffle_map[{stage.stage_id}:{task}]",
+            run_info=run_info, fallback=fb)
         logical += op.metrics.values.get("shuffle_logical_bytes", 0)
         slot.commit()
 
@@ -222,7 +260,44 @@ def _run_shuffle_stage(stage: Stage, stages: List[Stage],
     return logical
 
 
-def _run_broadcast_stage(stage: Stage, stages: List[Stage]) -> None:
+def _fallback_shuffle_task(stage: Stage, node: pb.PlanNode, task: int,
+                           ntasks: int):
+    """Ladder rung 3 for a map task: run the map subtree on the row
+    interpreter and pipe its Arrow batches into the NATIVE shuffle writer
+    via an ipc_reader — repartitioning, serde and the atomic commit stay
+    on the engine path, so readers can't tell a degraded map output from
+    a healthy one."""
+    from blaze_tpu.columnar.arrow_io import batch_from_arrow
+    from blaze_tpu.plan.to_proto import encode_schema
+    from blaze_tpu.spark import fallback
+    from blaze_tpu.spark.converters import bridge_schema
+
+    sch = bridge_schema(stage.source)
+    rid = f"__fallback_src:{stage.stage_id}:{task}"
+
+    def provider(partition=task, nparts=ntasks):
+        for rb in fallback.export_iterator(stage.source, partition, nparts):
+            yield batch_from_arrow(rb, schema=sch)
+
+    resources.put(rid, provider)
+    try:
+        node2 = pb.PlanNode()
+        node2.CopyFrom(node)
+        reader = pb.PlanNode()
+        reader.ipc_reader.schema.CopyFrom(encode_schema(sch))
+        reader.ipc_reader.provider_resource_id = rid
+        reader.ipc_reader.num_partitions = ntasks
+        node2.shuffle_writer.input.CopyFrom(reader)
+        op = decode_plan(node2)
+        list(execute_plan(op, ExecContext(partition=task,
+                                          num_partitions=ntasks)))
+        return op
+    finally:
+        resources.pop(rid)
+
+
+def _run_broadcast_stage(stage: Stage, stages: List[Stage],
+                         run_info=None) -> None:
     # a broadcast stage runs ONE task but must see its upstream shuffles'
     # WHOLE output — a plan like broadcast(final_agg(exchange(...)))
     # would otherwise read only partition 0 and broadcast a quarter of
@@ -230,10 +305,59 @@ def _run_broadcast_stage(stage: Stage, stages: List[Stage]) -> None:
     _rewrite_shuffle_readers_all(stage.plan, stages)
     frames: List[bytes] = []
     resources.put(f"broadcast_sink:{stage.stage_id}", frames.append)
-    op = decode_plan(stage.plan)
-    list(execute_plan(op, ExecContext(partition=0, num_partitions=1)))
+
+    def attempt():
+        del frames[:]  # a half-pushed earlier attempt must not leak frames
+        op = decode_plan(stage.plan)
+        list(execute_plan(op, ExecContext(partition=0, num_partitions=1)))
+        return op
+
+    fb = (None if stage.source is None else
+          lambda: _fallback_broadcast_task(stage, stages, frames))
+    run_task_with_resilience(
+        attempt, what=f"broadcast[{stage.stage_id}]", run_info=run_info,
+        fallback=fb)
     resources.put(f"broadcast:{stage.stage_id}",
                   lambda partition=0: iter(list(frames)))
+
+
+def _fallback_broadcast_task(stage: Stage, stages: List[Stage],
+                             frames: List[bytes]) -> None:
+    """Ladder rung 3 for a broadcast stage: the collect subtree runs on
+    the row interpreter (reading ALL upstream shuffle partitions, like
+    the native rewrite) and its batches are serialized into the same
+    frame format the sink consumers replay."""
+    from blaze_tpu.columnar import serde
+    from blaze_tpu.columnar.arrow_io import batch_from_arrow
+    from blaze_tpu.spark import fallback
+    from blaze_tpu.spark.converters import bridge_schema
+
+    del frames[:]
+    src = _copy_tree_readers_all(stage.source, stages)
+    sch = bridge_schema(src)
+    for rb in fallback.export_iterator(src, 0, 1):
+        frames.append(serde.serialize_batch(batch_from_arrow(rb,
+                                                             schema=sch)))
+
+
+def _copy_tree_readers_all(plan: SparkPlan, stages: List[Stage]) -> SparkPlan:
+    """Copy a SparkPlan tree, pointing shuffle __IpcReaders at the
+    all-partitions resource (the SparkPlan twin of
+    _rewrite_shuffle_readers_all; copies because stage.source is shared
+    with future retries)."""
+    from blaze_tpu.spark.aqe import _all_partitions_resource
+
+    attrs = dict(plan.attrs)
+    if plan.kind == "__IpcReader":
+        rid = attrs.get("resource_id", "")
+        if rid.startswith("shuffle:") and not rid.endswith(":all"):
+            sid = int(rid.split(":")[1])
+            attrs["resource_id"] = _all_partitions_resource(
+                rid, stages[sid].num_partitions)
+            attrs["num_partitions"] = 1
+    return SparkPlan(plan.kind, plan.schema,
+                     [_copy_tree_readers_all(c, stages)
+                      for c in plan.children], attrs)
 
 
 def _rewrite_shuffle_readers_all(node: pb.PlanNode,
@@ -263,6 +387,19 @@ def _rewrite_shuffle_readers_all(node: pb.PlanNode,
                 _rewrite_shuffle_readers_all(val, stages)
 
 
+def _fallback_result_task(stage: Stage, p: int, parts: int,
+                          schema) -> List[ColumnBatch]:
+    """Ladder rung 3 for one result-stage task: the full result subtree
+    (including any root sort the native path strips for the host-ordered
+    collect — re-sorting sorted rows is a no-op) runs on the row
+    interpreter and comes back as one device batch."""
+    from blaze_tpu.columnar.arrow_io import batch_from_arrow
+    from blaze_tpu.spark import fallback
+
+    df = fallback._execute(stage.source, p, parts)
+    return [batch_from_arrow(fallback._to_arrow(df, schema), schema=schema)]
+
+
 def _root_sort_split(op):
     """(specs, limit, strip_depth) for a host-ordered collect, or None.
 
@@ -289,7 +426,8 @@ def _root_sort_split(op):
     return None
 
 
-def _run_result_stage(stage: Stage, parts: int) -> ColumnBatch:
+def _run_result_stage(stage: Stage, parts: int,
+                      run_info=None) -> ColumnBatch:
     """`parts` is the upstream exchange's partition count (_input_tasks) —
     NOT the global default: an 8-way repartition read with 4 tasks would
     silently drop half the shuffle partitions."""
@@ -307,34 +445,48 @@ def _run_result_stage(stage: Stage, parts: int) -> ColumnBatch:
 
     batches: List[ColumnBatch] = []
     for p in range(parts):
-        op_p = decode_plan(stage.plan)  # fresh operator state per task
-        for _ in range(strip):
-            op_p = op_p.children[0]
-        task_ctx = ExecContext(partition=p, num_partitions=parts)
-        staged = try_run_stage(op_p, task_ctx)
-        if staged is not None:
-            batches.append(staged)
-            continue
-        batches.extend(execute_plan(op_p, task_ctx))
+        def attempt(p=p):
+            op_p = decode_plan(stage.plan)  # fresh operator state per task
+            for _ in range(strip):
+                op_p = op_p.children[0]
+            task_ctx = ExecContext(partition=p, num_partitions=parts)
+            staged = try_run_stage(op_p, task_ctx)
+            if staged is not None:
+                return [staged]
+            return list(execute_plan(op_p, task_ctx))
+
+        fb = (None if stage.source is None else
+              lambda p=p: _fallback_result_task(stage, p, parts, op.schema))
+        batches.extend(run_task_with_resilience(
+            attempt, what=f"result[{stage.stage_id}:{p}]",
+            run_info=run_info, fallback=fb))
 
     if split is not None:
         specs, limit, _ = split
         if not batches:
             return ColumnBatch.empty(op.schema)
-        # ordered collect: ONE pull per partition result, order + truncate
-        # on host, hand the driver the host view (no second pull)
-        hbs = [serde.to_host(b) for b in batches
-               if int(b.num_rows) > 0]
-        if not hbs:
-            return ColumnBatch.empty(op.schema)
-        hb = host_sort.host_concat(hbs)
-        perm = host_sort.sort_perm(hb, specs)
-        if limit is not None:
-            perm = perm[:limit]
-        hb = host_sort.host_take(hb, perm)
-        out = host_sort.host_to_device(hb)
-        out._host_numpy = host_sort.host_to_pylike(hb)
-        return out
+
+        def merge():
+            # ordered collect: ONE pull per partition result, order +
+            # truncate on host, hand the driver the host view (no second
+            # pull). A pure function of `batches`, so a failed device
+            # pull/upload mid-merge simply re-runs.
+            hbs = [serde.to_host(b) for b in batches
+                   if int(b.num_rows) > 0]
+            if not hbs:
+                return ColumnBatch.empty(op.schema)
+            hb = host_sort.host_concat(hbs)
+            perm = host_sort.sort_perm(hb, specs)
+            if limit is not None:
+                perm = perm[:limit]
+            hb = host_sort.host_take(hb, perm)
+            out = host_sort.host_to_device(hb)
+            out._host_numpy = host_sort.host_to_pylike(hb)
+            return out
+
+        return run_task_with_resilience(
+            merge, what=f"result_merge[{stage.stage_id}]",
+            run_info=run_info)
 
     if not batches:
         return ColumnBatch.empty(op.schema)

@@ -87,8 +87,13 @@ class BlazeShuffleManager:
     .data/.index files (ref: BlazeShuffleManager in the shims)."""
 
     def __init__(self, work_dir: str) -> None:
+        from blaze_tpu.runtime import artifacts
+
         self.work_dir = work_dir
         os.makedirs(work_dir, exist_ok=True)
+        # a previous executor killed mid-commit leaves .inprogress. temps
+        # (never final names) in the shared work dir — reclaim them now
+        artifacts.sweep_orphans([work_dir])
         self._handles: Dict[int, ShuffleHandle] = {}
         self._map_outputs: Dict[int, List[MapStatus]] = {}
 

@@ -33,6 +33,10 @@ class Stage:
     plan: pb.PlanNode  # native plan for one task of this stage
     num_partitions: int
     depends_on: List[int]
+    # the SparkPlan subtree this stage's plan was converted from: what
+    # the resilience ladder re-runs through the CPU fallback interpreter
+    # (spark/fallback.py) when a task exhausts every native rung
+    source: Optional[SparkPlan] = None
 
 
 def plan_stages(root: SparkPlan, default_partitions: int = 1) -> List[Stage]:
@@ -64,7 +68,7 @@ def plan_stages(root: SparkPlan, default_partitions: int = 1) -> List[Stage]:
             w.index_file = f"__shuffle_{sid}__.index"
             stages.append(Stage(sid, "shuffle_map", node,
                                 w.partitioning.num_partitions,
-                                _deps_of(child)))
+                                _deps_of(child), source=child))
             reader = SparkPlan("__IpcReader", plan.schema, [],
                                {"resource_id": f"shuffle:{sid}",
                                 "num_partitions":
@@ -77,7 +81,8 @@ def plan_stages(root: SparkPlan, default_partitions: int = 1) -> List[Stage]:
             node = pb.PlanNode()
             node.ipc_writer.input.CopyFrom(convert_spark_plan(child))
             node.ipc_writer.consumer_resource_id = f"broadcast_sink:{sid}"
-            stages.append(Stage(sid, "broadcast", node, 1, _deps_of(child)))
+            stages.append(Stage(sid, "broadcast", node, 1, _deps_of(child),
+                                source=child))
             return SparkPlan("__IpcReader", plan.schema, [],
                              {"resource_id": f"broadcast:{sid}",
                               "num_partitions": 1, "stage_dep": sid})
@@ -87,7 +92,8 @@ def plan_stages(root: SparkPlan, default_partitions: int = 1) -> List[Stage]:
     result_tree = walk(root)
     result_pb = convert_spark_plan(result_tree)
     stages.append(Stage(len(stages), "result", result_pb,
-                        default_partitions, _deps_of(result_tree)))
+                        default_partitions, _deps_of(result_tree),
+                        source=result_tree))
     return stages
 
 

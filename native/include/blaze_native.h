@@ -98,6 +98,12 @@ int bn_call_py(const uint8_t* task_def, int64_t len, const char* entry,
 int64_t bn_spill(int64_t bytes_needed);
 /* last error message (thread-local), empty string if none */
 const char* bn_last_error(void);
+/* error category of the last failed call on this thread, so the host
+ * (JVM task scheduler / Python executor) can pick retry vs. degrade vs.
+ * abort without parsing messages. Codes match
+ * blaze_tpu.runtime.faults.NATIVE_CATEGORY_CODES:
+ *   0 none, 1 retryable, 2 resource, 3 plan, 4 fatal, 5 killed */
+int bn_last_error_category(void);
 int bn_finalize(void);
 void bn_free_buffer(uint8_t* buf);
 

@@ -21,6 +21,10 @@
 namespace {
 
 thread_local std::string g_last_error;
+// category of the last failure, NATIVE_CATEGORY_CODES wire codes
+// (blaze_native.h / blaze_tpu.runtime.faults): 0 none, 1 retryable,
+// 2 resource, 3 plan, 4 fatal, 5 killed
+thread_local int g_last_category = 0;
 
 // minimal Python C-API surface, resolved at runtime
 struct PyApi {
@@ -35,6 +39,7 @@ struct PyApi {
   void (*Dec)(void*);
   void* (*Err_Occurred)();
   void (*Err_Fetch)(void**, void**, void**);
+  void (*Err_Clear)();
   void* (*Object_Str)(void*);
   const char* (*Unicode_AsUTF8)(void*);
   bool ok = false;
@@ -75,6 +80,7 @@ bool load_py_api() {
   g_py.Err_Occurred = reinterpret_cast<void* (*)()>(sym("PyErr_Occurred"));
   g_py.Err_Fetch = reinterpret_cast<void (*)(void**, void**, void**)>(
       sym("PyErr_Fetch"));
+  g_py.Err_Clear = reinterpret_cast<void (*)()>(sym("PyErr_Clear"));
   g_py.Object_Str = reinterpret_cast<void* (*)(void*)>(sym("PyObject_Str"));
   g_py.Unicode_AsUTF8 =
       reinterpret_cast<const char* (*)(void*)>(sym("PyUnicode_AsUTF8"));
@@ -83,13 +89,59 @@ bool load_py_api() {
   return g_py.ok;
 }
 
+int category_of_py_error(void* type, void* value) {
+  // The Python engine classifies task errors into the faults taxonomy
+  // before they cross this boundary (native_entry wraps the task entries
+  // in faults.ensure_classified), so the instance normally carries a
+  // `category` string attribute. Fall back to the type name for raw
+  // exceptions; anything unrecognized is fatal.
+  if (!g_py.Object_GetAttrString || !g_py.Unicode_AsUTF8) return 4;
+  if (value) {
+    void* cat = g_py.Object_GetAttrString(value, "category");
+    if (cat) {
+      const char* s = g_py.Unicode_AsUTF8(cat);
+      int code = 4;
+      if (s) {
+        if (std::strcmp(s, "retryable") == 0) code = 1;
+        else if (std::strcmp(s, "resource") == 0) code = 2;
+        else if (std::strcmp(s, "plan") == 0) code = 3;
+        else if (std::strcmp(s, "killed") == 0) code = 5;
+      }
+      g_py.Dec(cat);
+      return code;
+    }
+    if (g_py.Err_Clear) g_py.Err_Clear();  // GetAttrString set a new error
+  }
+  if (type) {
+    void* nm = g_py.Object_GetAttrString(type, "__name__");
+    if (nm) {
+      const char* s = g_py.Unicode_AsUTF8(nm);
+      int code = 4;
+      if (s) {
+        if (std::strstr(s, "TaskKilled")) code = 5;
+        else if (std::strcmp(s, "MemoryError") == 0) code = 2;
+        else if (std::strcmp(s, "NotImplementedError") == 0) code = 3;
+        else if (std::strcmp(s, "TimeoutError") == 0 ||
+                 std::strcmp(s, "ConnectionError") == 0 ||
+                 std::strcmp(s, "BrokenPipeError") == 0) code = 1;
+      }
+      g_py.Dec(nm);
+      return code;
+    }
+    if (g_py.Err_Clear) g_py.Err_Clear();
+  }
+  return 4;
+}
+
 void capture_py_error() {
   if (!g_py.Err_Occurred || !g_py.Err_Occurred()) {
     g_last_error = "python call failed (no exception info)";
+    g_last_category = 4;
     return;
   }
   void *type = nullptr, *value = nullptr, *tb = nullptr;
   g_py.Err_Fetch(&type, &value, &tb);
+  g_last_category = category_of_py_error(type, value);
   if (value && g_py.Object_Str && g_py.Unicode_AsUTF8) {
     void* s = g_py.Object_Str(value);
     const char* msg = s ? g_py.Unicode_AsUTF8(s) : nullptr;
@@ -109,9 +161,12 @@ extern "C" {
 
 const char* bn_last_error(void) { return g_last_error.c_str(); }
 
+int bn_last_error_category(void) { return g_last_category; }
+
 int bn_init(int64_t mem_budget) {
   if (!load_py_api()) {
     g_last_error = "python runtime not available";
+    g_last_category = 4;
     return -1;
   }
   void* gil = g_py.GILState_Ensure();
@@ -145,6 +200,7 @@ int bn_call_py(const uint8_t* task_def, int64_t len, const char* entry,
                uint8_t** out, int64_t* out_len) {
   if (!load_py_api()) {
     g_last_error = "python runtime not available";
+    g_last_category = 4;
     return -1;
   }
   void* gil = g_py.GILState_Ensure();
@@ -175,6 +231,7 @@ int bn_call_py(const uint8_t* task_def, int64_t len, const char* entry,
     char* data = g_py.Bytes_AsString(res);
     if (sz < 0 || !data) {
       g_last_error = "task entry must return bytes";
+      g_last_category = 4;
       rc = -5;
     } else {
       *out = static_cast<uint8_t*>(std::malloc(sz));
@@ -214,6 +271,7 @@ int64_t bn_spill(int64_t bytes_needed) {
 
 int bn_finalize(void) {
   g_last_error.clear();
+  g_last_category = 0;
   return 0;
 }
 
