@@ -7,8 +7,8 @@
 
 PYENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
-.PHONY: check check-fast check-faults test test-fast validate \
-	validate-fast warm
+.PHONY: check check-fast check-faults check-supervisor test test-fast \
+	validate validate-fast warm
 
 check: test validate
 	@echo "CHECK OK — safe to commit"
@@ -41,7 +41,17 @@ validate-fast:
 # oracle (or fail classified) and leave no orphans/leaked reservations.
 # Emits FAULTS_r06.json.
 check-faults:
-	$(PYENV) python tools/chaos_soak.py --json-out FAULTS_r06.json
+	$(PYENV) python tools/chaos_soak.py --kinds io,oom,stall \
+	  --stall-ms 300 --json-out FAULTS_r06.json
+
+# Supervisor soak: the same point x kind sweep — plus the "stall" kind —
+# under the CONCURRENT supervised pool (4 workers, hang detection +
+# straggler speculation armed). Stall cells must recover via watchdog
+# kill + relaunch, answers must match the pandas oracle, and no cell may
+# leave orphans or leaked reservations. Emits SUPERVISOR_r07.json.
+check-supervisor:
+	$(PYENV) python tools/chaos_soak.py --supervisor \
+	  --json-out SUPERVISOR_r07.json
 
 # Pre-warm the persistent compile caches (runtime/compile_service):
 # replays the shape manifest + the TPC-DS catalogue into the XLA cache.

@@ -96,6 +96,33 @@ class BlazeConf:
     # force MemManager spill -> route the task to the CPU fallback
     # interpreter. Off = resource errors get plain bounded retries.
     enable_degradation_ladder: bool = True
+    # -- task supervisor (runtime/supervisor.py) --
+    # Off = the PR-2 sequential runner: tasks run inline on the driver
+    # thread with retries/ladder only (no pool, watchdog, speculation).
+    enable_supervisor: bool = True
+    # bounded worker pool for shuffle-map / broadcast / result tasks.
+    # Deterministic chaos replay forces this to 1 while a fault spec
+    # without {"concurrent": true} is armed (scheduling order is part of
+    # the injection schedule).
+    max_concurrent_tasks: int = 4
+    # wall-clock budget per task (all attempts incl. retries/backoff) and
+    # per query; 0 = unlimited. Exhaustion raises faults.DeadlineError.
+    task_deadline_ms: int = 0
+    query_deadline_ms: int = 0
+    # watchdog hang detection: an attempt whose heartbeat (kill-flag
+    # checks at batch boundaries) stalls past this is cancelled and
+    # relaunched under the resilience ladder. 0 disables — a first jit
+    # compile can legitimately sit minutes without a batch boundary.
+    hang_detect_ms: int = 0
+    # straggler speculation: a running attempt exceeding multiplier x the
+    # median completed-attempt duration of its stage gets a speculative
+    # twin; first commit wins, the loser is cancelled. 0 disables
+    # (Spark's spark.speculation default; its multiplier default is 1.5).
+    speculation_multiplier: float = 0.0
+    # per-operator circuit breaker: after this many classified failures
+    # attributed to one operator kind within a query, that operator trips
+    # to the row-interpreter fallback for the rest of the run. 0 disables.
+    breaker_failure_threshold: int = 4
     # per-operator enable flags (tier b, spark.blaze.enable.<op>)
     enable_ops: Dict[str, bool] = dataclasses.field(default_factory=dict)
 

@@ -81,6 +81,14 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.bn_last_error_category.argtypes = []
     except AttributeError:
         pass
+    try:  # older .so builds predate the kill-flag symbols
+        for kname in ("bn_request_kill", "bn_clear_kill",
+                      "bn_kill_requested"):
+            kfn = getattr(lib, kname)
+            kfn.restype = ctypes.c_int
+            kfn.argtypes = []
+    except AttributeError:
+        pass
     lib.bn_free_buffer.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
     for name, argtypes in [
         ("bn_hash_i32", [ctypes.c_void_p] * 2 + [ctypes.c_int64,
@@ -112,6 +120,35 @@ def last_error_category() -> int:
         return int(lib.bn_last_error_category())
     except AttributeError:
         return 0
+
+
+def request_kill() -> None:
+    """bn_request_kill: cooperatively cancel running native tasks (the
+    C-ABI mirror of the supervisor's per-attempt kill flag). No-op when
+    the loaded .so predates the symbol."""
+    lib = _load()
+    try:
+        lib.bn_request_kill()
+    except AttributeError:
+        pass
+
+
+def clear_kill() -> None:
+    """bn_clear_kill: re-arm after a kill so the next task may run."""
+    lib = _load()
+    try:
+        lib.bn_clear_kill()
+    except AttributeError:
+        pass
+
+
+def kill_requested() -> bool:
+    """bn_kill_requested: whether the native kill flag is set."""
+    lib = _load()
+    try:
+        return int(lib.bn_kill_requested()) > 0
+    except AttributeError:
+        return False
 
 
 def _native_error(what: str, rc: int) -> Exception:

@@ -30,8 +30,16 @@ class ExecContext:
     batch_size: Optional[int] = None
     # populated by runtime.memory when spilling is enabled
     mem_manager: Optional[object] = None
-    # task-kill cooperation (ref JniBridge.isTaskRunning polling)
+    # task-kill cooperation (ref JniBridge.isTaskRunning polling). The
+    # supervisor wires each TaskAttempt's flag check here — every
+    # check_running() call at a batch boundary doubles as the attempt's
+    # HEARTBEAT (proof of cooperative liveness for hang detection).
     is_running: Callable[[], bool] = lambda: True
+    # first-commit-wins gate shared by an attempt and its speculative
+    # twin (runtime/supervisor.CommitGate); file-publishing operators
+    # (the shuffle writer) claim it before os.replace so racing attempts
+    # can never double-commit. None = uncontended (no speculation).
+    commit_gate: Optional[object] = None
 
     def check_running(self) -> None:
         if not self.is_running():
@@ -40,6 +48,13 @@ class ExecContext:
 
 class TaskKilledError(RuntimeError):
     pass
+
+
+class SpeculationLostError(TaskKilledError):
+    """This attempt lost the first-commit-wins race to its speculative
+    twin. A TaskKilledError subclass: classified "killed", never retried,
+    never counted as an engine error — the winner already produced the
+    task's output."""
 
 
 class Operator:

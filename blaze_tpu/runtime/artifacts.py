@@ -30,6 +30,15 @@ ORPHAN_TAG = ".inprogress."
 _SPILL_RE = re.compile(r"^blz(\d+)-.*\.spill$")
 _seq = itertools.count()
 
+# Per-directory sweep mutex. Two processes (or two concurrent tasks whose
+# queries share a work dir) racing sweep_orphans() could both stat a temp,
+# then one's listdir snapshot names files the other already reclaimed —
+# or worse, a sweeper could reclaim a temp whose writer pid it read as
+# dead while a *new* writer with a recycled pid stages the same name. The
+# lockfile is pid-stamped so a sweeper that died mid-sweep doesn't wedge
+# the directory: a stale lock held by a dead pid is broken and retaken.
+SWEEP_LOCK = ".blz_sweep.lock"
+
 
 def stage_path(final_path: str) -> str:
     """Temp path for `final_path`, unique per (process, call), carrying
@@ -64,7 +73,8 @@ def commit_file(write_fn: Callable[[str], None], final_path: str,
         raise
 
 
-def commit_shuffle_pair(write_fn, data_path: str, index_path: str):
+def commit_shuffle_pair(write_fn, data_path: str, index_path: str,
+                        gate=None):
     """Commit a map task's `.data`/`.index` pair crash-atomically.
 
     `write_fn(tmp_data, tmp_index) -> lengths` produces both files (the
@@ -73,18 +83,36 @@ def commit_shuffle_pair(write_fn, data_path: str, index_path: str):
     index must never name data that isn't fully in place. The
     `shuffle.commit` fault point fires between staging and publishing —
     a fault (or kill) there leaves only `.inprogress.` temps behind,
-    which the next task's sweep reclaims."""
+    which the next task's sweep reclaims.
+
+    `gate` (supervisor CommitGate, via ExecContext.commit_gate): the
+    first-commit-wins arbiter between an attempt and its speculative
+    twin. Claimed AFTER staging, immediately before publish — the loser
+    finds the gate taken, sweeps its own temps and aborts as
+    SpeculationLostError, so exactly one final pair ever appears and no
+    partials leak. A claim that then fails to publish is released so the
+    task's retry can commit."""
     tmp_data = stage_path(data_path)
     tmp_index = stage_path(index_path)
+    claimed = False
     try:
         lengths = write_fn(tmp_data, tmp_index)
         _fsync_path(tmp_data)
         _fsync_path(tmp_index)
         faults.inject("shuffle.commit")
+        if gate is not None:
+            if not gate.claim():
+                from blaze_tpu.ops.base import SpeculationLostError
+
+                raise SpeculationLostError(
+                    f"lost first-commit-wins race for {data_path}")
+            claimed = True
         os.replace(tmp_data, data_path)
         os.replace(tmp_index, index_path)
         return lengths
     except BaseException:
+        if claimed:
+            gate.abort()  # let the surviving lineage's retry commit
         _unlink_quiet(tmp_data)
         _unlink_quiet(tmp_index)
         raise
@@ -121,28 +149,68 @@ def _orphan_pid(name: str) -> int:
     return -1
 
 
+def _acquire_sweep_lock(d: str) -> bool:
+    """Take the per-directory sweep lock, breaking it if its holder died.
+    Returns False when another live process is sweeping (skip the dir —
+    it is being cleaned anyway)."""
+    path = os.path.join(d, SWEEP_LOCK)
+    for _ in range(2):  # second pass only after breaking a stale lock
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            try:
+                with open(path, "r") as f:
+                    holder = f.read().strip()
+            except OSError:
+                return False  # holder removed it between open attempts
+            if holder.isdigit() and _pid_alive(int(holder)):
+                return False
+            _unlink_quiet(path)  # stale: holder is dead or wrote garbage
+            continue
+        except OSError:
+            return False  # unwritable directory: nothing to sweep safely
+        try:
+            os.write(fd, str(os.getpid()).encode())
+        finally:
+            os.close(fd)
+        return True
+    return False
+
+
+def _release_sweep_lock(d: str) -> None:
+    _unlink_quiet(os.path.join(d, SWEEP_LOCK))
+
+
 def sweep_orphans(directories: Sequence[str], include_self: bool = False
                   ) -> List[str]:
     """Remove dead writers' leftovers from `directories`; returns removed
     paths. `include_self` additionally reclaims THIS process's temps —
-    only safe at points where no commit is in flight (test harnesses)."""
+    only safe at points where no commit is in flight (test harnesses).
+    Each directory is swept under a pid-stamped lockfile so concurrent
+    sweepers never race each other's listdir snapshots."""
     removed: List[str] = []
     if isinstance(directories, str):
         directories = [directories]
     for d in directories:
-        try:
-            names = os.listdir(d)
-        except OSError:
+        if not _acquire_sweep_lock(d):
             continue
-        for name in names:
-            pid = _orphan_pid(name)
-            if pid < 0:
+        try:
+            try:
+                names = os.listdir(d)
+            except OSError:
                 continue
-            if _pid_alive(pid) and not (include_self and pid == os.getpid()):
-                continue
-            path = os.path.join(d, name)
-            _unlink_quiet(path)
-            removed.append(path)
+            for name in names:
+                pid = _orphan_pid(name)
+                if pid < 0:
+                    continue
+                if _pid_alive(pid) and not (include_self
+                                            and pid == os.getpid()):
+                    continue
+                path = os.path.join(d, name)
+                _unlink_quiet(path)
+                removed.append(path)
+        finally:
+            _release_sweep_lock(d)
     if removed:
         faults.TELEMETRY.add("orphans_swept", len(removed))
     return removed

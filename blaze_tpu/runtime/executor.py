@@ -22,7 +22,9 @@ def run_task_with_resilience(attempt: Callable[[], object], *,
                              what: str = "task",
                              run_info: Optional[dict] = None,
                              fallback: Optional[Callable[[], object]] = None,
-                             ctx: Optional[ExecContext] = None):
+                             ctx: Optional[ExecContext] = None,
+                             deadline: Optional[float] = None,
+                             on_error: Optional[Callable] = None):
     """Drive one task attempt through the resilience ladder.
 
     `attempt` must be a FULL re-runnable unit of work (decode plan ->
@@ -45,11 +47,23 @@ def run_task_with_resilience(attempt: Callable[[], object], *,
 
     Rungs and retries are recorded in the process-global resilience
     telemetry and, when given, in `run_info` ("retries", "degradations",
-    "degraded.<rung>", "ladder_rung", "errors.<category>")."""
+    "degraded.<rung>", "ladder_rung", "errors.<category>").
+
+    `deadline` (time.monotonic seconds, from the supervisor's
+    task/query budgets): backoff sleeps are CLAMPED to the remaining
+    budget, and a retryable failure with no budget left is reclassified
+    to faults.DeadlineError instead of sleeping past the deadline.
+
+    `on_error(exc, category)` is invoked for every classified failure
+    except "killed" — the supervisor's per-operator circuit breaker
+    counts failures through it."""
+    import time as _time
+
     from blaze_tpu.config import conf
     from blaze_tpu.runtime import memory
 
     retries = 0
+    hang_relaunches = 0
     rung = 0
     saved_target = None
     try:
@@ -61,6 +75,11 @@ def run_task_with_resilience(attempt: Callable[[], object], *,
                 if cat == "killed":
                     raise
                 faults.note_error(cat, run_info)
+                if on_error is not None:
+                    try:
+                        on_error(e, cat)
+                    except Exception:  # noqa: BLE001 — observer only
+                        pass
                 ladder = cat == "resource" and conf.enable_degradation_ladder
                 if ladder:
                     if rung == 0:
@@ -82,16 +101,45 @@ def run_task_with_resilience(attempt: Callable[[], object], *,
                         faults.note_degradation("fallback", run_info)
                         _note_rung(run_info, rung)
                         return fallback()
+                elif isinstance(e, faults.HungError) and \
+                        hang_relaunches < conf.max_task_retries:
+                    # a watchdog kill-on-suspicion, not a failure: its
+                    # own relaunch budget (a false-positive hang must
+                    # not drain the error-retry budget) and no backoff
+                    # sleep — but never relaunch past the deadline
+                    if deadline is not None and \
+                            _time.monotonic() >= deadline:
+                        raise faults.DeadlineError(
+                            f"{what}: hang-relaunch budget exhausted by "
+                            f"deadline (after {hang_relaunches} "
+                            f"relaunches)") from e
+                    faults.note_retry(run_info)
+                    hang_relaunches += 1
+                    continue
                 elif cat in ("retryable", "resource") and \
                         retries < conf.max_task_retries:
+                    sleep_s = faults.backoff_ms(retries) / 1000.0
+                    if deadline is not None:
+                        remaining = deadline - _time.monotonic()
+                        if remaining <= 0:
+                            raise faults.DeadlineError(
+                                f"{what}: retry budget exhausted by "
+                                f"deadline (after {retries} retries)"
+                            ) from e
+                        sleep_s = min(sleep_s, remaining)
                     faults.note_retry(run_info)
-                    faults._sleep(faults.backoff_ms(retries) / 1000.0)
+                    faults._sleep(sleep_s)
                     retries += 1
                     continue
                 raise faults.ensure_classified(e) from e
     finally:
         if saved_target is not None:
-            conf.target_batch_bytes = saved_target
+            # restore-to-max: with concurrent tasks two ladders can
+            # interleave their save/restore — taking the max keeps a
+            # degraded (halved) target from outliving the query even if
+            # the saves raced
+            conf.target_batch_bytes = max(conf.target_batch_bytes,
+                                          saved_target)
 
 
 def _note_rung(run_info: Optional[dict], rung: int) -> None:

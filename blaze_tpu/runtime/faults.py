@@ -21,7 +21,15 @@ plus what the reference never had — a deterministic chaos harness:
              fail the first N calls then succeed, or fire with probability
              p from a per-point rng seeded by (spec seed, point) — so a
              schedule replays bit-identically for the same seed regardless
-             of how points interleave.
+             of how points interleave. Kinds map to the taxonomy ("io",
+             "oom", "plan", "fatal"); the special kind "stall" HANGS at
+             the point (cooperative sleep, rule "ms" bounds it) instead
+             of raising — the deterministic trigger for the supervisor's
+             hang detection and straggler speculation. Replay determinism
+             also covers SCHEDULING: while a spec without
+             {"concurrent": true} is armed, the supervisor serializes its
+             task pool so point interleavings don't depend on thread
+             timing.
 
   telemetry  process-global counters (faults injected, retries,
              degradations, fallback routes, per-category errors) exported
@@ -50,6 +58,7 @@ from __future__ import annotations
 
 import errno
 import random
+import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -85,6 +94,15 @@ class ResourceExhaustedError(RetryableError):
     category = "resource"
 
 
+class HungError(RetryableError):
+    """A supervisor watchdog kill-on-suspicion: the attempt's heartbeat
+    went stale past conf.hang_detect_ms. Retryable, but budgeted
+    SEPARATELY from error retries in the ladder — the attempt did not
+    fail, it was killed, and a false positive (a long jit compile
+    between batch boundaries) must not consume the task's real retry
+    budget. Relaunches skip the backoff sleep for the same reason."""
+
+
 class PlanError(FaultError, NotImplementedError):
     """Deterministic plan-shape failure (unsupported operator/expression,
     malformed plan): retrying is pointless, rerouting to the fallback
@@ -98,6 +116,14 @@ class FatalError(FaultError):
     """Non-retryable engine/runtime failure; relayed upward unchanged."""
 
     category = "fatal"
+
+
+class DeadlineError(FatalError):
+    """A task/query wall-clock budget (conf.task_deadline_ms /
+    conf.query_deadline_ms) was exhausted. Fatal by construction: there
+    is no time left to retry in — a retryable failure that runs out of
+    budget is RECLASSIFIED to this (the executor's deadline-clamped
+    backoff), so callers see "deadline", not a half-slept retry."""
 
 
 CATEGORY_CLASSES = {
@@ -198,6 +224,10 @@ _rngs: Dict[str, random.Random] = {}
 injection_log: List[Tuple[str, int]] = []  # (point, per-rule call index)
 _default_jitter = random.Random()
 _sleep = time.sleep  # patchable in tests
+# schedule state is shared by every task thread under the supervisor's
+# pool: the lock keeps per-rule call counts exact (a lost increment would
+# silently shift an nth/fail_times schedule)
+_sched_lock = threading.Lock()
 
 TELEMETRY = MetricsSet()
 TELEMETRY.values.clear()  # drop the operator-stream defaults; counters only
@@ -213,12 +243,13 @@ def install(spec: Optional[dict]) -> None:
 def reset() -> None:
     """Restart the injection schedule (counters/rngs/log) for the current
     spec; same seed => bit-identical schedule on replay."""
-    _counters.clear()
-    _rngs.clear()
-    injection_log.clear()
-    seed = (conf.fault_injection_spec or {}).get("seed")
-    if seed is not None:
-        _rngs["__jitter__"] = random.Random(_mix(seed, "__jitter__"))
+    with _sched_lock:
+        _counters.clear()
+        _rngs.clear()
+        injection_log.clear()
+        seed = (conf.fault_injection_spec or {}).get("seed")
+        if seed is not None:
+            _rngs["__jitter__"] = random.Random(_mix(seed, "__jitter__"))
 
 
 def reset_telemetry() -> None:
@@ -258,31 +289,70 @@ def inject(point: str) -> None:
     key, rule = _rule_for(points, point)
     if rule is None:
         return
-    n = _counters[key] = _counters.get(key, 0) + 1
-    if "nth" in rule:
-        fire = n == int(rule["nth"])
-    elif "fail_times" in rule:
-        fire = n <= int(rule["fail_times"])
-    elif "prob" in rule:
-        rng = _rngs.get(key)
-        if rng is None:
-            rng = _rngs[key] = random.Random(
-                _mix(spec.get("seed", 0), key))
-        fire = rng.random() < float(rule["prob"])
-    else:
-        fire = True
+    with _sched_lock:
+        n = _counters[key] = _counters.get(key, 0) + 1
+        if "nth" in rule:
+            fire = n == int(rule["nth"])
+        elif "fail_times" in rule:
+            fire = n <= int(rule["fail_times"])
+        elif "prob" in rule:
+            rng = _rngs.get(key)
+            if rng is None:
+                rng = _rngs[key] = random.Random(
+                    _mix(spec.get("seed", 0), key))
+            fire = rng.random() < float(rule["prob"])
+        else:
+            fire = True
+        if fire:
+            injection_log.append((point, n))
     if not fire:
         return
     TELEMETRY.add("faults_injected", 1)
     TELEMETRY.add(f"injected.{key}", 1)
-    injection_log.append((point, n))
     kind = rule.get("kind", "retryable")
+    if kind == "stall":
+        _stall(point, n, rule)
+        return
     cls = {"io": RetryableError, "oom": ResourceExhaustedError}.get(
         kind) or CATEGORY_CLASSES.get(kind, RetryableError)
     exc = cls(f"injected fault at {point} (call #{n}, kind={kind})")
     exc.injected = True
     exc.point = point
     raise exc
+
+
+def _stall(point: str, n: int, rule: dict) -> None:
+    """The "stall" injection kind: HANG at the armed point instead of
+    raising — the deterministic stand-in for a stuck native call or a
+    wedged JIT compile that the supervisor's hang detection / straggler
+    speculation must absorb (ISSUE 3). The sleep is cooperative: it
+    polls the supervising attempt's kill flag every few ms, so a
+    watchdog cancel interrupts the stall as TaskKilledError exactly the
+    way a batch-boundary check would; with no supervisor the stall ends
+    after rule "ms" (default 30s) and execution continues unharmed — a
+    stall is a delay, not an error."""
+    from blaze_tpu.ops.base import TaskKilledError
+
+    TELEMETRY.add("stalls_injected", 1)
+    ms = float(rule.get("ms", 30_000.0))
+    deadline = time.monotonic() + ms / 1000.0
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return
+        step = min(0.005, remaining)
+        ev = None
+        try:  # lazy: supervisor imports faults
+            from blaze_tpu.runtime import supervisor
+
+            ev = supervisor.current_kill_event()
+        except Exception:  # noqa: BLE001 — stall must never crash a task
+            pass
+        if ev is None:
+            _sleep(step)
+        elif ev.wait(step):
+            raise TaskKilledError(
+                f"stalled attempt killed at {point} (call #{n})")
 
 
 def stats() -> Dict[str, int]:
@@ -342,7 +412,7 @@ def run_info_delta(before: Dict[str, int],
     if run_info is None:
         return
     after = TELEMETRY.snapshot()
-    for k in ("faults_injected", "orphans_swept"):
+    for k in ("faults_injected", "orphans_swept", "stalls_injected"):
         d = after.get(k, 0) - before.get(k, 0)
         if d:
             run_info[k] = run_info.get(k, 0) + d

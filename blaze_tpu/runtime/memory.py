@@ -90,9 +90,15 @@ class MemManager:
             self._spill_files = [r for r, sf in live if sf is not None]
             return [sf for _, sf in live if sf is not None]
 
+    def _consumers_snapshot(self) -> List[MemConsumer]:
+        # registry snapshot: supervisor pool threads register/unregister
+        # concurrently with accounting walks over the list
+        with self._lock:
+            return list(self._consumers)
+
     # -- accounting --
     def mem_used(self) -> int:
-        return sum(c.mem_used() for c in self._consumers) \
+        return sum(c.mem_used() for c in self._consumers_snapshot()) \
             + self.spill_pages_pending()
 
     def spill_pages_pending(self) -> int:
@@ -109,7 +115,8 @@ class MemManager:
         return freed
 
     def fair_share(self) -> int:
-        n = max(len(self._consumers), 1)
+        with self._lock:
+            n = max(len(self._consumers), 1)
         return self.total // n
 
     def update_mem_used(self, updater: MemConsumer) -> None:
@@ -137,7 +144,7 @@ class MemManager:
             self._note_spill(freed)
             over -= freed
         while over > 0:
-            others = sorted((c for c in self._consumers
+            others = sorted((c for c in self._consumers_snapshot()
                              if c is not updater and c.mem_used() > 0),
                             key=lambda c: -c.mem_used())
             if not others:

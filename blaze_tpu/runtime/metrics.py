@@ -24,7 +24,11 @@ class MetricsSet:
         self._lock = threading.Lock()
 
     def add(self, name: str, delta: int) -> None:
-        self.values[name] = self.values.get(name, 0) + int(delta)
+        # locked: an operator's MetricsSet (and the process-global
+        # resilience TELEMETRY) is updated from every supervisor pool
+        # thread; an unlocked read-modify-write would lose counts
+        with self._lock:
+            self.values[name] = self.values.get(name, 0) + int(delta)
 
     def set_max(self, name: str, value: int) -> None:
         """Max-semantics update (a read-then-add emulation would produce

@@ -11,11 +11,19 @@ result frames (the embedding layer streams them back to the JVM).
 from __future__ import annotations
 
 import struct
+import threading
 
 from blaze_tpu.columnar import serde
 from blaze_tpu.runtime import faults
 from blaze_tpu.runtime.executor import execute_plan
 from blaze_tpu.ops.base import ExecContext
+
+# Host-requested kill flag (bn_request_kill / bn_clear_kill /
+# bn_kill_requested). The host embedding has no reference to a running
+# task's ExecContext, so the flag is process-global here: every native
+# task entry wires `is_running` to it and execution notices at the next
+# batch boundary — the JniBridge.isTaskRunning contract, over the C ABI.
+_task_killed = threading.Event()
 
 
 def error_category_code(exc: BaseException) -> int:
@@ -58,12 +66,39 @@ def spill(bytes_needed_le: bytes) -> bytes:
     return struct.pack("<q", freed)
 
 
+def request_kill(_payload: bytes = b"") -> bytes:
+    """bn_request_kill hook: cooperatively cancel the running native
+    task(s); checked at every batch boundary."""
+    _task_killed.set()
+    return b""
+
+
+def clear_kill(_payload: bytes = b"") -> bytes:
+    """bn_clear_kill hook: re-arm after a kill (next task may run)."""
+    _task_killed.clear()
+    return b""
+
+
+def kill_requested() -> bool:
+    return _task_killed.is_set()
+
+
+def kill_state(_payload: bytes = b"") -> bytes:
+    """bn_kill_requested hook: the flag as one byte (b"\\x01"/b"\\x00")."""
+    return b"\x01" if _task_killed.is_set() else b"\x00"
+
+
+def _native_ctx(partition_id: int) -> ExecContext:
+    return ExecContext(partition=partition_id,
+                       is_running=lambda: not _task_killed.is_set())
+
+
 def run_task_serialized(task_def: bytes) -> bytes:
     from blaze_tpu.plan import decode_task_definition
 
     try:
         plan, td = decode_task_definition(task_def)
-        ctx = ExecContext(partition=td.partition_id)
+        ctx = _native_ctx(td.partition_id)
         out = bytearray()
         for batch in execute_plan(plan, ctx):
             out += serde.serialize_batch(batch)
@@ -120,7 +155,7 @@ def run_task_arrow_payload(task_def: bytes) -> bytes:
 
     try:
         plan, td = decode_task_definition(task_def)
-        ctx = ExecContext(partition=td.partition_id)
+        ctx = _native_ctx(td.partition_id)
         out = bytearray(arrow_payload_header(plan.schema))
         for batch in execute_plan(plan, ctx):
             out += serde.serialize_batch(batch)

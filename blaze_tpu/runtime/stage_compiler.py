@@ -218,6 +218,9 @@ def try_run_stage(root: Operator, ctx: ExecContext, deferred: bool = False,
         return None
 
     batches = list(source.execute(ctx))
+    # kill/heartbeat point: the whole-stage path has no per-batch drive
+    # loop after capture, so check at the source-drain boundary
+    ctx.check_running()
     if not batches:
         return None
     shape0 = batches[0].shape_key()
@@ -784,6 +787,7 @@ def _run_chain_stage(root: Operator, chain: List[MapLikeOp],
         # make the caller re-execute the whole scan)
 
     batches = tuple(source.execute(ctx))
+    ctx.check_running()  # kill/heartbeat point (see try_run_stage)
     if not batches:
         return None
     shape0 = batches[0].shape_key()

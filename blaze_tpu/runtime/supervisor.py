@@ -1,0 +1,610 @@
+"""Task supervisor: bounded concurrency, heartbeats, deadlines, hang
+detection, straggler speculation and per-operator circuit breaking.
+
+The reference engine gets all of this for free from Spark's scheduler:
+TaskSchedulerImpl enforces task/stage deadlines, `spark.speculation`
+relaunches stragglers with first-commit-wins through the shuffle commit
+protocol, and blacklisting retires repeatedly-failing executors. This
+engine IS its own scheduler, so PR-2's resilience ladder (retry /
+degrade / fallback — executor.run_task_with_resilience) gets the
+missing *time axis* here:
+
+  pool        shuffle-map / broadcast / result tasks run on a bounded
+              worker pool (conf.max_concurrent_tasks). Deterministic
+              chaos replay serializes the pool to ONE worker while a
+              fault spec without {"concurrent": true} is armed —
+              scheduling order is part of an injection schedule.
+
+  heartbeat   every `ctx.check_running()` a task performs at a batch
+              boundary doubles as its heartbeat (TaskAttempt.is_running
+              bumps `last_beat`). No second instrument: proof of
+              cooperative liveness and the cancel point are the same
+              call, exactly the JniBridge.isTaskRunning polling posture.
+
+  watchdog    a daemon thread scans live attempts: heartbeat stalled
+              past conf.hang_detect_ms => the attempt is KILLED
+              (classified "killed", never retried as-is) and relaunched
+              under the ladder as a fresh attempt; a task/query deadline
+              (conf.task_deadline_ms / conf.query_deadline_ms) exceeded
+              => killed and relayed as faults.DeadlineError. Backoff
+              sleeps inside the ladder are clamped to the remaining
+              budget (executor.run_task_with_resilience `deadline`).
+
+  speculation a running attempt exceeding conf.speculation_multiplier x
+              the running median attempt duration of its stage gets a
+              speculative twin on a dedicated thread (NOT the bounded
+              pool — a saturated pool must never deadlock waiting on
+              itself). Both race to the finish; file-publishing tasks
+              arbitrate through a shared CommitGate threaded into
+              artifacts.commit_shuffle_pair, so exactly one `.data`/
+              `.index` pair is ever published and the loser aborts as
+              SpeculationLostError with its temps swept.
+
+  breaker     classified failures carrying an `op.<Kind>` fault point
+              are attributed to that operator kind; after
+              conf.breaker_failure_threshold of them within one query
+              the kind TRIPS and every remaining task whose plan
+              contains it is rerouted straight to the row-interpreter
+              fallback (no more doomed device attempts). State is
+              exported through the resilience telemetry
+              (`breaker.tripped.<Kind>`) and run_info.
+
+Disabled (conf.enable_supervisor=False) the runner degrades to the
+PR-2 sequential path: tasks run inline on the driver thread with
+retries/ladder only — overhead is one branch per stage.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import statistics
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FutureTimeoutError
+from typing import Any, Callable, Dict, FrozenSet, List, Optional, Tuple
+
+from blaze_tpu.config import conf
+from blaze_tpu.ops.base import ExecContext, TaskKilledError
+from blaze_tpu.runtime import faults
+
+# thread-local plumbing: the attempt running on THIS thread (read by
+# faults._stall to make injected stalls kill-interruptible) and the task
+# owning it (read by fallback builders to inherit the commit gate).
+_current = threading.local()
+
+
+def current_kill_event() -> Optional[threading.Event]:
+    att = getattr(_current, "attempt", None)
+    return att.kill_event if att is not None else None
+
+
+def current_commit_gate():
+    task = getattr(_current, "task", None)
+    return task.gate if task is not None else None
+
+
+class TaskAttempt:
+    """One execution of a task's attempt function. The kill flag is an
+    Event so cooperative sleeps (faults._stall, backoff) can block on it;
+    `is_running()` is wired into ExecContext, so every batch-boundary
+    check is simultaneously the attempt's heartbeat."""
+
+    __slots__ = ("task", "speculative", "started", "last_beat",
+                 "kill_event", "kill_reason", "deadline")
+
+    def __init__(self, task: "_Task", speculative: bool) -> None:
+        self.task = task
+        self.speculative = speculative
+        self.started = time.monotonic()
+        self.last_beat = self.started
+        self.kill_event = threading.Event()
+        self.kill_reason: Optional[str] = None
+        self.deadline = task.deadline
+
+    def is_running(self) -> bool:
+        self.last_beat = time.monotonic()
+        return not self.kill_event.is_set()
+
+    def kill(self, reason: str) -> bool:
+        """Request cancellation; returns True only for the first kill so
+        watchdog telemetry counts each detection once."""
+        if self.kill_event.is_set():
+            return False
+        self.kill_reason = self.kill_reason or reason
+        self.kill_event.set()
+        return True
+
+
+class CommitGate:
+    """First-commit-wins arbiter shared by an attempt and its
+    speculative twin. `claim()` is true exactly once; a claimant whose
+    publish then fails calls `abort()` so the surviving lineage's retry
+    can still commit."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._committed = False
+
+    def claim(self) -> bool:
+        with self._lock:
+            if self._committed:
+                return False
+            self._committed = True
+            return True
+
+    def abort(self) -> None:
+        with self._lock:
+            self._committed = False
+
+
+class CircuitBreaker:
+    """Per-query, per-operator-kind failure counter. Attribution comes
+    from the fault `point` the taxonomy attaches to classified errors
+    ("op.<Kind>" at operator stream boundaries); unattributable errors
+    (no point, or a non-operator point like spill.write) don't count —
+    tripping must name an operator to reroute around."""
+
+    def __init__(self, run_info: Optional[dict] = None) -> None:
+        self._lock = threading.Lock()
+        self._failures: Dict[str, int] = {}
+        self._tripped: set = set()
+        self._run_info = run_info
+
+    def note_failure(self, exc: BaseException, category: str = "") -> None:
+        if category == "killed":
+            return
+        threshold = int(conf.breaker_failure_threshold)
+        if threshold <= 0:
+            return
+        point = getattr(exc, "point", None)
+        if not point:
+            point = getattr(getattr(exc, "__cause__", None), "point", None)
+        if not isinstance(point, str) or not point.startswith("op."):
+            return
+        kind = point.split(".", 1)[1]
+        with self._lock:
+            n = self._failures[kind] = self._failures.get(kind, 0) + 1
+            if kind in self._tripped or n < threshold:
+                return
+            self._tripped.add(kind)
+        faults.TELEMETRY.add("breaker.trips", 1)
+        faults.TELEMETRY.add(f"breaker.tripped.{kind}", 1)
+        if self._run_info is not None:
+            self._run_info["breaker_trips"] = \
+                self._run_info.get("breaker_trips", 0) + 1
+
+    def tripped(self) -> FrozenSet[str]:
+        with self._lock:
+            return frozenset(self._tripped)
+
+    def should_reroute(self, op_kinds: FrozenSet[str]) -> bool:
+        if not op_kinds:
+            return False
+        with self._lock:
+            return not self._tripped.isdisjoint(op_kinds)
+
+
+@dataclasses.dataclass
+class TaskSpec:
+    """One schedulable unit handed to Supervisor.run_tasks.
+
+    `attempt_fn(ctx)` must be a FULL re-runnable attempt (decode plan ->
+    execute -> commit) — it is invoked once per attempt with a fresh
+    ExecContext carrying that attempt's kill flag and the task's commit
+    gate. `fallback_fn()` is the rung-3 row-interpreter route (also used
+    by breaker reroutes). `op_kinds` is the set of operator names in the
+    task's plan, for breaker matching."""
+
+    what: str
+    attempt_fn: Callable[[ExecContext], Any]
+    partition: int = 0
+    num_partitions: int = 1
+    fallback_fn: Optional[Callable[[], Any]] = None
+    op_kinds: FrozenSet[str] = frozenset()
+    speculatable: bool = True
+
+
+class _Task:
+    """Supervisor-internal task state: the spec, its commit gate, the
+    live attempts (primary + at most one speculative) and the
+    first-finish-wins outcome."""
+
+    def __init__(self, spec: TaskSpec, stage_key, deadline: Optional[float]
+                 ) -> None:
+        self.spec = spec
+        self.stage_key = stage_key
+        self.deadline = deadline
+        self.gate = CommitGate()
+        self.done = threading.Event()
+        self._lock = threading.Lock()
+        self.outcome: Optional[Tuple[str, Any]] = None
+        self.live_attempts: List[TaskAttempt] = []
+        self.speculated = False
+        self.cancelled = False
+        self.primary_started: Optional[float] = None
+
+    @property
+    def finished(self) -> bool:
+        return self.done.is_set()
+
+    def finish(self, kind: str, value: Any) -> bool:
+        """Record the outcome; only the FIRST finisher wins."""
+        with self._lock:
+            if self.outcome is not None:
+                return False
+            self.outcome = (kind, value)
+        self.done.set()
+        return True
+
+    def attach(self, att: TaskAttempt) -> None:
+        with self._lock:
+            self.live_attempts.append(att)
+            if not att.speculative and self.primary_started is None:
+                self.primary_started = att.started
+
+    def detach(self, att: TaskAttempt) -> None:
+        with self._lock:
+            try:
+                self.live_attempts.remove(att)
+            except ValueError:
+                pass
+
+    def live(self) -> List[TaskAttempt]:
+        with self._lock:
+            return list(self.live_attempts)
+
+    def kill_attempts(self, reason: str,
+                      speculative: Optional[bool] = None) -> None:
+        for att in self.live():
+            if speculative is None or att.speculative == speculative:
+                att.kill(reason)
+
+
+class Supervisor:
+    """Per-query task supervisor. Create one per run_plan invocation,
+    call `run_tasks` per stage, `close()` in the run's finally."""
+
+    _WATCHDOG_TICK = 0.05
+    _ABANDON_GRACE = 2.0  # slack past a deadline before abandoning a thread
+
+    def __init__(self, run_info: Optional[dict] = None) -> None:
+        self.run_info = run_info
+        self.enabled = bool(conf.enable_supervisor)
+        self.breaker = CircuitBreaker(run_info)
+        self.query_deadline: Optional[float] = None
+        if conf.query_deadline_ms and conf.query_deadline_ms > 0:
+            self.query_deadline = (time.monotonic()
+                                   + conf.query_deadline_ms / 1000.0)
+        self._lock = threading.Lock()
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._tasks: List[_Task] = []
+        self._durations: Dict[Any, List[float]] = {}
+        self._spec_threads: List[threading.Thread] = []
+        self._closed = threading.Event()
+        self._watchdog: Optional[threading.Thread] = None
+        self._abandoned = False
+
+    # -- budgets -----------------------------------------------------------
+
+    def deadline(self) -> Optional[float]:
+        """Absolute monotonic deadline for a task launched NOW: the
+        tighter of the per-task and remaining per-query budgets."""
+        cands = []
+        if conf.task_deadline_ms and conf.task_deadline_ms > 0:
+            cands.append(time.monotonic() + conf.task_deadline_ms / 1000.0)
+        if self.query_deadline is not None:
+            cands.append(self.query_deadline)
+        return min(cands) if cands else None
+
+    # -- pool / watchdog ---------------------------------------------------
+
+    def _pool_width(self) -> int:
+        spec = conf.fault_injection_spec
+        if spec and not spec.get("concurrent"):
+            # deterministic chaos replay: thread interleavings would make
+            # the global nth/fail_times counters consume in racy order
+            return 1
+        return max(1, int(conf.max_concurrent_tasks))
+
+    def _ensure_pool(self) -> ThreadPoolExecutor:
+        with self._lock:
+            if self._pool is None:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=self._pool_width(),
+                    thread_name_prefix="blz-task")
+            return self._pool
+
+    def _watchdog_needed(self) -> bool:
+        return (self.query_deadline is not None
+                or (conf.task_deadline_ms or 0) > 0
+                or (conf.hang_detect_ms or 0) > 0
+                or (conf.speculation_multiplier or 0) > 0)
+
+    def _ensure_watchdog(self) -> None:
+        if not self._watchdog_needed():
+            return
+        with self._lock:
+            if self._watchdog is not None:
+                return
+            t = threading.Thread(target=self._watchdog_loop,
+                                 name="blz-watchdog", daemon=True)
+            self._watchdog = t
+        t.start()
+
+    def _watchdog_loop(self) -> None:
+        while not self._closed.is_set():
+            tick = self._WATCHDOG_TICK
+            hang_ms = conf.hang_detect_ms or 0
+            if hang_ms > 0:
+                tick = min(tick, hang_ms / 4000.0)
+            self._closed.wait(max(tick, 0.005))
+            try:
+                self._scan()
+            except Exception:  # noqa: BLE001 — watchdog must never die
+                pass
+
+    def _scan(self) -> None:
+        now = time.monotonic()
+        hang_s = (conf.hang_detect_ms or 0) / 1000.0
+        with self._lock:
+            tasks = list(self._tasks)
+        for task in tasks:
+            if task.finished:
+                continue
+            for att in task.live():
+                if att.deadline is not None and now > att.deadline:
+                    if att.kill("deadline"):
+                        self._note("deadline_kills")
+                elif hang_s > 0 and now - att.last_beat > hang_s:
+                    if att.kill("hung"):
+                        self._note("hangs_detected")
+            self._maybe_speculate(task, now)
+
+    def _note(self, key: str, n: int = 1) -> None:
+        faults.TELEMETRY.add(key, n)
+        if self.run_info is not None:
+            self.run_info[key] = self.run_info.get(key, 0) + n
+
+    # -- duration stats (speculation threshold) ----------------------------
+
+    def _record_duration(self, stage_key, seconds: float) -> None:
+        with self._lock:
+            self._durations.setdefault(stage_key, []).append(seconds)
+
+    def _median_duration(self, stage_key) -> Optional[float]:
+        with self._lock:
+            ds = self._durations.get(stage_key)
+            if not ds or len(ds) < 2:
+                return None  # no basis to call anything a straggler yet
+            return statistics.median(ds)
+
+    # -- speculation -------------------------------------------------------
+
+    def _maybe_speculate(self, task: _Task, now: float) -> None:
+        mult = float(conf.speculation_multiplier or 0)
+        if mult <= 0 or task.speculated or task.cancelled or task.finished:
+            return
+        if not task.spec.speculatable or task.primary_started is None:
+            return
+        med = self._median_duration(task.stage_key)
+        if med is None or now - task.primary_started <= mult * med:
+            return
+        with task._lock:
+            if task.speculated or task.outcome is not None:
+                return
+            task.speculated = True
+        self._note("speculations_launched")
+        t = threading.Thread(target=self._run_speculative, args=(task,),
+                             name="blz-speculative", daemon=True)
+        with self._lock:
+            self._spec_threads.append(t)
+        t.start()
+
+    def _run_speculative(self, task: _Task) -> None:
+        """The twin: ONE bare attempt, no ladder — if it fails the
+        primary's ladder is still driving recovery, and if it wins the
+        primary is killed with reason "speculation_lost"."""
+        try:
+            started = time.monotonic()
+            value = self._attempt_once(task, speculative=True)
+        except BaseException:  # noqa: BLE001 — twin failure is non-fatal
+            return
+        if task.finish("ok", value):
+            self._note("speculations_won")
+            self._record_duration(task.stage_key,
+                                  time.monotonic() - started)
+            task.kill_attempts("speculation_lost", speculative=False)
+
+    # -- attempt execution -------------------------------------------------
+
+    def _attempt_once(self, task: _Task, speculative: bool) -> Any:
+        """Run the spec's attempt function once under a fresh
+        TaskAttempt. Supervisor-initiated kills are translated at this
+        boundary: "hung" relaunches under the ladder (HungError, its
+        own relaunch budget),
+        "deadline" is terminal (DeadlineError), everything else —
+        speculation_lost / sibling_failed / shutdown — stays killed."""
+        if task.cancelled:
+            raise TaskKilledError(f"{task.spec.what}: cancelled")
+        att = TaskAttempt(task, speculative)
+        task.attach(att)
+        prev_att = getattr(_current, "attempt", None)
+        prev_task = getattr(_current, "task", None)
+        _current.attempt, _current.task = att, task
+        try:
+            ctx = ExecContext(partition=task.spec.partition,
+                              num_partitions=task.spec.num_partitions,
+                              is_running=att.is_running,
+                              commit_gate=task.gate)
+            return task.spec.attempt_fn(ctx)
+        except TaskKilledError as e:
+            if att.kill_reason == "hung":
+                raise faults.HungError(
+                    f"{task.spec.what}: attempt hung (no heartbeat for "
+                    f"{conf.hang_detect_ms}ms), killed and relaunching"
+                ) from e
+            if att.kill_reason == "deadline":
+                raise faults.DeadlineError(
+                    f"{task.spec.what}: deadline exceeded") from e
+            raise
+        finally:
+            _current.attempt, _current.task = prev_att, prev_task
+            task.detach(att)
+
+    def _run_supervised(self, task: _Task) -> Any:
+        """Pool-worker body: breaker reroute, then the PR-2 resilience
+        ladder around `_attempt_once`, racing any speculative twin
+        through the task's outcome slot."""
+        from blaze_tpu.runtime.executor import run_task_with_resilience
+
+        spec = task.spec
+        prev_task = getattr(_current, "task", None)
+        _current.task = task
+        try:
+            def attempt():
+                # breaker check at EVERY attempt boundary, not just task
+                # start: a kind that trips mid-ladder (its own failures
+                # count) reroutes this task's next retry instead of
+                # burning the remaining budget on a doomed operator
+                if (spec.fallback_fn is not None
+                        and self.breaker.should_reroute(spec.op_kinds)):
+                    self._note("breaker_reroutes")
+                    return spec.fallback_fn()
+                return self._attempt_once(task, speculative=False)
+
+            started = time.monotonic()
+            value = run_task_with_resilience(
+                attempt, what=spec.what, run_info=self.run_info,
+                fallback=spec.fallback_fn, deadline=task.deadline,
+                on_error=self.breaker.note_failure)
+            if task.finish("ok", value):
+                self._record_duration(task.stage_key,
+                                      time.monotonic() - started)
+            task.kill_attempts("speculation_lost", speculative=True)
+        except BaseException as e:  # noqa: BLE001
+            if isinstance(e, TaskKilledError) and not task.finished:
+                # killed by a twin/sibling that should be finishing the
+                # task — give it a bounded window, then own the failure
+                # (e.g. the twin claimed the gate and then died)
+                task.done.wait(self._twin_grace(task))
+            if not task.finish("err", e):
+                pass  # a twin already finished; its outcome stands
+        finally:
+            _current.task = prev_task
+        task.done.wait()
+        kind, value = task.outcome  # type: ignore[misc]
+        if kind == "err":
+            raise value
+        return value
+
+    def _twin_grace(self, task: _Task) -> float:
+        if task.deadline is not None:
+            return max(0.0, task.deadline - time.monotonic()) \
+                + self._ABANDON_GRACE
+        return 30.0
+
+    # -- public API --------------------------------------------------------
+
+    def run_tasks(self, stage_key, specs: List[TaskSpec]) -> List[Any]:
+        """Run a stage's tasks, returning their values in spec order.
+        Raises the first task error after killing the stage's siblings;
+        a task that outlives its deadline without cooperating is
+        abandoned on its thread and relayed as DeadlineError."""
+        if not specs:
+            return []
+        if not self.enabled:
+            return [self._run_sequential(spec) for spec in specs]
+        pool = self._ensure_pool()
+        deadline = self.deadline()
+        tasks = [_Task(spec, stage_key, deadline) for spec in specs]
+        with self._lock:
+            self._tasks.extend(tasks)
+        self._ensure_watchdog()
+        futures = [pool.submit(self._run_supervised, t) for t in tasks]
+        results: List[Any] = [None] * len(tasks)
+        first_err: Optional[BaseException] = None
+        for i, (task, fut) in enumerate(zip(tasks, futures)):
+            timeout = None
+            if task.deadline is not None:
+                timeout = max(0.0, task.deadline - time.monotonic()) \
+                    + self._ABANDON_GRACE
+            try:
+                results[i] = fut.result(timeout=timeout)
+            except (TimeoutError, FutureTimeoutError):
+                # (futures.TimeoutError is a distinct class until py3.11)
+                # non-cooperative hang: kill (in case it ever wakes),
+                # abandon the thread, relay as a deadline failure
+                task.cancelled = True
+                task.kill_attempts("deadline")
+                self._abandoned = True
+                if first_err is None:
+                    first_err = faults.DeadlineError(
+                        f"{task.spec.what}: task exceeded its deadline "
+                        f"without cooperating; attempt abandoned")
+                    self._cancel_siblings(tasks, futures, skip=i)
+            except BaseException as e:  # noqa: BLE001
+                if first_err is None:
+                    first_err = e
+                    self._cancel_siblings(tasks, futures, skip=i)
+        if first_err is not None:
+            raise first_err
+        return results
+
+    def _cancel_siblings(self, tasks: List[_Task], futures, skip: int
+                         ) -> None:
+        for j, (t, f) in enumerate(zip(tasks, futures)):
+            if j == skip:
+                continue
+            f.cancel()  # queued-but-unstarted siblings never run
+            t.cancelled = True
+            t.kill_attempts("sibling_failed")
+
+    def _run_sequential(self, spec: TaskSpec) -> Any:
+        """conf.enable_supervisor=False: the PR-2 inline path (plus the
+        breaker and deadline clamps, which cost one lookup each)."""
+        from blaze_tpu.runtime.executor import run_task_with_resilience
+
+        ctx = ExecContext(partition=spec.partition,
+                          num_partitions=spec.num_partitions)
+
+        def attempt():
+            # same per-attempt breaker check as the supervised path
+            if (spec.fallback_fn is not None
+                    and self.breaker.should_reroute(spec.op_kinds)):
+                self._note("breaker_reroutes")
+                return spec.fallback_fn()
+            return spec.attempt_fn(ctx)
+
+        return run_task_with_resilience(
+            attempt, what=spec.what,
+            run_info=self.run_info, fallback=spec.fallback_fn,
+            ctx=ctx, deadline=self.deadline(),
+            on_error=self.breaker.note_failure)
+
+    def close(self) -> None:
+        """Kill every live attempt, stop the watchdog, drain the pool.
+        Safe to call twice; called from the runner's finally."""
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        with self._lock:
+            tasks = list(self._tasks)
+            pool = self._pool
+            spec_threads = list(self._spec_threads)
+            watchdog = self._watchdog
+        for task in tasks:
+            task.cancelled = True
+            task.kill_attempts("shutdown")
+        if pool is not None:
+            # after an abandon the stuck thread may never exit; don't
+            # let close() inherit its hang
+            try:
+                pool.shutdown(wait=not self._abandoned,
+                              cancel_futures=True)
+            except TypeError:  # pragma: no cover — pre-3.9 signature
+                pool.shutdown(wait=not self._abandoned)
+        for t in spec_threads:
+            t.join(timeout=1.0)
+        if watchdog is not None:
+            watchdog.join(timeout=1.0)

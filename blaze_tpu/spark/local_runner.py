@@ -28,7 +28,9 @@ from blaze_tpu.ops.common import concat_batches
 from blaze_tpu.plan import decode_plan
 from blaze_tpu.plan import plan_pb2 as pb
 from blaze_tpu.runtime import artifacts, faults, resources
+from blaze_tpu.runtime import supervisor as supervisor_mod
 from blaze_tpu.runtime.executor import execute_plan, run_task_with_resilience
+from blaze_tpu.runtime.supervisor import Supervisor, TaskSpec
 from blaze_tpu.spark.convert_strategy import apply_strategy
 from blaze_tpu.spark.plan_model import SparkPlan
 from blaze_tpu.spark.stages import Stage, plan_stages
@@ -104,6 +106,11 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
 
     from blaze_tpu.spark.aqe import apply_dynamic_join_selection
 
+    # the task supervisor owns this query's worker pool, watchdog (hang
+    # detection + deadlines), straggler speculation and the per-operator
+    # circuit breaker (runtime/supervisor.py); disabled it degrades each
+    # stage to the sequential inline path
+    sup = Supervisor(run_info)
     try:
         for stage in stages:
             # re-optimize THIS stage with the statistics of completed
@@ -146,20 +153,21 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
                         run_info["mesh_stages"] += 1
                         continue
                 logical = _run_shuffle_stage(stage, stages, shuffle_mgr,
-                                             run_info)
+                                             sup, run_info)
                 # logical (uncompressed) bytes: the mesh path reports the
                 # same unit, so the AQE threshold is transport-independent
                 shuffle_bytes[stage.stage_id] = logical
                 run_info["file_stages"] += 1
             elif stage.kind == "broadcast":
-                _run_broadcast_stage(stage, stages, run_info)
+                _run_broadcast_stage(stage, stages, sup, run_info)
                 run_info["broadcast_stages"] += 1
             else:
                 parts = _input_tasks(stage, stages, fallback=num_partitions)
-                out = _run_result_stage(stage, parts, run_info)
+                out = _run_result_stage(stage, parts, sup, run_info)
                 return _merge_fallback_root_sort(root, out, parts)
         raise AssertionError("no result stage produced")
     finally:
+        sup.close()
         faults.run_info_delta(telemetry_before, run_info)
         # release per-query registry entries: FFI export subtrees and the
         # shuffle/broadcast providers (the mesh path's providers pin full
@@ -214,7 +222,7 @@ def _schema_of_reader(node: pb.PlanNode):
 
 
 def _run_shuffle_stage(stage: Stage, stages: List[Stage],
-                       shuffle_mgr, run_info=None) -> int:
+                       shuffle_mgr, sup: Supervisor, run_info=None) -> int:
     """Runs the map tasks through the shuffle manager (register ->
     per-task writer slot -> commit MapStatus -> reduce-side reader
     resource); returns the stage's total LOGICAL output bytes
@@ -222,16 +230,20 @@ def _run_shuffle_stage(stage: Stage, stages: List[Stage],
 
     Each map task is a re-runnable resilience unit: the writer's
     crash-atomic commit means a failed attempt left no final files, so a
-    retry simply re-executes. The ladder's last rung re-runs the task's
-    map subtree (stage.source) on the row interpreter, feeding the native
-    shuffle writer through an ipc_reader — the committed file format is
-    identical either way."""
+    retry simply re-executes. The supervisor may also race a speculative
+    twin against a straggling attempt — the ExecContext's commit gate
+    makes first-commit win and the loser abort cleanly. The ladder's
+    last rung re-runs the task's map subtree (stage.source) on the row
+    interpreter, feeding the native shuffle writer through an ipc_reader
+    — the committed file format is identical either way."""
     ntasks = _input_tasks(stage, stages)
     # the reader schema is the writer's input schema
     reader_schema = decode_plan(stage.plan.shuffle_writer.input).schema
     handle = shuffle_mgr.register_shuffle(
         stage.stage_id, stage.num_partitions, reader_schema)
-    logical = 0
+    op_kinds = stage.op_kinds()
+    specs: List[TaskSpec] = []
+    slots = []
     for task in range(ntasks):
         node = pb.PlanNode()
         node.CopyFrom(stage.plan)
@@ -239,18 +251,22 @@ def _run_shuffle_stage(stage: Stage, stages: List[Stage],
         node.shuffle_writer.data_file = slot.data_path
         node.shuffle_writer.index_file = slot.index_path
 
-        def attempt(node=node, task=task):
+        def attempt(ctx, node=node):
             op = decode_plan(node)  # fresh operator state per attempt
-            list(execute_plan(op, ExecContext(partition=task,
-                                              num_partitions=ntasks)))
+            list(execute_plan(op, ctx))
             return op
 
         fb = (None if stage.source is None else
               lambda node=node, task=task: _fallback_shuffle_task(
                   stage, node, task, ntasks))
-        op = run_task_with_resilience(
-            attempt, what=f"shuffle_map[{stage.stage_id}:{task}]",
-            run_info=run_info, fallback=fb)
+        specs.append(TaskSpec(
+            what=f"shuffle_map[{stage.stage_id}:{task}]",
+            attempt_fn=attempt, partition=task, num_partitions=ntasks,
+            fallback_fn=fb, op_kinds=op_kinds))
+        slots.append(slot)
+    ops = sup.run_tasks(("shuffle", stage.stage_id), specs)
+    logical = 0
+    for op, slot in zip(ops, slots):
         logical += op.metrics.values.get("shuffle_logical_bytes", 0)
         slot.commit()
 
@@ -289,15 +305,18 @@ def _fallback_shuffle_task(stage: Stage, node: pb.PlanNode, task: int,
         reader.ipc_reader.num_partitions = ntasks
         node2.shuffle_writer.input.CopyFrom(reader)
         op = decode_plan(node2)
-        list(execute_plan(op, ExecContext(partition=task,
-                                          num_partitions=ntasks)))
+        # inherit the supervised task's commit gate (if any): a fallback
+        # racing a speculative twin must still arbitrate the publish
+        ctx = ExecContext(partition=task, num_partitions=ntasks,
+                          commit_gate=supervisor_mod.current_commit_gate())
+        list(execute_plan(op, ctx))
         return op
     finally:
         resources.pop(rid)
 
 
 def _run_broadcast_stage(stage: Stage, stages: List[Stage],
-                         run_info=None) -> None:
+                         sup: Supervisor, run_info=None) -> None:
     # a broadcast stage runs ONE task but must see its upstream shuffles'
     # WHOLE output — a plan like broadcast(final_agg(exchange(...)))
     # would otherwise read only partition 0 and broadcast a quarter of
@@ -306,17 +325,19 @@ def _run_broadcast_stage(stage: Stage, stages: List[Stage],
     frames: List[bytes] = []
     resources.put(f"broadcast_sink:{stage.stage_id}", frames.append)
 
-    def attempt():
+    def attempt(ctx):
         del frames[:]  # a half-pushed earlier attempt must not leak frames
         op = decode_plan(stage.plan)
-        list(execute_plan(op, ExecContext(partition=0, num_partitions=1)))
+        list(execute_plan(op, ctx))
         return op
 
     fb = (None if stage.source is None else
           lambda: _fallback_broadcast_task(stage, stages, frames))
-    run_task_with_resilience(
-        attempt, what=f"broadcast[{stage.stage_id}]", run_info=run_info,
-        fallback=fb)
+    # speculatable=False: both twins would push into the ONE frames sink
+    sup.run_tasks(("broadcast", stage.stage_id), [TaskSpec(
+        what=f"broadcast[{stage.stage_id}]", attempt_fn=attempt,
+        partition=0, num_partitions=1, fallback_fn=fb,
+        op_kinds=stage.op_kinds(), speculatable=False)])
     resources.put(f"broadcast:{stage.stage_id}",
                   lambda partition=0: iter(list(frames)))
 
@@ -426,7 +447,7 @@ def _root_sort_split(op):
     return None
 
 
-def _run_result_stage(stage: Stage, parts: int,
+def _run_result_stage(stage: Stage, parts: int, sup: Supervisor,
                       run_info=None) -> ColumnBatch:
     """`parts` is the upstream exchange's partition count (_input_tasks) —
     NOT the global default: an 8-way repartition read with 4 tasks would
@@ -443,13 +464,13 @@ def _run_result_stage(stage: Stage, parts: int,
              if host_sort.host_supported(op.schema) else None)
     strip = split[2] if split else 0
 
-    batches: List[ColumnBatch] = []
+    op_kinds = stage.op_kinds()
+    specs: List[TaskSpec] = []
     for p in range(parts):
-        def attempt(p=p):
+        def attempt(task_ctx):
             op_p = decode_plan(stage.plan)  # fresh operator state per task
             for _ in range(strip):
                 op_p = op_p.children[0]
-            task_ctx = ExecContext(partition=p, num_partitions=parts)
             staged = try_run_stage(op_p, task_ctx)
             if staged is not None:
                 return [staged]
@@ -457,9 +478,13 @@ def _run_result_stage(stage: Stage, parts: int,
 
         fb = (None if stage.source is None else
               lambda p=p: _fallback_result_task(stage, p, parts, op.schema))
-        batches.extend(run_task_with_resilience(
-            attempt, what=f"result[{stage.stage_id}:{p}]",
-            run_info=run_info, fallback=fb))
+        specs.append(TaskSpec(
+            what=f"result[{stage.stage_id}:{p}]", attempt_fn=attempt,
+            partition=p, num_partitions=parts, fallback_fn=fb,
+            op_kinds=op_kinds))
+    batches: List[ColumnBatch] = []
+    for lst in sup.run_tasks(("result", stage.stage_id), specs):
+        batches.extend(lst)
 
     if split is not None:
         specs, limit, _ = split
@@ -484,9 +509,12 @@ def _run_result_stage(stage: Stage, parts: int,
             out._host_numpy = host_sort.host_to_pylike(hb)
             return out
 
+        # the merge tail runs inline on the driver (it needs every
+        # partition's batches) but still honors deadlines + the breaker
         return run_task_with_resilience(
             merge, what=f"result_merge[{stage.stage_id}]",
-            run_info=run_info)
+            run_info=run_info, deadline=sup.deadline(),
+            on_error=sup.breaker.note_failure)
 
     if not batches:
         return ColumnBatch.empty(op.schema)

@@ -37,6 +37,29 @@ class Stage:
     # the resilience ladder re-runs through the CPU fallback interpreter
     # (spark/fallback.py) when a task exhausts every native rung
     source: Optional[SparkPlan] = None
+    _op_kinds: Optional[frozenset] = dataclasses.field(
+        default=None, repr=False, compare=False)
+
+    def op_kinds(self) -> frozenset:
+        """Operator kinds in this stage's task plan — the circuit
+        breaker's reroute key (a tripped kind reroutes every remaining
+        task whose subtree contains it). Cached: every task of the
+        stage shares one plan shape."""
+        if self._op_kinds is None:
+            from blaze_tpu.plan.from_proto import decode_plan
+
+            try:
+                stack = [decode_plan(self.plan)]
+            except Exception:  # noqa: BLE001 — attribution, never fatal
+                self._op_kinds = frozenset()
+                return self._op_kinds
+            kinds = set()
+            while stack:
+                op = stack.pop()
+                kinds.add(op.name())
+                stack.extend(op.children)
+            self._op_kinds = frozenset(kinds)
+        return self._op_kinds
 
 
 def plan_stages(root: SparkPlan, default_partitions: int = 1) -> List[Stage]:

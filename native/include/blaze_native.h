@@ -96,6 +96,15 @@ int bn_call_py(const uint8_t* task_def, int64_t len, const char* entry,
  * state until `bytes_needed` is freed (ref OnHeapSpillManager's
  * pressure-driven spill-to-disk). Returns bytes freed, or -1. */
 int64_t bn_spill(int64_t bytes_needed);
+/* cooperative task cancellation (ref JniBridge.isTaskRunning polling):
+ * bn_request_kill flags the running native task(s); execution notices at
+ * the next batch boundary and the failed bn_call reports category 5
+ * ("killed"). bn_clear_kill re-arms before the next task; the flag is
+ * process-global — the C ABI has no per-task handle. bn_kill_requested
+ * returns 1 when the flag is set (0 otherwise, negative on error). */
+int bn_request_kill(void);
+int bn_clear_kill(void);
+int bn_kill_requested(void);
 /* last error message (thread-local), empty string if none */
 const char* bn_last_error(void);
 /* error category of the last failed call on this thread, so the host

@@ -252,6 +252,40 @@ int bn_call(const uint8_t* task_def, int64_t len, uint8_t** out,
   return bn_call_py(task_def, len, "run_task_serialized", out, out_len);
 }
 
+namespace {
+
+// shared body of the kill-flag entries: call a no-argument-payload
+// native_entry hook and report success/failure. The Python-side flag is
+// the source of truth (native ExecContexts poll it at batch boundaries);
+// the C++ layer only flips it on the host's behalf.
+int call_kill_entry(const char* entry) {
+  uint8_t* out = nullptr;
+  int64_t out_len = 0;
+  int rc = bn_call_py(nullptr, 0, entry, &out, &out_len);
+  if (out) bn_free_buffer(out);
+  return rc == 0 ? 0 : -1;
+}
+
+}  // namespace
+
+int bn_request_kill(void) { return call_kill_entry("request_kill"); }
+
+int bn_clear_kill(void) { return call_kill_entry("clear_kill"); }
+
+int bn_kill_requested(void) {
+  uint8_t* out = nullptr;
+  int64_t out_len = 0;
+  // kill_state returns b"\x01" / b"\x00"
+  int rc = bn_call_py(nullptr, 0, "kill_state", &out, &out_len);
+  if (rc != 0 || out_len != 1) {
+    if (out) bn_free_buffer(out);
+    return -1;
+  }
+  int set = out[0] != 0;
+  bn_free_buffer(out);
+  return set;
+}
+
 int64_t bn_spill(int64_t bytes_needed) {
   // host-driven memory reclamation (ref OnHeapSpillManager.scala:61-144
   // — Spark's memory manager forces spill state to disk under pressure)

@@ -524,3 +524,103 @@ def test_chaos_broadcast_fallback_rung3(tables, tmp_path):
                                                      "kind": "oom"}}})
     assert info.get("ladder_rung", 0) == 3
     assert info.get("task_fallbacks", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# "stall" injection kind (ISSUE 3: the hang that never raises)
+# ---------------------------------------------------------------------------
+
+
+def test_inject_stall_delays_then_continues():
+    import time as _time
+
+    faults.install({"points": {"op": {"kind": "stall", "nth": 1,
+                                      "ms": 60}}})
+    t0 = _time.monotonic()
+    faults.inject("op.ScanExec")  # a stall is a delay, not an error
+    assert _time.monotonic() - t0 >= 0.05
+    assert faults.stats().get("stalls_injected") == 1
+    assert faults.stats().get("faults_injected") == 1
+    faults.inject("op.ScanExec")  # nth=1: fires once
+
+
+def test_inject_stall_interrupted_by_kill_flag():
+    import time as _time
+    import types as _types
+
+    from blaze_tpu.runtime import supervisor as sup_mod
+
+    att = sup_mod.TaskAttempt(_types.SimpleNamespace(deadline=None), False)
+    att.kill(reason="hung")
+    sup_mod._current.attempt = att
+    try:
+        faults.install({"points": {"op": {"kind": "stall", "nth": 1,
+                                          "ms": 30_000}}})
+        t0 = _time.monotonic()
+        with pytest.raises(TaskKilledError):
+            faults.inject("op.ScanExec")
+        assert _time.monotonic() - t0 < 5.0, "kill must cut the stall short"
+    finally:
+        sup_mod._current.attempt = None
+
+
+# ---------------------------------------------------------------------------
+# deadline-aware backoff (the retry budget cannot outlive the deadline)
+# ---------------------------------------------------------------------------
+
+
+def test_retry_backoff_clamped_to_deadline(no_sleep):
+    import time as _time
+
+    def attempt():
+        raise faults.RetryableError("flaky")
+
+    old = conf.retry_backoff_ms
+    conf.retry_backoff_ms = 60_000  # would sleep ~a minute unclamped
+    try:
+        with pytest.raises(faults.RetryableError):
+            run_task_with_resilience(
+                attempt, deadline=_time.monotonic() + 0.05)
+    finally:
+        conf.retry_backoff_ms = old
+    assert no_sleep, "retryable failures must still back off"
+    assert all(s <= 0.06 for s in no_sleep), \
+        f"sleeps must be clamped to the remaining budget, got {no_sleep}"
+
+
+def test_hang_relaunch_budgeted_separately_from_retries(no_sleep):
+    # a watchdog kill-on-suspicion (HungError) must not drain the error
+    # retry budget: 1 hang + max_task_retries real failures still wins
+    errors = [faults.HungError("suspected hang"),
+              faults.RetryableError("flaky"),
+              faults.RetryableError("flaky")]
+
+    def attempt():
+        if errors:
+            raise errors.pop(0)
+        return "ok"
+
+    old = conf.max_task_retries
+    conf.max_task_retries = 2
+    try:
+        info = {}
+        assert run_task_with_resilience(attempt, run_info=info) == "ok"
+        assert info["retries"] == 3
+    finally:
+        conf.max_task_retries = old
+    assert len(no_sleep) == 2, "hang relaunches skip the backoff sleep"
+
+
+def test_retry_exhausted_by_deadline_reclassified(no_sleep):
+    import time as _time
+
+    def attempt():
+        raise faults.RetryableError("flaky")
+
+    # budget already spent: the would-be retry surfaces as DeadlineError
+    # (fatal — the scheduler must NOT treat it as retryable again)
+    with pytest.raises(faults.DeadlineError):
+        run_task_with_resilience(attempt,
+                                 deadline=_time.monotonic() - 1.0)
+    assert no_sleep == []
+    assert faults.classify(faults.DeadlineError("x")) == "fatal"

@@ -1,6 +1,15 @@
 """Chaos soak (ISSUE 2 artifact): sweep every fault-injection point x
 fault kind over a validator mini-catalogue and emit `FAULTS_r06.json`.
 
+`--supervisor` (ISSUE 3 artifact): the same sweep — plus the "stall"
+kind — under the CONCURRENT supervised pool (4 workers, hang detection
+armed, straggler speculation on), emitting `SUPERVISOR_r07.json`. Every
+cell must still match the pandas oracle with zero orphan artifacts and
+zero leaked reservations; stall cells must recover via watchdog kill +
+relaunch instead of waiting the stall out. The overhead section gains a
+supervisor-off vs. sequential A/B backing the "disabled path is the
+PR-2 runner" claim.
+
 Each cell installs one deterministic fault spec (fail the first N calls
 of one KNOWN_POINTS prefix), runs a full driver-path query, and diffs
 the answer against the pandas oracle. A cell is
@@ -73,7 +82,9 @@ def _run_cell(tables, query, mode, spec):
         faults.install(None)
     cell["seconds"] = round(time.time() - t0, 3)
     for k in ("faults_injected", "retries", "degradations", "ladder_rung",
-              "task_fallbacks"):
+              "task_fallbacks", "stalls_injected", "hangs_detected",
+              "deadline_kills", "speculations_launched", "speculations_won",
+              "breaker_trips", "breaker_reroutes"):
         if info.get(k):
             cell[k] = info[k]
     cell["orphans"] = artifacts.find_orphans([work_dir])
@@ -117,6 +128,34 @@ def _overhead(tables):
             "catalogue_armed_never_fires_s": t_armed}
 
 
+def _supervisor_overhead(tables):
+    """Supervisor-off must be the PR-2 sequential runner: a clean
+    catalogue A/B with no faults armed, pool on vs. off."""
+    from blaze_tpu.config import conf
+    from blaze_tpu.spark import validator
+    from blaze_tpu.spark.local_runner import run_plan
+
+    def catalogue():
+        paths, frames = tables
+        t0 = time.time()
+        for query, mode in QUERIES:
+            plan, _ = validator.QUERIES[query](paths, frames, mode)
+            run_plan(plan, num_partitions=4, mesh_exchange="off")
+        return round(time.time() - t0, 3)
+
+    catalogue()  # warm jit caches
+    saved = conf.enable_supervisor
+    try:
+        conf.enable_supervisor = False
+        t_off = catalogue()
+        conf.enable_supervisor = True
+        t_on = catalogue()
+    finally:
+        conf.enable_supervisor = saved
+    return {"catalogue_supervisor_off_s": t_off,
+            "catalogue_supervisor_on_s": t_on}
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", type=int, default=8000)
@@ -124,31 +163,66 @@ def main() -> int:
                     help="consecutive failures per armed point (2 climbs "
                          "past a plain retry into the ladder)")
     ap.add_argument("--seed", type=int, default=1234)
-    ap.add_argument("--json-out", default="FAULTS_r06.json")
+    ap.add_argument("--kinds", default=None,
+                    help="comma-separated fault kinds to sweep "
+                         "(default: io,oom; --supervisor adds stall)")
+    ap.add_argument("--stall-ms", type=int, default=2000,
+                    help="stall length per fired stall cell; the watchdog "
+                         "must recover well before this elapses")
+    ap.add_argument("--hang-detect-ms", type=int, default=500,
+                    help="watchdog heartbeat-staleness threshold; must be "
+                         "well under --stall-ms yet above the longest "
+                         "legitimate between-batch gap (jit compiles)")
+    ap.add_argument("--supervisor", action="store_true",
+                    help="run the sweep under the concurrent supervised "
+                         "pool (hang detection + speculation armed)")
+    ap.add_argument("--json-out", default=None)
     args = ap.parse_args()
+    if args.json_out is None:
+        args.json_out = ("SUPERVISOR_r07.json" if args.supervisor
+                         else "FAULTS_r06.json")
+    kinds = (tuple(args.kinds.split(",")) if args.kinds
+             else KINDS + ("stall",) if args.supervisor else KINDS)
 
+    from blaze_tpu.config import conf
     from blaze_tpu.runtime import faults
     from blaze_tpu.spark import validator
+
+    saved_conf = {k: getattr(conf, k) for k in (
+        "max_concurrent_tasks", "hang_detect_ms", "speculation_multiplier")}
+    if args.supervisor:
+        conf.max_concurrent_tasks = 4
+        conf.hang_detect_ms = args.hang_detect_ms
+        conf.speculation_multiplier = 4.0
 
     tmpdir = tempfile.mkdtemp(prefix="chaos_tables_")
     tables = validator.generate_tables(tmpdir, rows=args.rows)
 
     cells = []
     for point in faults.KNOWN_POINTS:
-        for kind in KINDS:
-            spec = {"seed": args.seed,
-                    "points": {point: {"fail_times": args.fail_times,
-                                       "kind": kind}}}
+        for kind in kinds:
+            rule = {"fail_times": args.fail_times, "kind": kind}
+            if kind == "stall":
+                rule["ms"] = args.stall_ms
+            spec = {"seed": args.seed, "points": {point: rule}}
+            if args.supervisor:
+                # scheduling order is part of the schedule only in the
+                # sequential harness; the supervisor soak wants the pool
+                spec["concurrent"] = True
             for query, mode in QUERIES:
                 cell = _run_cell(tables, query, mode, spec)
                 cell.update(point=point, kind=kind)
                 cells.append(cell)
-                print(f"[cell] {point:15s} {kind:3s} {query:22s} "
+                print(f"[cell] {point:15s} {kind:5s} {query:22s} "
                       f"{cell['outcome']:15s} rung={cell.get('ladder_rung', 0)}"
                       f" {cell['seconds']:.1f}s", flush=True)
 
     overhead = _overhead(tables)
+    if args.supervisor:
+        overhead.update(_supervisor_overhead(tables))
     shutil.rmtree(tmpdir, ignore_errors=True)
+    for k, v in saved_conf.items():
+        setattr(conf, k, v)
 
     outcomes = {}
     for c in cells:
@@ -157,7 +231,9 @@ def main() -> int:
            + [c for c in cells if c["orphans"] or c["mem_leaked"]])
     report = {
         "rows": args.rows, "fail_times": args.fail_times,
-        "seed": args.seed, "outcomes": outcomes, "overhead": overhead,
+        "seed": args.seed, "kinds": list(kinds),
+        "supervisor": bool(args.supervisor),
+        "outcomes": outcomes, "overhead": overhead,
         "ok": not bad, "cells": cells,
     }
     with open(args.json_out, "w") as f:
