@@ -7,8 +7,8 @@
 
 PYENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
-.PHONY: check check-fast check-faults check-supervisor test test-fast \
-	validate validate-fast warm
+.PHONY: check check-fast check-faults check-supervisor check-trace \
+	test test-fast validate validate-fast warm
 
 check: test validate
 	@echo "CHECK OK — safe to commit"
@@ -52,6 +52,13 @@ check-faults:
 check-supervisor:
 	$(PYENV) python tools/chaos_soak.py --supervisor \
 	  --json-out SUPERVISOR_r07.json
+
+# Trace gate: validator mini-catalogue tracing-off vs tracing-on — the
+# enabled path must drop zero events at the default ring size and stay
+# within noise of the disabled path, and the exported Chrome trace must
+# be structurally valid. Emits TRACE_r08.json.
+check-trace:
+	$(PYENV) python tools/trace_report.py --bench --json-out TRACE_r08.json
 
 # Pre-warm the persistent compile caches (runtime/compile_service):
 # replays the shape manifest + the TPC-DS catalogue into the XLA cache.

@@ -82,6 +82,21 @@ class BlazeConf:
     canonical_pow2_limit: int = 1 << 14
     # JAX profiler trace output dir ("" disables) — runtime/tracing.py
     profiler_dir: str = os.environ.get("BLAZE_TPU_PROFILE_DIR", "")
+    # -- structured query tracing (runtime/trace.py) --
+    # Record correlated span/event records (query/stage/task/attempt ids)
+    # for every runtime decision: stage transport, task attempts, retries,
+    # ladder rungs, speculation, breaker trips, spills, compile cache
+    # traffic. Off (default) every trace call is one truthiness check.
+    trace_enabled: bool = False
+    # bounded ring capacity of the process-global TraceLog; overflow
+    # drops the OLDEST record and counts it (TraceLog.dropped — surfaced
+    # in the run ledger so a truncated trace is never mistaken for a
+    # quiet one)
+    trace_buffer_events: int = 1 << 17
+    # per-query export dir ("" disables): the local runner writes
+    # trace_<query_id>.json (Chrome/Perfetto trace-event JSON) and
+    # appends one JSONL line to ledger.jsonl per query
+    trace_export_dir: str = os.environ.get("BLAZE_TPU_TRACE_DIR", "")
     # -- execution resilience (runtime/faults.py, runtime/executor.py) --
     # fault-injection spec ({} disables; see faults.py docstring for the
     # {"seed": ..., "points": {...}} shape). Install via faults.install()

@@ -119,9 +119,14 @@ def count_stream(op: Operator, stream: BatchStream) -> BatchStream:
     batch_statisitcs module: per-exec input-batch stat metrics behind
     spark.blaze.enableInputBatchStatistics), every batch also records
     byte/row-size statistics — each operator's output stream IS its
-    parent's input stream, so one output-side hook covers the plan."""
+    parent's input stream, so one output-side hook covers the plan.
+
+    conf.trace_enabled reuses this same batch boundary for the engine
+    trace's batch events + batch_rows histogram (runtime/trace.py): no
+    new per-batch branch appears on the hot path when tracing is off —
+    the truthiness checks below are the whole disabled-mode cost."""
     from blaze_tpu.config import conf
-    from blaze_tpu.runtime import faults
+    from blaze_tpu.runtime import faults, trace
 
     stats = conf.enable_input_batch_statistics
     if stats:
@@ -130,6 +135,8 @@ def count_stream(op: Operator, stream: BatchStream) -> BatchStream:
     for batch in stream:
         if conf.fault_injection_spec:
             faults.inject(fault_point)
+        if conf.trace_enabled:
+            trace.on_batch(op, int(batch.num_rows))
         op.metrics.add("output_batches", 1)
         op.metrics.add("output_rows", int(batch.num_rows))
         if stats:

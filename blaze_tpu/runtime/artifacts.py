@@ -24,7 +24,7 @@ import os
 import re
 from typing import Callable, List, Sequence
 
-from blaze_tpu.runtime import faults
+from blaze_tpu.runtime import faults, trace
 
 ORPHAN_TAG = ".inprogress."
 _SPILL_RE = re.compile(r"^blz(\d+)-.*\.spill$")
@@ -109,6 +109,8 @@ def commit_shuffle_pair(write_fn, data_path: str, index_path: str,
             claimed = True
         os.replace(tmp_data, data_path)
         os.replace(tmp_index, index_path)
+        trace.event("artifact_commit", what="shuffle_pair",
+                    gated=gate is not None)
         return lengths
     except BaseException:
         if claimed:
@@ -213,6 +215,7 @@ def sweep_orphans(directories: Sequence[str], include_self: bool = False
             _release_sweep_lock(d)
     if removed:
         faults.TELEMETRY.add("orphans_swept", len(removed))
+        trace.event("orphan_sweep", removed=len(removed))
     return removed
 
 

@@ -51,7 +51,7 @@ import time
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 from blaze_tpu.config import conf
-from blaze_tpu.runtime import jit_cache
+from blaze_tpu.runtime import jit_cache, trace
 from blaze_tpu.runtime.metrics import MetricNode, MetricsSet
 
 # --------------------------------------------------------------------------
@@ -60,8 +60,8 @@ from blaze_tpu.runtime.metrics import MetricNode, MetricsSet
 
 TELEMETRY = MetricsSet()
 # MetricsSet seeds operator-centric counters; the service's set is its own
-# namespace, so start clean.
-TELEMETRY.values.clear()
+# namespace, so start clean (reset() clears under the set's lock).
+TELEMETRY.reset()
 
 _COUNTERS = (
     "compile_count", "compile_ns", "cache_hits", "cache_misses",
@@ -83,10 +83,13 @@ def telemetry_node() -> MetricNode:
 
 
 def _coverage_update() -> None:
-    att = TELEMETRY.values.get("stage_attempts", 0)
-    if att:
-        TELEMETRY.values["whole_stage_coverage_pct"] = round(
-            100 * TELEMETRY.values.get("stage_compiled", 0) / att)
+    # read-modify-write of two counters: hold the set's lock for the
+    # whole derivation so a concurrent add() can't interleave
+    with TELEMETRY._lock:
+        att = TELEMETRY.values.get("stage_attempts", 0)
+        if att:
+            TELEMETRY.values["whole_stage_coverage_pct"] = round(
+                100 * TELEMETRY.values.get("stage_compiled", 0) / att)
 
 
 def note_stage_attempt() -> None:
@@ -101,14 +104,17 @@ def note_stage_compiled() -> None:
 
 def telemetry_summary() -> str:
     """One-line counter summary for metric_report ('' when idle)."""
-    v = TELEMETRY.values
-    if not (v["compile_count"] or v["cache_hits"] or v["cache_misses"]):
+    v = TELEMETRY.snapshot()  # pool threads add() concurrently
+    if not (v.get("compile_count") or v.get("cache_hits")
+            or v.get("cache_misses")):
         return ""
     return ("compile_service: compiles={compile_count} "
             "compile_ms={ms:.1f} hits={cache_hits} misses={cache_misses} "
             "waste_rows={canonicalization_waste_rows} "
             "stage_coverage={whole_stage_coverage_pct}%".format(
-                ms=v["compile_ns"] / 1e6, **v))
+                ms=v.get("compile_ns", 0) / 1e6,
+                **{c: v.get(c, 0) for c in
+                   _COUNTERS + ("whole_stage_coverage_pct",)}))
 
 
 @contextlib.contextmanager
@@ -321,6 +327,13 @@ class ShapeRegistry:
                 TELEMETRY.add("compile_count", 1)
                 TELEMETRY.add("compile_ns", int(ns))
             self.dirty = True
+        # after the registry lock: the trace log has its own lock and
+        # events inherit the calling thread's query/stage/task context
+        if event == "compiled":
+            trace.event("compile_compiled", op_kind=kind,
+                        compile_ns=int(ns))
+        elif event in ("hit", "miss"):
+            trace.event(f"compile_{event}", op_kind=kind)
 
     # -- canonicalization accounting -----------------------------------
     def note_canonical(self, kind: str, raw_cap: int, canon_cap: int,
@@ -640,7 +653,7 @@ def warm(manifest_path: Optional[str] = None,
     saved = _REGISTRY.persist(manifest_path)
     stats["seconds"] = round(budget.spent(), 2)
     stats["manifest"] = saved or manifest_path
-    stats["telemetry"] = dict(TELEMETRY.values)
+    stats["telemetry"] = TELEMETRY.snapshot()
     stats["shape_reduction"] = _REGISTRY.shape_reduction()
     progress(f"[warm] done: {stats['replayed_shapes']} shapes, "
              f"{stats['cells_run']} cells in {stats['seconds']}s"

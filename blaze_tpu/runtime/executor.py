@@ -14,7 +14,7 @@ from typing import Callable, Iterator, List, Optional
 
 from blaze_tpu.columnar.batch import ColumnBatch
 from blaze_tpu.ops.base import BatchStream, ExecContext, MapLikeOp, Operator, count_stream
-from blaze_tpu.runtime import faults, jit_cache
+from blaze_tpu.runtime import faults, jit_cache, trace
 from blaze_tpu.runtime.metrics import MetricNode
 
 
@@ -75,6 +75,8 @@ def run_task_with_resilience(attempt: Callable[[], object], *,
                 if cat == "killed":
                     raise
                 faults.note_error(cat, run_info)
+                trace.event("task_error", what=what, category=cat,
+                            error=type(e).__name__)
                 if on_error is not None:
                     try:
                         on_error(e, cat)
@@ -88,17 +90,23 @@ def run_task_with_resilience(attempt: Callable[[], object], *,
                         conf.target_batch_bytes = max(
                             saved_target // 2, 1 << 20)
                         faults.note_degradation("halve_batch", run_info)
+                        trace.event("ladder_rung", what=what, rung=1,
+                                    action="halve_batch")
                         _note_rung(run_info, rung)
                         continue
                     if rung == 1:
                         rung = 2
                         memory.get_manager(ctx).release(1 << 62)
                         faults.note_degradation("force_spill", run_info)
+                        trace.event("ladder_rung", what=what, rung=2,
+                                    action="force_spill")
                         _note_rung(run_info, rung)
                         continue
                     if rung == 2 and fallback is not None:
                         rung = 3
                         faults.note_degradation("fallback", run_info)
+                        trace.event("ladder_rung", what=what, rung=3,
+                                    action="fallback")
                         _note_rung(run_info, rung)
                         return fallback()
                 elif isinstance(e, faults.HungError) and \
@@ -109,12 +117,16 @@ def run_task_with_resilience(attempt: Callable[[], object], *,
                     # sleep — but never relaunch past the deadline
                     if deadline is not None and \
                             _time.monotonic() >= deadline:
+                        trace.event("deadline_exceeded", what=what,
+                                    during="hang_relaunch")
                         raise faults.DeadlineError(
                             f"{what}: hang-relaunch budget exhausted by "
                             f"deadline (after {hang_relaunches} "
                             f"relaunches)") from e
                     faults.note_retry(run_info)
                     hang_relaunches += 1
+                    trace.event("hang_relaunch", what=what,
+                                n=hang_relaunches)
                     continue
                 elif cat in ("retryable", "resource") and \
                         retries < conf.max_task_retries:
@@ -122,14 +134,19 @@ def run_task_with_resilience(attempt: Callable[[], object], *,
                     if deadline is not None:
                         remaining = deadline - _time.monotonic()
                         if remaining <= 0:
+                            trace.event("deadline_exceeded", what=what,
+                                        during="retry")
                             raise faults.DeadlineError(
                                 f"{what}: retry budget exhausted by "
                                 f"deadline (after {retries} retries)"
                             ) from e
                         sleep_s = min(sleep_s, remaining)
                     faults.note_retry(run_info)
-                    faults._sleep(sleep_s)
                     retries += 1
+                    trace.event("retry", what=what, n=retries,
+                                category=cat,
+                                backoff_ms=round(sleep_s * 1000, 2))
+                    faults._sleep(sleep_s)
                     continue
                 raise faults.ensure_classified(e) from e
     finally:

@@ -63,6 +63,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from blaze_tpu.config import conf
+from blaze_tpu.runtime import trace
 from blaze_tpu.runtime.metrics import MetricNode, MetricsSet
 
 # ---------------------------------------------------------------------------
@@ -230,7 +231,7 @@ _sleep = time.sleep  # patchable in tests
 _sched_lock = threading.Lock()
 
 TELEMETRY = MetricsSet()
-TELEMETRY.values.clear()  # drop the operator-stream defaults; counters only
+TELEMETRY.reset()  # drop the operator-stream defaults; counters only
 
 
 def install(spec: Optional[dict]) -> None:
@@ -253,7 +254,10 @@ def reset() -> None:
 
 
 def reset_telemetry() -> None:
-    TELEMETRY.values.clear()
+    # MetricsSet.reset() clears under the adders' lock: a bare
+    # values.clear() racing a pool-thread add() could resurrect a stale
+    # key mid-clear (the add's read-modify-write straddling the clear)
+    TELEMETRY.reset()
 
 
 def _mix(seed, key: str) -> int:
@@ -310,6 +314,7 @@ def inject(point: str) -> None:
     TELEMETRY.add("faults_injected", 1)
     TELEMETRY.add(f"injected.{key}", 1)
     kind = rule.get("kind", "retryable")
+    trace.event("fault_injected", point=point, call=n, fault_kind=kind)
     if kind == "stall":
         _stall(point, n, rule)
         return
@@ -356,7 +361,7 @@ def _stall(point: str, n: int, rule: dict) -> None:
 
 
 def stats() -> Dict[str, int]:
-    return dict(TELEMETRY.values)
+    return TELEMETRY.snapshot()
 
 
 # ---------------------------------------------------------------------------
@@ -425,8 +430,11 @@ def telemetry_node() -> MetricNode:
 
 
 def telemetry_summary() -> str:
-    """One-line summary for tracing.metric_report ('' when idle)."""
-    v = TELEMETRY.values
+    """One-line summary for tracing.metric_report ('' when idle),
+    including the per-category error counts ([plan=1 retryable=2 ...])
+    next to the totals. Reads a locked snapshot — pool threads keep
+    adding while reports render."""
+    v = TELEMETRY.snapshot()
     keys = ("retries", "degradations", "task_fallbacks", "faults_injected")
     if not any(v.get(k) for k in keys):
         return ""

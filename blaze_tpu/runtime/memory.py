@@ -28,6 +28,7 @@ from blaze_tpu.columnar import serde
 from blaze_tpu.columnar.batch import ColumnBatch
 from blaze_tpu.columnar.types import Schema
 from blaze_tpu.config import conf
+from blaze_tpu.runtime import trace
 
 class MemConsumer:
     """Spillable operator state (ref MemConsumer trait)."""
@@ -112,6 +113,8 @@ class MemManager:
         freed = 0
         for sf in self._live_spill_files():
             freed += sf.flush_pages()
+        if freed > 0:
+            trace.event("spill_pages_flush", freed_bytes=freed)
         return freed
 
     def fair_share(self) -> int:
@@ -166,6 +169,7 @@ class MemManager:
         if freed > 0:
             self.spill_count += 1
             self.spilled_bytes += freed
+            trace.event("spill", spill_bytes=freed)
 
     def release(self, bytes_needed: int) -> int:
         """Host-driven reclamation (ref OnHeapSpillManager.scala:61-144:
@@ -192,6 +196,8 @@ class MemManager:
                     freed += got
             if freed < bytes_needed:
                 freed += self.flush_spill_pages()
+        trace.event("mem_release", requested_bytes=bytes_needed,
+                    freed_bytes=freed)
         return freed
 
 

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 
 class MetricsSet:
@@ -44,8 +44,22 @@ class MetricsSet:
     def snapshot(self) -> Dict[str, int]:
         """Point-in-time copy of the counters; compile-service task
         scopes diff two snapshots to attribute process-global deltas
-        (compile_count/compile_ns/...) to one task's MetricsSet."""
-        return dict(self.values)
+        (compile_count/compile_ns/...) to one task's MetricsSet.
+
+        Taken under the lock: readers (MetricNode.push, metric_report,
+        the telemetry summaries) iterate this copy while supervisor pool
+        threads keep mutating the live dict — iterating `values` raw
+        raises RuntimeError("dict changed size during iteration")."""
+        with self._lock:
+            return dict(self.values)
+
+    def reset(self) -> None:
+        """Clear every counter under the lock. A bare `values.clear()`
+        racing a pool-thread `add` can resurrect a stale key (the adder
+        read-modify-writes outside the clear's view); resets must take
+        the same lock the adders do."""
+        with self._lock:
+            self.values.clear()
 
     def __getitem__(self, name: str) -> int:
         return self.values.get(name, 0)
@@ -74,9 +88,13 @@ class MetricNode:
         self.handler = handler
 
     def push(self) -> None:
-        """Walk the tree pushing values through handlers (task finalize)."""
+        """Walk the tree pushing values through handlers (task finalize).
+
+        Iterates a locked snapshot: finalize can overlap live supervisor
+        pool threads still adding counters (a speculative twin draining,
+        the telemetry nodes executor.metric_tree appends)."""
         if self.handler is not None:
-            for k, v in self.metrics.values.items():
+            for k, v in self.metrics.snapshot().items():
                 self.handler(k, v)
         for c in self.children:
             c.push()
@@ -84,3 +102,104 @@ class MetricNode:
     @staticmethod
     def from_operator(op) -> "MetricNode":
         return MetricNode(op.metrics, [MetricNode.from_operator(c) for c in op.children])
+
+
+class Histogram:
+    """Fixed-bucket log2 latency/size histogram (lock-protected, mergeable).
+
+    Bucket i counts values v with 2^(i-1) <= v < 2^i (bucket 0 takes
+    v <= 0, bucket 1 takes v == 1); 64 buckets cover the full non-negative
+    int64 range, so recording never allocates and two histograms merge by
+    summing counts — the same fixed-layout trick HdrHistogram-style
+    recorders use so per-task histograms can fold into a per-query one.
+
+    Percentiles are bucket-resolution estimates: `percentile(p)` returns
+    the upper bound of the bucket holding the p-th value (clamped to the
+    observed max), which is exact within a factor of 2 — enough for the
+    trace ledger's p50/p95/p99 trend lines (runtime/trace.py)."""
+
+    N_BUCKETS = 64
+
+    def __init__(self, name: str = "") -> None:
+        self.name = name
+        self._lock = threading.Lock()
+        self.counts = [0] * self.N_BUCKETS
+        self.count = 0
+        self.total = 0
+        self.vmin: Optional[int] = None
+        self.vmax: Optional[int] = None
+
+    @staticmethod
+    def bucket_index(value: int) -> int:
+        v = int(value)
+        if v <= 0:
+            return 0
+        return min(v.bit_length(), Histogram.N_BUCKETS - 1)
+
+    @staticmethod
+    def bucket_upper_bound(index: int) -> int:
+        """Exclusive upper bound of bucket `index` (1 for bucket 0)."""
+        return 1 << max(index, 0)
+
+    def record(self, value: int) -> None:
+        v = int(value)
+        i = self.bucket_index(v)
+        with self._lock:
+            self.counts[i] += 1
+            self.count += 1
+            self.total += v
+            if self.vmin is None or v < self.vmin:
+                self.vmin = v
+            if self.vmax is None or v > self.vmax:
+                self.vmax = v
+
+    def merge(self, other: "Histogram") -> "Histogram":
+        """Fold `other` into self (same fixed layout, so a plain sum)."""
+        o = other.snapshot()
+        with self._lock:
+            for i, n in enumerate(o["counts"]):
+                self.counts[i] += n
+            self.count += o["count"]
+            self.total += o["total"]
+            if o["min"] is not None:
+                self.vmin = o["min"] if self.vmin is None \
+                    else min(self.vmin, o["min"])
+            if o["max"] is not None:
+                self.vmax = o["max"] if self.vmax is None \
+                    else max(self.vmax, o["max"])
+        return self
+
+    def percentile(self, p: float) -> Optional[int]:
+        """Upper bound of the bucket holding the p-th percentile value,
+        clamped to the observed max (None when empty)."""
+        with self._lock:
+            if not self.count:
+                return None
+            rank = max(1, -(-int(self.count * p) // 100))  # ceil
+            seen = 0
+            for i, n in enumerate(self.counts):
+                seen += n
+                if seen >= rank:
+                    return min(self.bucket_upper_bound(i), self.vmax)
+            return self.vmax
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            nonzero: List[Tuple[int, int]] = [
+                (i, n) for i, n in enumerate(self.counts) if n]
+            return {
+                "name": self.name, "count": self.count, "total": self.total,
+                "min": self.vmin, "max": self.vmax,
+                "mean": (self.total / self.count) if self.count else None,
+                "counts": list(self.counts),
+                "buckets": {f"<{self.bucket_upper_bound(i)}": n
+                            for i, n in nonzero},
+            }
+
+    def summary(self) -> str:
+        """One-line 'n= p50= p95= p99= max=' rendering ('' when empty)."""
+        if not self.count:
+            return ""
+        return (f"{self.name}: n={self.count} p50={self.percentile(50)} "
+                f"p95={self.percentile(95)} p99={self.percentile(99)} "
+                f"max={self.vmax}")

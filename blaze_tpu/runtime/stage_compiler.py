@@ -40,7 +40,7 @@ from blaze_tpu.ops.agg import (
     AggExec, AggMode, result_field, state_fields,
 )
 from blaze_tpu.ops.base import ExecContext, MapLikeOp, Operator
-from blaze_tpu.runtime import compile_service, jit_cache
+from blaze_tpu.runtime import compile_service, jit_cache, trace
 
 _GROUP_KINDS = (TypeKind.INT8, TypeKind.INT16, TypeKind.INT32,
                 TypeKind.INT64, TypeKind.DATE)
@@ -194,6 +194,7 @@ def try_run_stage(root: Operator, ctx: ExecContext, deferred: bool = False,
 
         faults.inject("op." + type(root).__name__)
     compile_service.note_stage_attempt()
+    trace.event("whole_stage_attempt", op_kind=type(root).__name__)
     m = _match(root)
     if m is None:
         # chain_ok=False (the shuffle drivers): an agg-less chain stage
@@ -833,6 +834,7 @@ def _run_chain_stage(root: Operator, chain: List[MapLikeOp],
 def _fallback(root, batches, source, ctx) -> ColumnBatch:
     from blaze_tpu.ops.basic import MemorySourceExec
 
+    trace.event("whole_stage_fallback", op_kind=type(root).__name__)
     src = MemorySourceExec(batches, source.schema)
     return _collect_streaming(_rebuild(root, source, src), ctx)
 

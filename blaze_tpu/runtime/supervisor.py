@@ -57,6 +57,7 @@ retries/ladder only — overhead is one branch per stage.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import statistics
 import threading
 import time
@@ -66,7 +67,7 @@ from typing import Any, Callable, Dict, FrozenSet, List, Optional, Tuple
 
 from blaze_tpu.config import conf
 from blaze_tpu.ops.base import ExecContext, TaskKilledError
-from blaze_tpu.runtime import faults
+from blaze_tpu.runtime import faults, trace
 
 # thread-local plumbing: the attempt running on THIS thread (read by
 # faults._stall to make injected stalls kill-interruptible) and the task
@@ -91,7 +92,7 @@ class TaskAttempt:
     check is simultaneously the attempt's heartbeat."""
 
     __slots__ = ("task", "speculative", "started", "last_beat",
-                 "kill_event", "kill_reason", "deadline")
+                 "kill_event", "kill_reason", "deadline", "attempt_id")
 
     def __init__(self, task: "_Task", speculative: bool) -> None:
         self.task = task
@@ -101,6 +102,9 @@ class TaskAttempt:
         self.kill_event = threading.Event()
         self.kill_reason: Optional[str] = None
         self.deadline = task.deadline
+        # trace correlation id, unique within the task (speculative twins
+        # get their own — "which attempt actually produced partition 7")
+        self.attempt_id = task.next_attempt_id()
 
     def is_running(self) -> bool:
         self.last_beat = time.monotonic()
@@ -170,6 +174,7 @@ class CircuitBreaker:
             self._tripped.add(kind)
         faults.TELEMETRY.add("breaker.trips", 1)
         faults.TELEMETRY.add(f"breaker.tripped.{kind}", 1)
+        trace.event("breaker_trip", op_kind=kind, failures=n)
         if self._run_info is not None:
             self._run_info["breaker_trips"] = \
                 self._run_info.get("breaker_trips", 0) + 1
@@ -210,8 +215,8 @@ class _Task:
     live attempts (primary + at most one speculative) and the
     first-finish-wins outcome."""
 
-    def __init__(self, spec: TaskSpec, stage_key, deadline: Optional[float]
-                 ) -> None:
+    def __init__(self, spec: TaskSpec, stage_key, deadline: Optional[float],
+                 trace_ctx: Optional[Dict[str, Any]] = None) -> None:
         self.spec = spec
         self.stage_key = stage_key
         self.deadline = deadline
@@ -223,6 +228,15 @@ class _Task:
         self.speculated = False
         self.cancelled = False
         self.primary_started: Optional[float] = None
+        # driver-thread trace context (query_id/stage_id) captured at
+        # submit, replayed inside pool/speculative/watchdog emissions so
+        # cross-thread records stay correlated; task_id = spec.what
+        self.trace_ctx: Dict[str, Any] = dict(trace_ctx or {})
+        self.trace_ctx["task_id"] = spec.what
+        self._attempt_seq = itertools.count(1)
+
+    def next_attempt_id(self) -> int:
+        return next(self._attempt_seq)
 
     @property
     def finished(self) -> bool:
@@ -356,9 +370,19 @@ class Supervisor:
                 if att.deadline is not None and now > att.deadline:
                     if att.kill("deadline"):
                         self._note("deadline_kills")
+                        trace.event("deadline_kill",
+                                    attempt_id=att.attempt_id,
+                                    **task.trace_ctx)
                 elif hang_s > 0 and now - att.last_beat > hang_s:
                     if att.kill("hung"):
                         self._note("hangs_detected")
+                        # a heartbeat miss: the attempt's batch-boundary
+                        # check went stale past conf.hang_detect_ms
+                        trace.event("hang_detected",
+                                    attempt_id=att.attempt_id,
+                                    stale_ms=round((now - att.last_beat)
+                                                   * 1000),
+                                    **task.trace_ctx)
             self._maybe_speculate(task, now)
 
     def _note(self, key: str, n: int = 1) -> None:
@@ -395,6 +419,9 @@ class Supervisor:
                 return
             task.speculated = True
         self._note("speculations_launched")
+        trace.event("speculation_launch",
+                    elapsed_ms=round((now - task.primary_started) * 1000),
+                    median_ms=round(med * 1000), **task.trace_ctx)
         t = threading.Thread(target=self._run_speculative, args=(task,),
                              name="blz-speculative", daemon=True)
         with self._lock:
@@ -408,12 +435,20 @@ class Supervisor:
         try:
             started = time.monotonic()
             value = self._attempt_once(task, speculative=True)
-        except BaseException:  # noqa: BLE001 — twin failure is non-fatal
+        except BaseException as e:  # noqa: BLE001 — twin failure non-fatal
+            trace.event("speculation_loss", loser="speculative",
+                        reason=type(e).__name__, **task.trace_ctx)
             return
         if task.finish("ok", value):
             self._note("speculations_won")
+            # the twin won the first-commit-wins race; the primary is
+            # killed and records the loss side of the same pair
+            trace.event("speculation_win", winner="speculative",
+                        **task.trace_ctx)
             self._record_duration(task.stage_key,
                                   time.monotonic() - started)
+            trace.record_value("task_latency_us",
+                               int((time.monotonic() - started) * 1e6))
             task.kill_attempts("speculation_lost", speculative=False)
 
     # -- attempt execution -------------------------------------------------
@@ -433,11 +468,24 @@ class Supervisor:
         prev_task = getattr(_current, "task", None)
         _current.attempt, _current.task = att, task
         try:
-            ctx = ExecContext(partition=task.spec.partition,
-                              num_partitions=task.spec.num_partitions,
-                              is_running=att.is_running,
-                              commit_gate=task.gate)
-            return task.spec.attempt_fn(ctx)
+            # replay the driver's correlation ids on THIS thread (pool or
+            # speculative twin) and record the attempt as a span — every
+            # record inside inherits query/stage/task/attempt ids
+            with trace.context(**task.trace_ctx):
+                with trace.span("task_attempt",
+                                attempt_id=att.attempt_id,
+                                partition=task.spec.partition,
+                                speculative=speculative) as sp:
+                    ctx = ExecContext(
+                        partition=task.spec.partition,
+                        num_partitions=task.spec.num_partitions,
+                        is_running=att.is_running,
+                        commit_gate=task.gate)
+                    try:
+                        return task.spec.attempt_fn(ctx)
+                    finally:
+                        if att.kill_reason:
+                            sp.set(kill_reason=att.kill_reason)
         except TaskKilledError as e:
             if att.kill_reason == "hung":
                 raise faults.HungError(
@@ -458,30 +506,14 @@ class Supervisor:
         through the task's outcome slot."""
         from blaze_tpu.runtime.executor import run_task_with_resilience
 
-        spec = task.spec
         prev_task = getattr(_current, "task", None)
         _current.task = task
         try:
-            def attempt():
-                # breaker check at EVERY attempt boundary, not just task
-                # start: a kind that trips mid-ladder (its own failures
-                # count) reroutes this task's next retry instead of
-                # burning the remaining budget on a doomed operator
-                if (spec.fallback_fn is not None
-                        and self.breaker.should_reroute(spec.op_kinds)):
-                    self._note("breaker_reroutes")
-                    return spec.fallback_fn()
-                return self._attempt_once(task, speculative=False)
-
-            started = time.monotonic()
-            value = run_task_with_resilience(
-                attempt, what=spec.what, run_info=self.run_info,
-                fallback=spec.fallback_fn, deadline=task.deadline,
-                on_error=self.breaker.note_failure)
-            if task.finish("ok", value):
-                self._record_duration(task.stage_key,
-                                      time.monotonic() - started)
-            task.kill_attempts("speculation_lost", speculative=True)
+            # context on the WORKER thread so the executor's retry/ladder
+            # events (emitted between attempts, outside _attempt_once's
+            # span) still carry the query/stage/task ids
+            with trace.context(**task.trace_ctx):
+                self._run_supervised_inner(task, run_task_with_resilience)
         except BaseException as e:  # noqa: BLE001
             if isinstance(e, TaskKilledError) and not task.finished:
                 # killed by a twin/sibling that should be finishing the
@@ -497,6 +529,40 @@ class Supervisor:
         if kind == "err":
             raise value
         return value
+
+    def _run_supervised_inner(self, task: _Task, run_task_with_resilience
+                              ) -> None:
+        spec = task.spec
+
+        def attempt():
+            # breaker check at EVERY attempt boundary, not just task
+            # start: a kind that trips mid-ladder (its own failures
+            # count) reroutes this task's next retry instead of
+            # burning the remaining budget on a doomed operator
+            if (spec.fallback_fn is not None
+                    and self.breaker.should_reroute(spec.op_kinds)):
+                self._note("breaker_reroutes")
+                return spec.fallback_fn()
+            return self._attempt_once(task, speculative=False)
+
+        started = time.monotonic()
+        value = run_task_with_resilience(
+            attempt, what=spec.what, run_info=self.run_info,
+            fallback=spec.fallback_fn, deadline=task.deadline,
+            on_error=self.breaker.note_failure)
+        if task.finish("ok", value):
+            self._record_duration(task.stage_key,
+                                  time.monotonic() - started)
+            trace.record_value(
+                "task_latency_us",
+                int((time.monotonic() - started) * 1e6))
+            if task.speculated:
+                # primary beat its own twin: the launched speculation
+                # lost the race
+                trace.event("speculation_loss", loser="speculative",
+                            reason="primary_finished",
+                            **task.trace_ctx)
+        task.kill_attempts("speculation_lost", speculative=True)
 
     def _twin_grace(self, task: _Task) -> float:
         if task.deadline is not None:
@@ -517,7 +583,11 @@ class Supervisor:
             return [self._run_sequential(spec) for spec in specs]
         pool = self._ensure_pool()
         deadline = self.deadline()
-        tasks = [_Task(spec, stage_key, deadline) for spec in specs]
+        # snapshot the driver's query/stage ids here, on the submitting
+        # thread — pool workers and twins replay them via task.trace_ctx
+        ctx_snap = trace.current_context()
+        tasks = [_Task(spec, stage_key, deadline, ctx_snap)
+                 for spec in specs]
         with self._lock:
             self._tasks.extend(tasks)
         self._ensure_watchdog()
@@ -538,6 +608,7 @@ class Supervisor:
                 task.cancelled = True
                 task.kill_attempts("deadline")
                 self._abandoned = True
+                trace.event("task_abandoned", **task.trace_ctx)
                 if first_err is None:
                     first_err = faults.DeadlineError(
                         f"{task.spec.what}: task exceeded its deadline "
@@ -576,11 +647,18 @@ class Supervisor:
                 return spec.fallback_fn()
             return spec.attempt_fn(ctx)
 
-        return run_task_with_resilience(
-            attempt, what=spec.what,
-            run_info=self.run_info, fallback=spec.fallback_fn,
-            ctx=ctx, deadline=self.deadline(),
-            on_error=self.breaker.note_failure)
+        # sequential path runs on the driver thread: only task_id needs
+        # pushing, the query/stage ids are already on this thread's stack
+        with trace.context(task_id=spec.what):
+            started = time.monotonic()
+            value = run_task_with_resilience(
+                attempt, what=spec.what,
+                run_info=self.run_info, fallback=spec.fallback_fn,
+                ctx=ctx, deadline=self.deadline(),
+                on_error=self.breaker.note_failure)
+            trace.record_value("task_latency_us",
+                               int((time.monotonic() - started) * 1e6))
+            return value
 
     def close(self) -> None:
         """Kill every live attempt, stop the watchdog, drain the pool.

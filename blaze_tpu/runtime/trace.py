@@ -1,0 +1,593 @@
+"""Structured query tracing: correlated span/event log + exporters.
+
+The reference Blaze's observability is per-operator counters pushed into
+the Spark UI (blaze/src/metrics.rs, MetricNode.scala). After the
+resilience/supervisor PRs this engine retries, degrades, speculates,
+kills and reroutes tasks — a flat counter dict cannot answer "why was
+this query slow" or "which attempt actually produced partition 7". This
+module records every such decision as a structured record with
+correlation ids, the native-side trace Flare argues Spark loses once
+compilation makes its own instrumentation blind (arxiv 1703.08219):
+
+  TraceLog    process-global, lock-protected, BOUNDED ring of records
+              (conf.trace_buffer_events; overflow drops the oldest and
+              counts it in `dropped`). Monotonic + wall timestamps come
+              from injectable clocks so tests assert exact durations.
+
+  spans       `with span(kind, **attrs):` records one "span" with
+              begin/duration; id kwargs (query_id/stage_id/task_id/
+              attempt_id) also become thread-local CONTEXT inherited by
+              every record opened inside — a grep on one task_id
+              reconstructs the task's whole life across threads (the
+              supervisor copies the driver's context into pool/
+              speculation threads).
+
+  events      `event(kind, **attrs)` records a point: retries, ladder
+              rungs, heartbeat misses, deadline kills, speculation
+              launch/win/loss, breaker trips, fault injections, spills,
+              compile cache traffic.
+
+  exporters   export_chrome_trace() — Chrome/Perfetto trace-event JSON,
+              one row per task, spans nested under stages; view next to
+              the XLA traces conf.profiler_dir captures (tracing.py).
+              explain_analyze() — EXPLAIN ANALYZE-style operator tree
+              merging per-op counters with span wall-times, throughput
+              and resilience annotations.
+              export_run_ledger() — one JSONL summary line per query
+              (ids, durations, per-stage timings, telemetry deltas,
+              histogram percentiles) for trend tooling
+              (tools/trace_report.py).
+
+  histograms  named process-global `metrics.Histogram`s (log2 buckets):
+              batch_rows, task_latency_us, shuffle_write_bytes —
+              surfaced in the ledger and explain_analyze.
+
+Everything is gated on `conf.trace_enabled`: disabled, span() returns a
+shared no-op context manager and event() returns after one truthiness
+check — the posture faults.inject established for disabled points.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+from blaze_tpu.config import conf
+from blaze_tpu.runtime.metrics import Histogram
+
+# correlation-id keys: hoisted out of attrs onto the record top level and
+# inherited by nested records through the thread-local context stack
+ID_KEYS = ("query_id", "stage_id", "task_id", "attempt_id")
+
+_ctx = threading.local()
+_qid_seq = itertools.count(1)
+
+
+def new_query_id() -> str:
+    """Process-unique query correlation id (pid-tagged so ledger lines
+    from different drivers sharing a trace dir never collide)."""
+    return f"q{os.getpid()}-{next(_qid_seq)}"
+
+
+def _ctx_stack() -> List[Dict[str, Any]]:
+    s = getattr(_ctx, "stack", None)
+    if s is None:
+        s = _ctx.stack = []
+    return s
+
+
+def current_context() -> Dict[str, Any]:
+    """Merged correlation ids active on THIS thread (innermost wins).
+    The supervisor snapshots this on the driver thread and replays it
+    inside pool/speculative threads (trace.context(**snap))."""
+    merged: Dict[str, Any] = {}
+    for d in _ctx_stack():
+        merged.update(d)
+    return merged
+
+
+@contextlib.contextmanager
+def context(**ids):
+    """Push correlation ids for records opened inside the block."""
+    stack = _ctx_stack()
+    stack.append({k: v for k, v in ids.items() if v is not None})
+    try:
+        yield
+    finally:
+        stack.pop()
+
+
+class TraceLog:
+    """Bounded, lock-protected span/event log.
+
+    `clock` returns monotonic nanoseconds (ordering + durations), `wall`
+    epoch nanoseconds (cross-process correlation); both injectable so
+    tests pin exact timings. Capacity is re-read from
+    conf.trace_buffer_events per append unless fixed at construction."""
+
+    def __init__(self, capacity: Optional[int] = None,
+                 clock: Optional[Callable[[], int]] = None,
+                 wall: Optional[Callable[[], int]] = None) -> None:
+        self._lock = threading.Lock()
+        self._buf: deque = deque()
+        self._capacity = capacity
+        self.clock = clock or time.monotonic_ns
+        self.wall = wall or time.time_ns
+        self.dropped = 0
+
+    def _cap(self) -> int:
+        if self._capacity is not None:
+            return max(int(self._capacity), 1)
+        return max(int(conf.trace_buffer_events), 1)
+
+    def append(self, rec: Dict[str, Any]) -> None:
+        cap = self._cap()
+        with self._lock:
+            while len(self._buf) >= cap:
+                self._buf.popleft()
+                self.dropped += 1
+            self._buf.append(rec)
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        """Records oldest-first (copies of the list, records shared)."""
+        with self._lock:
+            return list(self._buf)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._buf.clear()
+            self.dropped = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._buf)
+
+
+TRACE = TraceLog()
+
+# -- named histogram registry ------------------------------------------------
+
+_hist_lock = threading.Lock()
+_HISTS: Dict[str, Histogram] = {}
+
+
+def histogram(name: str) -> Histogram:
+    h = _HISTS.get(name)
+    if h is None:
+        with _hist_lock:
+            h = _HISTS.setdefault(name, Histogram(name))
+    return h
+
+
+def record_value(name: str, value: int) -> None:
+    """Record into a named histogram when tracing is enabled."""
+    if conf.trace_enabled:
+        histogram(name).record(value)
+
+
+def histograms_snapshot(reset: bool = False) -> Dict[str, dict]:
+    with _hist_lock:
+        hists = dict(_HISTS)
+        if reset:
+            _HISTS.clear()
+    return {k: h.snapshot() for k, h in hists.items() if h.count}
+
+
+def reset_histograms() -> None:
+    with _hist_lock:
+        _HISTS.clear()
+
+
+def reset() -> None:
+    """Clear the global log + histograms (test/bench isolation)."""
+    TRACE.reset()
+    reset_histograms()
+
+
+# -- recording ---------------------------------------------------------------
+
+
+def _base_record(rtype: str, kind: str, attrs: Dict[str, Any]
+                 ) -> Dict[str, Any]:
+    rec: Dict[str, Any] = {"type": rtype, "kind": kind}
+    rec.update(current_context())
+    for k in ID_KEYS:
+        if k in attrs:
+            v = attrs.pop(k)
+            if v is not None:
+                rec[k] = v
+    rec["thread"] = threading.current_thread().name
+    if attrs:
+        rec["attrs"] = attrs
+    return rec
+
+
+def event(kind: str, **attrs) -> None:
+    """Record a point event (no-op unless conf.trace_enabled).
+
+    Correlation ids come from the thread context; explicit id kwargs
+    (query_id=..., task_id=...) override it — watchdog-thread callers
+    pass them directly since they run outside any task context."""
+    if not conf.trace_enabled:
+        return
+    log = TRACE
+    rec = _base_record("event", kind, attrs)
+    rec["ts"] = log.clock()
+    rec["wall"] = log.wall()
+    log.append(rec)
+
+
+class _Span:
+    """Live span handle: `attrs` may be mutated (or set()) before exit —
+    the stage spans learn their transport only after the mesh attempt."""
+
+    __slots__ = ("kind", "attrs", "ids", "t0", "wall0", "_cm", "error")
+
+    def __init__(self, kind: str, ids: Dict[str, Any],
+                 attrs: Dict[str, Any]) -> None:
+        self.kind = kind
+        self.ids = ids
+        self.attrs = attrs
+        self.error: Optional[str] = None
+        self.t0 = 0
+        self.wall0 = 0
+        self._cm = None
+
+    def set(self, **kw) -> "_Span":
+        self.attrs.update(kw)
+        return self
+
+
+class _NullSpan:
+    """Shared disabled-path span: enter/exit/set are no-ops."""
+
+    __slots__ = ()
+    attrs: Dict[str, Any] = {}
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **kw):
+        return self
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _SpanCM:
+    __slots__ = ("span",)
+
+    def __init__(self, span: _Span) -> None:
+        self.span = span
+
+    def __enter__(self) -> _Span:
+        sp = self.span
+        sp.t0 = TRACE.clock()
+        sp.wall0 = TRACE.wall()
+        cm = context(**sp.ids)
+        cm.__enter__()
+        sp._cm = cm
+        return sp
+
+    def __exit__(self, etype, exc, tb) -> bool:
+        sp = self.span
+        log = TRACE
+        dur = log.clock() - sp.t0
+        sp._cm.__exit__(etype, exc, tb)
+        rec = _base_record("span", sp.kind, dict(sp.attrs))
+        rec.update({k: v for k, v in sp.ids.items() if v is not None})
+        rec["ts"] = sp.t0
+        rec["wall"] = sp.wall0
+        rec["dur"] = dur
+        if exc is not None:
+            rec["error"] = f"{type(exc).__name__}: {exc}"[:200]
+        elif sp.error:
+            rec["error"] = sp.error
+        log.append(rec)
+        return False
+
+
+def span(kind: str, **attrs):
+    """Context manager recording a span (one record at exit, with begin
+    timestamp + duration). Id kwargs double as context for the block:
+
+        with span("stage", stage_id=3, stage_kind="shuffle_map") as sp:
+            ...                       # children inherit stage_id=3
+            sp.set(transport="mesh")  # attrs may be refined before exit
+    """
+    if not conf.trace_enabled:
+        return _NULL_SPAN
+    ids = {k: attrs.pop(k) for k in ID_KEYS if k in attrs}
+    return _SpanCM(_Span(kind, ids, attrs))
+
+
+def on_batch(op, rows: int) -> None:
+    """Batch-boundary hook (ops/base.count_stream — the same place the
+    heartbeat/kill check lives, so the hot path gains no new check
+    points): batch-size histogram + one trace event per batch."""
+    histogram("batch_rows").record(rows)
+    event("batch", op=op.name(), rows=rows)
+
+
+def query_records(query_id: str,
+                  records: Optional[Iterable[dict]] = None) -> List[dict]:
+    """Records correlated to one query (plus globals recorded with no
+    query id inside its window — compile/spill events from helper
+    threads keep their ids when context was present, so uncorrelated
+    records are rare and excluded)."""
+    recs = TRACE.snapshot() if records is None else list(records)
+    return [r for r in recs if r.get("query_id") == query_id]
+
+
+# -- exporter 1: Chrome/Perfetto trace-event JSON ----------------------------
+
+
+def export_chrome_trace(path: str,
+                        records: Optional[Iterable[dict]] = None) -> dict:
+    """Write records as Chrome trace-event JSON (load in Perfetto /
+    chrome://tracing, next to the XLA profiler traces from
+    conf.profiler_dir).
+
+    Row model: one process per query, one row (tid) per task — spans
+    nest by time on their row, so task-attempt spans sit under their
+    stage's span on the driver row timeline. "X" complete events carry
+    spans; instant events ("i") carry points; metadata events name the
+    rows. Returns {"events": n, "path": path}."""
+    recs = TRACE.snapshot() if records is None else list(records)
+    pids: Dict[str, int] = {}
+    tids: Dict[tuple, int] = {}
+    events: List[dict] = []
+
+    def pid_of(rec) -> int:
+        q = str(rec.get("query_id", "-"))
+        if q not in pids:
+            pids[q] = len(pids) + 1
+            events.append({"ph": "M", "name": "process_name",
+                           "pid": pids[q], "tid": 0,
+                           "args": {"name": f"blaze_tpu {q}"}})
+        return pids[q]
+
+    def tid_of(rec, pid: int) -> int:
+        row = rec.get("task_id")
+        label = str(row) if row is not None else "driver"
+        key = (pid, label)
+        if key not in tids:
+            tids[key] = 1 if row is None else len(tids) + 2
+            events.append({"ph": "M", "name": "thread_name",
+                           "pid": pid, "tid": tids[key],
+                           "args": {"name": label}})
+        return tids[key]
+
+    for rec in recs:
+        pid = pid_of(rec)
+        tid = tid_of(rec, pid)
+        args = {k: rec[k] for k in ID_KEYS if k in rec}
+        args.update(rec.get("attrs") or {})
+        if rec.get("error"):
+            args["error"] = rec["error"]
+        ev = {"name": rec["kind"], "cat": rec["type"],
+              "ts": rec["ts"] / 1000.0, "pid": pid, "tid": tid,
+              "args": args}
+        if rec["type"] == "span":
+            ev["ph"] = "X"
+            ev["dur"] = max(rec.get("dur", 0), 1) / 1000.0
+        else:
+            ev["ph"] = "i"
+            ev["s"] = "t"
+        events.append(ev)
+
+    doc = {"traceEvents": events, "displayTimeUnit": "ms",
+           "otherData": {"dropped_events": TRACE.dropped}}
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return {"events": len(events), "path": path}
+
+
+# -- exporter 2: EXPLAIN ANALYZE ---------------------------------------------
+
+
+def human_bytes(n: int) -> str:
+    """1536 -> '1.5KiB' (the *_bytes analog of *_ns -> ms rendering)."""
+    n = int(n)
+    for unit, shift in (("GiB", 30), ("MiB", 20), ("KiB", 10)):
+        if abs(n) >= (1 << shift):
+            return f"{n / (1 << shift):.1f}{unit}"
+    return f"{n}B"
+
+
+def fmt_metric(k: str, v) -> str:
+    if k.endswith("_ns"):
+        return f"{k[:-3]}={v / 1e6:.1f}ms"
+    if k.endswith("_bytes"):
+        return f"{k}={human_bytes(v)}"
+    return f"{k}={v}"
+
+
+_RESILIENCE_EVENT_KINDS = (
+    "retry", "ladder_rung", "hang_detected", "hang_relaunch",
+    "deadline_kill", "deadline_exceeded", "speculation_launch",
+    "speculation_win", "speculation_loss", "breaker_trip",
+    "fault_injected", "task_error", "degrade",
+)
+
+
+def _stage_annotations(stage_events: List[dict]) -> str:
+    """'2 retries, rung=halve_batch, speculated: won' from one stage's
+    resilience events."""
+    notes: List[str] = []
+    retries = sum(1 for e in stage_events if e["kind"] == "retry")
+    if retries:
+        notes.append(f"{retries} retr{'y' if retries == 1 else 'ies'}")
+    rungs = [e.get("attrs", {}).get("action") for e in stage_events
+             if e["kind"] == "ladder_rung"]
+    if rungs:
+        notes.append(f"rung={rungs[-1]}")
+    hangs = sum(1 for e in stage_events if e["kind"] == "hang_detected")
+    if hangs:
+        notes.append(f"{hangs} hang kill(s)")
+    if any(e["kind"] == "speculation_launch" for e in stage_events):
+        won = any(e["kind"] == "speculation_win" for e in stage_events)
+        notes.append("speculated: " + ("won" if won else "lost"))
+    trips = [e.get("attrs", {}).get("op_kind") for e in stage_events
+             if e["kind"] == "breaker_trip"]
+    if trips:
+        notes.append(f"breaker tripped: {','.join(map(str, trips))}")
+    faults_fired = sum(1 for e in stage_events
+                       if e["kind"] == "fault_injected")
+    if faults_fired:
+        notes.append(f"{faults_fired} fault(s) injected")
+    return ", ".join(notes)
+
+
+def explain_analyze(root, run_info: Optional[dict] = None,
+                    records: Optional[Iterable[dict]] = None) -> str:
+    """EXPLAIN ANALYZE-style report: the operator tree with per-operator
+    counters (bytes humanized, times in ms, row throughput), then
+    per-stage span wall-times with resilience annotations, histogram
+    percentiles and the process telemetry summaries.
+
+    `root` is an executed Operator tree (its MetricsSet snapshots are
+    read under their locks); `records` defaults to the global TraceLog —
+    pass query_records(qid) to scope a multi-query log."""
+    lines: List[str] = ["== EXPLAIN ANALYZE =="]
+
+    def walk(op, depth: int) -> None:
+        vals = {k: v for k, v in op.metrics.snapshot().items() if v}
+        parts = [fmt_metric(k, v) for k, v in sorted(vals.items())]
+        ns = vals.get("elapsed_compute_ns", 0)
+        rows = vals.get("output_rows", 0)
+        if ns and rows:
+            parts.append(f"throughput={rows / (ns / 1e9):,.0f} rows/s")
+        lines.append("  " * depth + f"{op.name()}: " + ", ".join(parts))
+        for c in op.children:
+            walk(c, depth + 1)
+
+    walk(root, 0)
+
+    recs = TRACE.snapshot() if records is None else list(records)
+    stage_spans = [r for r in recs
+                   if r["type"] == "span" and r["kind"] == "stage"]
+    if stage_spans:
+        lines.append("-- stages --")
+        for sp in stage_spans:
+            a = sp.get("attrs", {})
+            sid = sp.get("stage_id")
+            head = (f"stage {sid} {a.get('stage_kind', '?')}"
+                    f"[{a.get('transport', '-')}] "
+                    f"{sp.get('dur', 0) / 1e6:.1f}ms tasks={a.get('tasks', 1)}")
+            if a.get("bytes"):
+                head += f" bytes={human_bytes(a['bytes'])}"
+            notes = _stage_annotations(
+                [r for r in recs if r["type"] == "event"
+                 and r.get("stage_id") == sid
+                 and r["kind"] in _RESILIENCE_EVENT_KINDS])
+            if sp.get("error"):
+                notes = (notes + ", " if notes else "") + \
+                    f"error={sp['error']}"
+            lines.append("  " + head + (f"  [{notes}]" if notes else ""))
+    qspans = [r for r in recs
+              if r["type"] == "span" and r["kind"] == "query"]
+    for q in qspans:
+        lines.append(f"query {q.get('query_id')}: "
+                     f"{q.get('dur', 0) / 1e6:.1f}ms")
+
+    hists = histograms_snapshot()
+    if hists:
+        lines.append("-- distributions --")
+        for name in sorted(hists):
+            lines.append("  " + histogram(name).summary())
+
+    from blaze_tpu.runtime import compile_service, faults
+
+    for summary in (compile_service.telemetry_summary(),
+                    faults.telemetry_summary()):
+        if summary:
+            lines.append(summary)
+    if run_info:
+        shown = ", ".join(f"{k}={v}" for k, v in sorted(run_info.items())
+                          if not isinstance(v, (dict, list)))
+        lines.append(f"run_info: {shown}")
+    return "\n".join(lines)
+
+
+# -- exporter 3: run ledger (JSONL, one line per query) ----------------------
+
+
+def build_run_record(query_id: str, run_info: Optional[dict] = None,
+                     records: Optional[Iterable[dict]] = None) -> dict:
+    """One query's ledger line: ids, durations, per-stage timings,
+    run_info counters, histogram snapshots, drop accounting."""
+    recs = query_records(query_id, records)
+    qspan = next((r for r in recs if r["type"] == "span"
+                  and r["kind"] == "query"), None)
+    stages = []
+    for sp in recs:
+        if sp["type"] != "span" or sp["kind"] != "stage":
+            continue
+        a = sp.get("attrs", {})
+        stages.append({"stage_id": sp.get("stage_id"),
+                       "kind": a.get("stage_kind"),
+                       "transport": a.get("transport"),
+                       "ms": round(sp.get("dur", 0) / 1e6, 3),
+                       "tasks": a.get("tasks", 1),
+                       "bytes": a.get("bytes", 0)})
+    event_counts: Dict[str, int] = {}
+    for r in recs:
+        if r["type"] == "event" and r["kind"] in _RESILIENCE_EVENT_KINDS:
+            event_counts[r["kind"]] = event_counts.get(r["kind"], 0) + 1
+    return {
+        "query_id": query_id,
+        "wall_ns": qspan.get("wall") if qspan else None,
+        "duration_ms": (round(qspan.get("dur", 0) / 1e6, 3)
+                        if qspan else None),
+        "stages": stages,
+        "events": len(recs),
+        "resilience_events": event_counts,
+        "counters": {k: v for k, v in (run_info or {}).items()
+                     if not isinstance(v, (dict, list))},
+        "histograms": {
+            name: {"count": s["count"], "total": s["total"],
+                   "min": s["min"], "max": s["max"],
+                   "p50": histogram(name).percentile(50),
+                   "p95": histogram(name).percentile(95),
+                   "p99": histogram(name).percentile(99)}
+            for name, s in histograms_snapshot().items()},
+        "dropped_events": TRACE.dropped,
+    }
+
+
+def export_run_ledger(path: str, record: dict) -> None:
+    """Append one JSONL line (atomic enough for trend tooling: a single
+    write() of one line; concurrent drivers interleave whole lines)."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "a") as f:
+        f.write(json.dumps(record, default=str) + "\n")
+
+
+def export_query(query_id: str, run_info: Optional[dict] = None,
+                 export_dir: Optional[str] = None) -> Optional[dict]:
+    """Per-query auto-export (the local runner calls this at query-span
+    close when conf.trace_export_dir is set): writes
+    <dir>/trace_<query_id>.json and appends <dir>/ledger.jsonl."""
+    d = export_dir or conf.trace_export_dir
+    if not d:
+        return None
+    recs = query_records(query_id)
+    export_chrome_trace(os.path.join(d, f"trace_{query_id}.json"), recs)
+    rec = build_run_record(query_id, run_info, recs)
+    export_run_ledger(os.path.join(d, "ledger.jsonl"), rec)
+    return rec

@@ -11,6 +11,14 @@ cannot give you.
 `metric_report` renders the per-operator metric tree (MetricNode) after a
 run — the textual analog of the reference's metric push into the Spark UI
 (blaze/src/metrics.rs:21-50).
+
+For the ENGINE-side timeline — spans/events with query/stage/task/attempt
+correlation ids, Chrome/Perfetto export, the EXPLAIN ANALYZE tree
+(`trace.explain_analyze`, a superset of `metric_report`) and the per-query
+run ledger — see runtime/trace.py. The two traces are complementary: the
+XLA profiler shows where the DEVICE spent time, trace.py shows why the
+RUNTIME scheduled, retried or rerouted the work around it; load both in
+Perfetto side by side (README "Observability").
 """
 
 from __future__ import annotations
@@ -35,14 +43,22 @@ def profiled_scope(name: str = "query"):
 
 
 def metric_report(root) -> str:
-    """Operator tree with its metrics, one line per op (post-run)."""
+    """Operator tree with its metrics, one line per op (post-run).
+
+    Counters are read via MetricsSet.snapshot() — supervisor pool
+    threads mutate the raw dicts while a report renders, and iterating
+    them unlocked raises RuntimeError("dict changed size during
+    iteration"). `*_ns` values render as ms, `*_bytes` as KiB/MiB
+    (trace.fmt_metric). For the span-correlated superset (stage
+    wall-times, throughput, resilience annotations) use
+    trace.explain_analyze(root, run_info)."""
+    from blaze_tpu.runtime.trace import fmt_metric
+
     lines: List[str] = []
 
     def walk(op, depth: int) -> None:
-        vals = {k: v for k, v in op.metrics.values.items() if v}
-        shown = ", ".join(
-            f"{k}={v / 1e6:.1f}ms" if k.endswith("_ns") else f"{k}={v}"
-            for k, v in sorted(vals.items()))
+        vals = {k: v for k, v in op.metrics.snapshot().items() if v}
+        shown = ", ".join(fmt_metric(k, v) for k, v in sorted(vals.items()))
         lines.append("  " * depth + f"{op.name()}: {shown}")
         for c in op.children:
             walk(c, depth + 1)
@@ -50,6 +66,8 @@ def metric_report(root) -> str:
     walk(root, 0)
     from blaze_tpu.runtime import compile_service, faults
 
+    # both summaries include their per-category breakdowns (the faults
+    # one appends [plan=1 retryable=2 ...] error counts, not only totals)
     for summary in (compile_service.telemetry_summary(),
                     faults.telemetry_summary()):
         if summary:

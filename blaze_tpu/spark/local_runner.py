@@ -27,7 +27,7 @@ from blaze_tpu.ops.base import ExecContext
 from blaze_tpu.ops.common import concat_batches
 from blaze_tpu.plan import decode_plan
 from blaze_tpu.plan import plan_pb2 as pb
-from blaze_tpu.runtime import artifacts, faults, resources
+from blaze_tpu.runtime import artifacts, faults, resources, trace
 from blaze_tpu.runtime import supervisor as supervisor_mod
 from blaze_tpu.runtime.executor import execute_plan, run_task_with_resilience
 from blaze_tpu.runtime.supervisor import Supervisor, TaskSpec
@@ -55,12 +55,31 @@ def run_plan(root: SparkPlan, num_partitions: int = 4,
     ("mesh_stages", "file_stages", "broadcast_stages") so callers — the
     multichip dryrun, tests — can assert WHICH transport carried each
     exchange rather than trusting the result alone.
+
+    When conf.trace_enabled, the whole run is a "query" span in the
+    engine trace (runtime/trace.py) and every stage/task below inherits
+    its query_id; with conf.trace_export_dir set, the Chrome trace and a
+    run-ledger line are exported on completion (README "Observability").
     """
+    from blaze_tpu.config import conf
     from blaze_tpu.runtime.tracing import profiled_scope
 
-    with profiled_scope("run_plan"):
-        return _run_plan_inner(root, num_partitions, work_dir,
-                               mesh_exchange, mesh_quota, run_info)
+    if run_info is None:
+        run_info = {}
+    qid = run_info.get("query_id") or trace.new_query_id()
+    run_info["query_id"] = qid
+    try:
+        with profiled_scope("run_plan"):
+            with trace.span("query", query_id=qid,
+                            num_partitions=num_partitions,
+                            mesh_exchange=mesh_exchange):
+                return _run_plan_inner(root, num_partitions, work_dir,
+                                       mesh_exchange, mesh_quota, run_info)
+    finally:
+        # export even on failure: a failed query's trace is the one you
+        # most want to read
+        if conf.trace_enabled and conf.trace_export_dir:
+            trace.export_query(qid, run_info)
 
 
 def _run_plan_inner(root: SparkPlan, num_partitions: int,
@@ -126,44 +145,60 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
                         "(stage %d)", n, stage.stage_id)
             if stage.kind == "shuffle_map":
                 shuffle_parts[stage.stage_id] = stage.num_partitions
-                if mesh_exchange == "auto":
-                    from blaze_tpu.parallel.stage_exchange import (
-                        run_mesh_shuffle_stage,
-                    )
+                with trace.span("stage", stage_id=stage.stage_id,
+                                stage_kind="shuffle_map",
+                                tasks=_input_tasks(stage, stages)) as sp:
+                    if mesh_exchange == "auto":
+                        from blaze_tpu.parallel.stage_exchange import (
+                            run_mesh_shuffle_stage,
+                        )
 
-                    stats: Dict[str, int] = {}
-                    # a transient/resource failure on the mesh degrades to
-                    # the file exchange (same row multisets by design);
-                    # plan/fatal/killed relay — another transport won't fix
-                    # a broken plan
-                    try:
-                        mesh_ok = run_mesh_shuffle_stage(
-                            stage.plan, stage.stage_id,
-                            _input_tasks(stage, stages), quota=mesh_quota,
-                            work_dir=work_dir, stats=stats)
-                    except Exception as e:  # noqa: BLE001 — classified below
-                        cat = faults.classify(e)
-                        if cat in ("killed", "fatal", "plan"):
-                            raise
-                        faults.note_error(cat, run_info)
-                        faults.note_degradation("mesh_to_file", run_info)
-                        mesh_ok = False
-                    if mesh_ok:
-                        shuffle_bytes[stage.stage_id] = stats.get("bytes", 0)
-                        run_info["mesh_stages"] += 1
-                        continue
-                logical = _run_shuffle_stage(stage, stages, shuffle_mgr,
-                                             sup, run_info)
-                # logical (uncompressed) bytes: the mesh path reports the
-                # same unit, so the AQE threshold is transport-independent
-                shuffle_bytes[stage.stage_id] = logical
-                run_info["file_stages"] += 1
+                        stats: Dict[str, int] = {}
+                        # a transient/resource failure on the mesh degrades
+                        # to the file exchange (same row multisets by
+                        # design); plan/fatal/killed relay — another
+                        # transport won't fix a broken plan
+                        try:
+                            mesh_ok = run_mesh_shuffle_stage(
+                                stage.plan, stage.stage_id,
+                                _input_tasks(stage, stages),
+                                quota=mesh_quota,
+                                work_dir=work_dir, stats=stats)
+                        except Exception as e:  # noqa: BLE001 — classified
+                            cat = faults.classify(e)
+                            if cat in ("killed", "fatal", "plan"):
+                                raise
+                            faults.note_error(cat, run_info)
+                            faults.note_degradation("mesh_to_file", run_info)
+                            trace.event("degrade", what="mesh_to_file",
+                                        category=cat,
+                                        error=type(e).__name__)
+                            mesh_ok = False
+                        if mesh_ok:
+                            shuffle_bytes[stage.stage_id] = \
+                                stats.get("bytes", 0)
+                            run_info["mesh_stages"] += 1
+                            sp.set(transport="mesh",
+                                   bytes=stats.get("bytes", 0))
+                            continue
+                    logical = _run_shuffle_stage(stage, stages, shuffle_mgr,
+                                                 sup, run_info)
+                    # logical (uncompressed) bytes: the mesh path reports
+                    # the same unit, so the AQE threshold is
+                    # transport-independent
+                    shuffle_bytes[stage.stage_id] = logical
+                    run_info["file_stages"] += 1
+                    sp.set(transport="file", bytes=logical)
             elif stage.kind == "broadcast":
-                _run_broadcast_stage(stage, stages, sup, run_info)
+                with trace.span("stage", stage_id=stage.stage_id,
+                                stage_kind="broadcast", tasks=1):
+                    _run_broadcast_stage(stage, stages, sup, run_info)
                 run_info["broadcast_stages"] += 1
             else:
                 parts = _input_tasks(stage, stages, fallback=num_partitions)
-                out = _run_result_stage(stage, parts, sup, run_info)
+                with trace.span("stage", stage_id=stage.stage_id,
+                                stage_kind="result", tasks=parts):
+                    out = _run_result_stage(stage, parts, sup, run_info)
                 return _merge_fallback_root_sort(root, out, parts)
         raise AssertionError("no result stage produced")
     finally:
@@ -267,7 +302,9 @@ def _run_shuffle_stage(stage: Stage, stages: List[Stage],
     ops = sup.run_tasks(("shuffle", stage.stage_id), specs)
     logical = 0
     for op, slot in zip(ops, slots):
-        logical += op.metrics.values.get("shuffle_logical_bytes", 0)
+        written = op.metrics.values.get("shuffle_logical_bytes", 0)
+        trace.record_value("shuffle_write_bytes", written)
+        logical += written
         slot.commit()
 
     resources.put(f"shuffle:{stage.stage_id}",

@@ -550,7 +550,9 @@ def test_inject_stall_interrupted_by_kill_flag():
 
     from blaze_tpu.runtime import supervisor as sup_mod
 
-    att = sup_mod.TaskAttempt(_types.SimpleNamespace(deadline=None), False)
+    att = sup_mod.TaskAttempt(
+        _types.SimpleNamespace(deadline=None,
+                               next_attempt_id=lambda: 1), False)
     att.kill(reason="hung")
     sup_mod._current.attempt = att
     try:

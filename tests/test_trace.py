@@ -1,0 +1,362 @@
+"""Engine trace (runtime/trace.py): correlated span/event records,
+bounded ring accounting, deterministic-clock timings, the Chrome-trace /
+EXPLAIN ANALYZE / run-ledger exporters, log2 histograms, and the
+supervised-chaos acceptance run (trace must contain the injected fault,
+the retry and the speculation, all correlated to task ids)."""
+
+import json
+import time
+
+import pytest
+
+from blaze_tpu.config import conf
+from blaze_tpu.runtime import artifacts, faults, trace
+from blaze_tpu.runtime.metrics import Histogram
+from blaze_tpu.runtime.trace import TraceLog
+
+
+@pytest.fixture(autouse=True)
+def _clean_trace_conf():
+    saved = {k: getattr(conf, k) for k in (
+        "trace_enabled", "trace_export_dir", "trace_buffer_events",
+        "enable_supervisor", "max_concurrent_tasks", "hang_detect_ms",
+        "speculation_multiplier", "max_task_retries", "retry_backoff_ms")}
+    saved_clock, saved_wall = trace.TRACE.clock, trace.TRACE.wall
+    trace.reset()
+    yield
+    for k, v in saved.items():
+        setattr(conf, k, v)
+    trace.TRACE.clock, trace.TRACE.wall = saved_clock, saved_wall
+    trace.reset()
+    faults.install(None)
+    faults.reset_telemetry()
+
+
+# ---------------------------------------------------------------------------
+# spans, events, correlation context
+# ---------------------------------------------------------------------------
+
+
+def test_span_nesting_and_context_inheritance():
+    conf.trace_enabled = True
+    with trace.context(query_id="qT"):
+        with trace.span("stage", stage_id=3, stage_kind="shuffle_map"):
+            trace.event("retry", task_id="map[3:1]", n=1)
+        trace.event("degrade", what="mesh_to_file")
+    recs = trace.TRACE.snapshot()
+    assert [r["kind"] for r in recs] == ["retry", "stage", "degrade"]
+    retry, stage, degrade = recs
+    # the event inherits BOTH the outer context and the span's ids, plus
+    # its own explicit task_id — a grep on any id finds it
+    assert retry["query_id"] == "qT"
+    assert retry["stage_id"] == 3
+    assert retry["task_id"] == "map[3:1]"
+    assert retry["attrs"]["n"] == 1
+    assert stage["query_id"] == "qT" and stage["stage_id"] == 3
+    assert "dur" in stage and stage["dur"] >= 0
+    # context popped with the span: the later event has no stage_id
+    assert degrade["query_id"] == "qT" and "stage_id" not in degrade
+
+
+def test_span_records_error_and_attr_refinement():
+    conf.trace_enabled = True
+    with pytest.raises(ValueError):
+        with trace.span("stage", stage_id=1) as sp:
+            sp.set(transport="file")
+            raise ValueError("boom")
+    (rec,) = trace.TRACE.snapshot()
+    assert rec["attrs"]["transport"] == "file"
+    assert rec["error"].startswith("ValueError")
+
+
+def test_disabled_trace_records_nothing():
+    conf.trace_enabled = False
+    with trace.span("stage", stage_id=1) as sp:
+        sp.set(transport="file")  # the shared null span absorbs set()
+        trace.event("retry", n=1)
+    trace.record_value("batch_rows", 100)
+    assert len(trace.TRACE) == 0
+    assert trace.histograms_snapshot() == {}
+
+
+def test_ring_buffer_overflow_drops_oldest_and_counts():
+    log = TraceLog(capacity=4)
+    for i in range(10):
+        log.append({"type": "event", "kind": f"e{i}", "ts": i})
+    assert len(log) == 4
+    assert log.dropped == 6
+    assert [r["kind"] for r in log.snapshot()] == ["e6", "e7", "e8", "e9"]
+    log.reset()
+    assert len(log) == 0 and log.dropped == 0
+
+
+def test_deterministic_clock_durations():
+    conf.trace_enabled = True
+    ticks = iter([1000, 5500, 9000])  # span enter, event, span exit
+    trace.TRACE.clock = lambda: next(ticks)
+    trace.TRACE.wall = lambda: 1_700_000_000_000_000_000
+    with trace.span("query", query_id="qC"):
+        trace.event("spill", spill_bytes=64)
+    ev, sp = trace.TRACE.snapshot()
+    assert ev["ts"] == 5500
+    assert sp["ts"] == 1000 and sp["dur"] == 8000
+    assert sp["wall"] == 1_700_000_000_000_000_000
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+
+def _record_sample_query(qid="qE"):
+    conf.trace_enabled = True
+    with trace.span("query", query_id=qid):
+        with trace.span("stage", stage_id=0, stage_kind="shuffle_map",
+                        tasks=2) as sp:
+            with trace.span("task_attempt", task_id="map[0:0]",
+                            attempt_id=1):
+                trace.event("fault_injected", point="op.FilterExec",
+                            fault_kind="io")
+                trace.event("retry", n=1, category="retryable")
+            sp.set(transport="file", bytes=2048)
+    return trace.TRACE.snapshot()
+
+
+def test_chrome_trace_schema(tmp_path):
+    recs = _record_sample_query()
+    path = str(tmp_path / "t.json")
+    out = trace.export_chrome_trace(path, recs)
+    assert out["events"] > 0
+    doc = json.load(open(path))
+    evs = doc["traceEvents"]
+    assert doc["otherData"]["dropped_events"] == 0
+    phases = {e["ph"] for e in evs}
+    assert phases <= {"X", "i", "M"}
+    spans = [e for e in evs if e["ph"] == "X"]
+    assert {"query", "stage", "task_attempt"} <= {e["name"] for e in spans}
+    for e in spans:
+        assert isinstance(e["ts"], float) and isinstance(e["dur"], float)
+        assert e["dur"] > 0
+    # instants sit on the same row (tid) as their task's span
+    att = next(e for e in spans if e["name"] == "task_attempt")
+    retry = next(e for e in evs if e["name"] == "retry")
+    assert retry["ph"] == "i" and retry["s"] == "t"
+    assert retry["tid"] == att["tid"] and retry["pid"] == att["pid"]
+    assert retry["args"]["task_id"] == "map[0:0]"
+    # metadata rows name the process after the query id
+    names = [e["args"]["name"] for e in evs if e["ph"] == "M"]
+    assert any("qE" in n for n in names)
+
+
+def test_run_ledger_appends_one_line_per_query(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    for qid in ("qL1", "qL2"):
+        trace.reset()
+        recs = _record_sample_query(qid)
+        rec = trace.build_run_record(qid, {"file_stages": 1}, recs)
+        trace.export_run_ledger(path, rec)
+    lines = [json.loads(x) for x in open(path).read().splitlines()]
+    assert [x["query_id"] for x in lines] == ["qL1", "qL2"]
+    one = lines[0]
+    assert one["duration_ms"] > 0
+    assert one["stages"][0]["transport"] == "file"
+    assert one["stages"][0]["bytes"] == 2048
+    assert one["resilience_events"]["retry"] == 1
+    assert one["resilience_events"]["fault_injected"] == 1
+    assert one["counters"]["file_stages"] == 1
+    assert one["dropped_events"] == 0
+
+
+def test_explain_analyze_tree_and_annotations(rng):
+    import numpy as np
+
+    from blaze_tpu.columnar import types as T
+    from blaze_tpu.columnar.batch import ColumnBatch
+    from blaze_tpu.exprs import ir
+    from blaze_tpu.ops.basic import FilterExec, MemorySourceExec
+    from blaze_tpu.runtime.executor import collect
+
+    schema = T.Schema([T.Field("x", T.INT64)])
+    b = ColumnBatch.from_numpy({"x": np.arange(64, dtype=np.int64)},
+                               schema)
+    flt = FilterExec(MemorySourceExec([b], schema),
+                     [ir.Binary(ir.BinOp.GE, ir.col("x"),
+                                ir.Literal(T.INT64, 32))])
+    collect(flt)
+    recs = _record_sample_query("qX")
+    trace.record_value("batch_rows", 64)
+    rep = trace.explain_analyze(flt, {"file_stages": 1}, recs)
+    assert "== EXPLAIN ANALYZE ==" in rep
+    assert "FilterExec" in rep and "MemorySourceExec" in rep
+    assert "stage 0 shuffle_map[file]" in rep
+    assert "1 retry" in rep and "1 fault(s) injected" in rep
+    assert "bytes=2.0KiB" in rep
+    assert "batch_rows" in rep
+    assert "run_info: file_stages=1" in rep
+
+
+def test_export_query_writes_trace_and_ledger(tmp_path):
+    conf.trace_enabled = True
+    d = str(tmp_path / "exports")
+    _record_sample_query("qD")
+    rec = trace.export_query("qD", {"file_stages": 1}, export_dir=d)
+    assert rec["query_id"] == "qD"
+    doc = json.load(open(str(tmp_path / "exports" / "trace_qD.json")))
+    assert doc["traceEvents"]
+    lines = open(str(tmp_path / "exports" / "ledger.jsonl")).readlines()
+    assert len(lines) == 1
+
+
+# ---------------------------------------------------------------------------
+# histograms
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_log2_bucket_math():
+    h = Histogram("t")
+    assert h.bucket_index(0) == 0
+    assert h.bucket_index(1) == 1    # [1, 2)
+    assert h.bucket_index(2) == 2    # [2, 4)
+    assert h.bucket_index(3) == 2
+    assert h.bucket_index(4) == 3    # [4, 8)
+    assert h.bucket_index(1 << 62) == 63
+    assert h.bucket_index(1 << 63) == 63  # clamp: top bucket is open
+    for i in range(1, 10):
+        lo, hi = h.bucket_upper_bound(i - 1), h.bucket_upper_bound(i)
+        assert h.bucket_index(lo) == i and h.bucket_index(hi - 1) == i
+
+
+def test_histogram_percentiles_and_summary():
+    h = Histogram("lat_us")
+    for _ in range(100):
+        h.record(1000)
+    h.record(1_000_000)
+    assert h.count == 101
+    # bucket resolution: p50 reports the 1000-bucket's upper bound
+    assert h.percentile(50) == 1024
+    assert h.percentile(99) == 1024
+    assert h.percentile(100) == 1_000_000  # capped at the observed max
+    assert h.vmin == 1000 and h.vmax == 1_000_000
+    s = h.summary()
+    assert "lat_us" in s and "n=101" in s
+
+
+def test_histogram_merge():
+    a, b = Histogram("m"), Histogram("m")
+    for v in (1, 2, 4):
+        a.record(v)
+    for v in (8, 16):
+        b.record(v)
+    a.merge(b)
+    assert a.count == 5
+    assert a.total == 31
+    assert a.vmin == 1 and a.vmax == 16
+    empty = Histogram("m")
+    empty.merge(a)
+    assert empty.count == 5 and empty.vmin == 1 and empty.vmax == 16
+
+
+def test_record_value_registry():
+    conf.trace_enabled = True
+    trace.record_value("batch_rows", 100)
+    trace.record_value("batch_rows", 200)
+    snap = trace.histograms_snapshot()
+    assert snap["batch_rows"]["count"] == 2
+    trace.reset_histograms()
+    assert trace.histograms_snapshot() == {}
+
+
+# ---------------------------------------------------------------------------
+# supervised chaos acceptance: fault + retry + speculation in one trace
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tables(tmp_path_factory):
+    from blaze_tpu.spark import validator
+
+    d = str(tmp_path_factory.mktemp("trace_tables"))
+    return validator.generate_tables(d, rows=3000)
+
+
+def _run_traced(tables, tmp_path, query, mode, spec):
+    from blaze_tpu.spark import validator
+    from blaze_tpu.spark.local_runner import run_plan
+
+    paths, frames = tables
+    plan, oracle = validator.QUERIES[query](paths, frames, mode)
+    faults.install(spec)
+    info = {}
+    try:
+        out = run_plan(plan, num_partitions=4, work_dir=str(tmp_path),
+                       mesh_exchange="off", run_info=info)
+    finally:
+        faults.install(None)
+    diff = validator._compare(
+        validator._to_pandas(out).reset_index(drop=True),
+        oracle().reset_index(drop=True))
+    assert diff is None, diff
+    assert artifacts.find_orphans([str(tmp_path)]) == []
+    return info
+
+
+def test_supervised_chaos_trace_acceptance(tables, tmp_path):
+    """ISSUE 4 acceptance: a supervised chaos run with tracing on yields
+    a valid Chrome trace containing >=1 speculation and >=1 retry event,
+    each correlated to a task id."""
+    from blaze_tpu.spark import validator
+    from blaze_tpu.spark.local_runner import run_plan
+
+    paths, frames = tables
+    # warm the jit caches so attempt durations reflect execution
+    plan, _ = validator.QUERIES["q3_join_agg_sort"](paths, frames, "smj")
+    run_plan(plan, num_partitions=4, mesh_exchange="off")
+
+    conf.trace_enabled = True
+    conf.speculation_multiplier = 3.0
+    conf.max_concurrent_tasks = 4
+    trace.reset()
+    # run 1: a 15s straggler stall -> the twin must launch and win
+    t0 = time.monotonic()
+    info = _run_traced(
+        tables, tmp_path, "q3_join_agg_sort", "smj",
+        {"seed": 22, "concurrent": True,
+         "points": {"op": {"kind": "stall", "nth": 6, "ms": 15_000}}})
+    assert time.monotonic() - t0 < 12.0, "twin must beat the 15s stall"
+    assert info.get("speculations_launched", 0) >= 1
+    # run 2: transient io faults -> plain retries on the ladder
+    info2 = _run_traced(
+        tables, tmp_path, "q2_q06_core_agg", "bhj",
+        {"seed": 7, "concurrent": True,
+         "points": {"op.ParquetScanExec": {"kind": "io",
+                                           "fail_times": 1}}})
+    assert info2.get("retries", 0) >= 1
+
+    recs = trace.TRACE.snapshot()
+    by_kind = {}
+    for r in recs:
+        by_kind.setdefault(r["kind"], []).append(r)
+    assert by_kind.get("speculation_launch"), "no speculation in trace"
+    assert by_kind.get("retry"), "no retry in trace"
+    assert by_kind.get("fault_injected"), "no injected fault in trace"
+    # every resilience event names the task it belongs to
+    for kind in ("speculation_launch", "retry"):
+        for r in by_kind[kind]:
+            assert r.get("task_id"), f"{kind} event missing task_id: {r}"
+            assert r.get("query_id"), f"{kind} event missing query_id"
+    # the retry correlates to a recorded attempt span of the SAME task
+    attempts = {r.get("task_id") for r in recs
+                if r["type"] == "span" and r["kind"] == "task_attempt"}
+    assert by_kind["retry"][0]["task_id"] in attempts
+
+    # and the whole log exports as a structurally valid Chrome trace
+    path = str(tmp_path / "chaos_trace.json")
+    trace.export_chrome_trace(path)
+    doc = json.load(open(path))
+    evs = doc["traceEvents"]
+    assert {e["ph"] for e in evs} <= {"X", "i", "M"}
+    spec_evs = [e for e in evs if e["name"] == "speculation_launch"]
+    retry_evs = [e for e in evs if e["name"] == "retry"]
+    assert spec_evs and spec_evs[0]["args"].get("task_id")
+    assert retry_evs and retry_evs[0]["args"].get("task_id")
+    assert doc["otherData"]["dropped_events"] == 0

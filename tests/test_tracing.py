@@ -32,6 +32,20 @@ def test_profiler_trace_written(tmp_path, rng):
     assert found, "profiler must write trace files"
 
 
+def test_profiled_scope_noop_without_profiler_dir():
+    """conf.profiler_dir unset: the scope must be a plain passthrough —
+    no jax.profiler session, no files, body still runs."""
+    old = conf.profiler_dir
+    conf.profiler_dir = ""
+    try:
+        ran = []
+        with profiled_scope("noop"):
+            ran.append(1)
+        assert ran == [1]
+    finally:
+        conf.profiler_dir = old
+
+
 def test_metric_report(rng):
     schema = T.Schema([T.Field("x", T.INT64)])
     b = ColumnBatch.from_numpy({"x": np.arange(50, dtype=np.int64)}, schema)
@@ -42,6 +56,20 @@ def test_metric_report(rng):
     rep = metric_report(flt)
     assert "FilterExec" in rep and "MemorySourceExec" in rep
     assert "output_rows=25" in rep
+
+
+def test_metric_report_humanizes_bytes_and_ns(rng):
+    """*_ns counters render as ms and *_bytes as KiB/MiB — the same
+    formatting trace.explain_analyze uses (fmt_metric)."""
+    schema = T.Schema([T.Field("x", T.INT64)])
+    b = ColumnBatch.from_numpy({"x": np.arange(8, dtype=np.int64)}, schema)
+    src = MemorySourceExec([b], schema)
+    collect(src)
+    src.metrics.add("fake_bytes", 3 * (1 << 20))
+    src.metrics.add("fake_ns", 2_500_000)
+    rep = metric_report(src)
+    assert "fake_bytes=3.0MiB" in rep
+    assert "fake=2.5ms" in rep  # fake_ns -> 'fake=...ms'
 
 
 def test_input_batch_statistics(rng):

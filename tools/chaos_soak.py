@@ -176,6 +176,11 @@ def main() -> int:
     ap.add_argument("--supervisor", action="store_true",
                     help="run the sweep under the concurrent supervised "
                          "pool (hang detection + speculation armed)")
+    ap.add_argument("--trace-dir", default=None,
+                    help="enable the engine trace (conf.trace_enabled) and "
+                         "export per-query Chrome traces + ledger.jsonl "
+                         "into this directory — the soak doubles as the "
+                         "observability acceptance run")
     ap.add_argument("--json-out", default=None)
     args = ap.parse_args()
     if args.json_out is None:
@@ -189,11 +194,16 @@ def main() -> int:
     from blaze_tpu.spark import validator
 
     saved_conf = {k: getattr(conf, k) for k in (
-        "max_concurrent_tasks", "hang_detect_ms", "speculation_multiplier")}
+        "max_concurrent_tasks", "hang_detect_ms", "speculation_multiplier",
+        "trace_enabled", "trace_export_dir")}
     if args.supervisor:
         conf.max_concurrent_tasks = 4
         conf.hang_detect_ms = args.hang_detect_ms
         conf.speculation_multiplier = 4.0
+    if args.trace_dir:
+        os.makedirs(args.trace_dir, exist_ok=True)
+        conf.trace_enabled = True
+        conf.trace_export_dir = args.trace_dir
 
     tmpdir = tempfile.mkdtemp(prefix="chaos_tables_")
     tables = validator.generate_tables(tmpdir, rows=args.rows)
@@ -236,6 +246,12 @@ def main() -> int:
         "outcomes": outcomes, "overhead": overhead,
         "ok": not bad, "cells": cells,
     }
+    if args.trace_dir:
+        from blaze_tpu.runtime import trace
+
+        report["trace"] = {"dir": args.trace_dir,
+                           "records": len(trace.TRACE),
+                           "dropped_events": trace.TRACE.dropped}
     with open(args.json_out, "w") as f:
         json.dump(report, f, indent=1)
     print(f"\noutcomes: {outcomes}")
