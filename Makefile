@@ -8,7 +8,8 @@
 PYENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
 .PHONY: check check-fast check-faults check-supervisor check-trace \
-	test test-fast validate validate-fast warm
+	check-pipeline check-pipeline-soak test test-fast validate \
+	validate-fast warm
 
 check: test validate
 	@echo "CHECK OK — safe to commit"
@@ -52,6 +53,23 @@ check-faults:
 check-supervisor:
 	$(PYENV) python tools/chaos_soak.py --supervisor \
 	  --json-out SUPERVISOR_r07.json
+
+# Pipeline gate: I/O-bound shuffle microbench serial vs pipelined (must
+# show >= 1.3x from overlapping synthetic I/O with consumer compute),
+# plus the validator mini-catalogue with enable_pipeline off vs on (both
+# directions within noise — the off path restores serial behavior, the
+# on path must not slow real queries). Emits PIPELINE_r09.json.
+check-pipeline:
+	$(PYENV) python tools/pipeline_bench.py --json-out PIPELINE_r09.json
+
+# Pipeline chaos soak: the fault sweep with the async pipeline layer
+# kept live under every armed spec (pool-thread errors — including the
+# io.prefetch queue hand-off — must classify + recover, answers must
+# match the oracle, and no cell may leak prefetch streams, sinks, or
+# pipeline memory reservations). Emits PIPELINE_SOAK_r09.json.
+check-pipeline-soak:
+	$(PYENV) python tools/chaos_soak.py --pipeline \
+	  --json-out PIPELINE_SOAK_r09.json
 
 # Trace gate: validator mini-catalogue tracing-off vs tracing-on — the
 # enabled path must drop zero events at the default ring size and stay

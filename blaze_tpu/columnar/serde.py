@@ -194,20 +194,24 @@ def _read_exact(fp: BinaryIO, n: int) -> bytes:
 
 
 def deserialize_batch(buf: bytes, schema: Schema,
-                      capacity: Optional[int] = None) -> ColumnBatch:
+                      capacity: Optional[int] = None,
+                      dctx=None) -> ColumnBatch:
     if conf.fault_injection_spec:
         faults.inject("serde.decode")
     if buf[:4] != MAGIC:
         raise ValueError("bad batch frame magic")
     raw_len, comp_len = struct.unpack("<II", buf[4:12])
-    raw = zstandard.ZstdDecompressor().decompress(
+    raw = (dctx or zstandard.ZstdDecompressor()).decompress(
         buf[12:12 + comp_len], max_output_size=raw_len)
     return _decode(io.BytesIO(raw), schema, capacity)
 
 
 def read_batch(fp: BinaryIO, schema: Schema,
-               capacity: Optional[int] = None) -> Optional[ColumnBatch]:
-    """Read one frame; None at clean EOF."""
+               capacity: Optional[int] = None,
+               dctx=None) -> Optional[ColumnBatch]:
+    """Read one frame; None at clean EOF. `dctx` lets stream readers
+    reuse one decompressor across frames (context setup dominates small
+    frames); per-frame construction remains the one-shot default."""
     if conf.fault_injection_spec:
         faults.inject("serde.decode")
     head = fp.read(12)
@@ -217,20 +221,22 @@ def read_batch(fp: BinaryIO, schema: Schema,
         raise ValueError("bad batch frame header")
     raw_len, comp_len = struct.unpack("<II", head[4:])
     comp = _read_exact(fp, comp_len)
-    raw = zstandard.ZstdDecompressor().decompress(comp,
-                                                  max_output_size=raw_len)
+    raw = (dctx or zstandard.ZstdDecompressor()).decompress(
+        comp, max_output_size=raw_len)
     return _decode(io.BytesIO(raw), schema, capacity)
 
 
 def read_batches(fp: BinaryIO, schema: Schema) -> Iterator[ColumnBatch]:
+    dctx = zstandard.ZstdDecompressor()
     while True:
-        b = read_batch(fp, schema)
+        b = read_batch(fp, schema, dctx=dctx)
         if b is None:
             return
         yield b
 
 
-def read_batch_host(fp: BinaryIO, schema: Schema) -> Optional[HostBatch]:
+def read_batch_host(fp: BinaryIO, schema: Schema,
+                    dctx=None) -> Optional[HostBatch]:
     """Decode one frame to host numpy columns (no device upload) — the
     spill-merge and host-coalescing paths (ops/host_sort.py) stay entirely
     on the host until one bulk upload."""
@@ -243,8 +249,8 @@ def read_batch_host(fp: BinaryIO, schema: Schema) -> Optional[HostBatch]:
         raise ValueError("bad batch frame header")
     raw_len, comp_len = struct.unpack("<II", head[4:])
     comp = _read_exact(fp, comp_len)
-    raw = zstandard.ZstdDecompressor().decompress(comp,
-                                                  max_output_size=raw_len)
+    raw = (dctx or zstandard.ZstdDecompressor()).decompress(
+        comp, max_output_size=raw_len)
     bio = io.BytesIO(raw)
     n, ncols = struct.unpack("<IH", _read_exact(bio, 6))
     assert ncols == len(schema.fields), (ncols, len(schema.fields))
@@ -260,8 +266,9 @@ def deserialize_batch_host(buf: bytes, schema: Schema) -> HostBatch:
 
 
 def read_batches_host(fp: BinaryIO, schema: Schema) -> Iterator[HostBatch]:
+    dctx = zstandard.ZstdDecompressor()
     while True:
-        hb = read_batch_host(fp, schema)
+        hb = read_batch_host(fp, schema, dctx=dctx)
         if hb is None:
             return
         yield hb

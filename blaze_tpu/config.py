@@ -138,6 +138,24 @@ class BlazeConf:
     # attributed to one operator kind within a query, that operator trips
     # to the row-interpreter fallback for the rest of the run. 0 disables.
     breaker_failure_threshold: int = 4
+    # -- pipelined async execution (runtime/pipeline.py) --
+    # Overlap host-side stages (parquet read+decode, serde compress/
+    # decompress, shuffle frame write + read-side readahead, spill I/O)
+    # with device compute: producers run on a shared I/O thread pool
+    # behind bounded queues while the consumer thread keeps the device
+    # busy. False restores the serial streams; an armed fault spec
+    # without {"concurrent": true} also forces serial (thread timing
+    # would otherwise perturb deterministic chaos schedules).
+    enable_pipeline: bool = True
+    # shared I/O pool width (pipeline.io_pool). Host stages are
+    # zlib/zstd + numpy + file I/O — they release the GIL, so a few
+    # threads overlap well even under CPython.
+    io_threads: int = 4
+    # bounded queue depth per pipelined stream: at most this many
+    # batches sit decoded-but-unconsumed. In-flight bytes are reserved
+    # against the MemManager budget (backpressure, not OOM), so raising
+    # this trades memory for tolerance to bursty producers.
+    prefetch_batches: int = 2
     # per-operator enable flags (tier b, spark.blaze.enable.<op>)
     enable_ops: Dict[str, bool] = dataclasses.field(default_factory=dict)
 

@@ -132,14 +132,24 @@ def count_stream(op: Operator, stream: BatchStream) -> BatchStream:
     if stats:
         from blaze_tpu.runtime.memory import batch_nbytes
     fault_point = "op." + op.name()  # chaos injection at the op boundary
-    for batch in stream:
-        if conf.fault_injection_spec:
-            faults.inject(fault_point)
-        if conf.trace_enabled:
-            trace.on_batch(op, int(batch.num_rows))
-        op.metrics.add("output_batches", 1)
-        op.metrics.add("output_rows", int(batch.num_rows))
-        if stats:
-            op.metrics.add("stat_bytes", batch_nbytes(batch))
-            op.metrics.set_max("stat_max_batch_rows", int(batch.num_rows))
-        yield batch
+    try:
+        for batch in stream:
+            if conf.fault_injection_spec:
+                faults.inject(fault_point)
+            if conf.trace_enabled:
+                trace.on_batch(op, int(batch.num_rows))
+            op.metrics.add("output_batches", 1)
+            op.metrics.add("output_rows", int(batch.num_rows))
+            if stats:
+                op.metrics.add("stat_bytes", batch_nbytes(batch))
+                op.metrics.set_max("stat_max_batch_rows",
+                                   int(batch.num_rows))
+            yield batch
+    finally:
+        # deterministic teardown: when the consumer abandons the stream
+        # (kill, speculation loss, downstream error) a pipelined source
+        # (runtime/pipeline.PrefetchStream) must quiesce its producer and
+        # release its memory reservations NOW, not at GC time
+        close = getattr(stream, "close", None)
+        if close is not None:
+            close()

@@ -158,7 +158,12 @@ class ParquetScanExec(Operator):
                         self.metrics.add("bytes_scanned", rb.nbytes)
                         yield batch
 
-        return count_stream(self, gen())
+        from blaze_tpu.runtime import memory as M, pipeline
+
+        # prefetch: parquet read+decode+upload of the next macro-batch
+        # runs on the I/O pool while downstream computes on this one
+        return count_stream(self, pipeline.prefetch(
+            gen(), ctx=ctx, manager=M.get_manager(ctx), name="parquet_scan"))
 
     def _select_row_groups(self, pf) -> List[int]:
         if not self.pruning_predicates:

@@ -168,6 +168,27 @@ class ShuffleWriterExec(Operator):
         key = ("shuffle_part", keys_jit, rr if is_rr else None,
                self.plan_key())
         row_offset = 0
+
+        def write_out(job):
+            # pool-side half of the map task: slice the partition-sorted
+            # host batch into per-partition frames (compress) and push
+            # them into the writer. state.push serializes on op_lock and
+            # the sink has one worker, so push order == submit order.
+            hb, counts = job
+            offs = np.concatenate([[0], np.cumsum(counts)])
+            for p in range(self.partitioning.num_partitions):
+                if counts[p]:
+                    state.push(p, serde.serialize_slice(
+                        hb, int(offs[p]), int(offs[p + 1])))
+
+        from blaze_tpu.ops.host_sort import host_nbytes
+        from blaze_tpu.runtime import pipeline
+
+        # overlap batch i's compress+write with batch i+1's
+        # partition-split compute; inline (serial) when pipelining is off
+        sink = pipeline.Sink(write_out, ctx=ctx, manager=M.get_manager(ctx),
+                             name="shuffle_write")
+        committed = False
         try:
             from blaze_tpu.runtime.executor import execute_stage_or_plan
 
@@ -190,12 +211,10 @@ class ShuffleWriterExec(Operator):
                         "shuffle_logical_bytes",
                         M.batch_nbytes(batch) * int(batch.num_rows) // cap)
                     hb = serde.to_host(sb)
-                    counts = np.asarray(counts)
-                    offs = np.concatenate([[0], np.cumsum(counts)])
-                    for p in range(self.partitioning.num_partitions):
-                        if counts[p]:
-                            state.push(p, serde.serialize_slice(
-                                hb, int(offs[p]), int(offs[p + 1])))
+                    sink.submit((hb, np.asarray(counts)), host_nbytes(hb))
+            # drain every pending frame (re-raising any pool-side error)
+            # BEFORE the crash-atomic commit sees the buffers
+            sink.close()
             with self.metrics.timer():
                 os.makedirs(os.path.dirname(self.data_path) or ".",
                             exist_ok=True)
@@ -204,7 +223,10 @@ class ShuffleWriterExec(Operator):
                     state.commit, self.data_path, self.index_path)
             self.metrics.add("shuffle_bytes_written", int(sum(lengths)))
             self.metrics.add("spill_count", state.spill_chunks)
+            committed = True
         finally:
+            if not committed:
+                sink.abort()
             state.close()
         return iter(())
 
@@ -412,10 +434,13 @@ def read_shuffle_partition(data_path: str, index_path: str, partition: int,
     zero-copy path of BlazeBlockStoreShuffleReaderBase, SURVEY.md §2.6)."""
     offsets = np.frombuffer(open(index_path, "rb").read(), "<u8")
     start, end = int(offsets[partition]), int(offsets[partition + 1])
+    # one decompressor for the whole partition: zstd context setup costs
+    # per .decompress() call dominate small frames
+    dctx = serde.zstandard.ZstdDecompressor()
     with open(data_path, "rb") as f:
         f.seek(start)
         while f.tell() < end:
-            b = serde.read_batch(f, schema)
+            b = serde.read_batch(f, schema, dctx=dctx)
             if b is None:
                 break
             yield b
@@ -428,10 +453,11 @@ def read_shuffle_partition_host(data_path: str, index_path: str,
     paying a device decode per frame."""
     offsets = np.frombuffer(open(index_path, "rb").read(), "<u8")
     start, end = int(offsets[partition]), int(offsets[partition + 1])
+    dctx = serde.zstandard.ZstdDecompressor()
     with open(data_path, "rb") as f:
         f.seek(start)
         while f.tell() < end:
-            hb = serde.read_batch_host(f, schema)
+            hb = serde.read_batch_host(f, schema, dctx=dctx)
             if hb is None:
                 break
             yield hb
@@ -469,8 +495,17 @@ class IpcReaderExec(Operator):
                     self.num_partitions != ctx.num_partitions:
                 eff_ctx = dataclasses.replace(
                     ctx, num_partitions=self.num_partitions)
+            from blaze_tpu.runtime import memory as M, pipeline
+
             source = _call_provider(resources.get(self.resource_id),
                                     eff_ctx)
+            # read-side readahead: the provider's fetch+decompress (e.g.
+            # shuffle_manager.get_reader_host decoding partition frames)
+            # runs ahead on the I/O pool, charged against the budget,
+            # while this thread coalesces/uploads the current macro-batch
+            source = pipeline.prefetch(source, ctx=ctx,
+                                       manager=M.get_manager(ctx),
+                                       name="shuffle_read")
             # host-level coalescing: serialized frames decode to numpy and
             # accumulate toward the macro-batch byte target, then upload
             # ONCE — a per-frame upload+dispatch costs a fixed ~90ms
@@ -493,33 +528,42 @@ class IpcReaderExec(Operator):
                 pending.append(hb)
                 pending_bytes += host_sort.host_nbytes(hb)
 
-            for seg in source:
-                ctx.check_running()
-                if isinstance(seg, ColumnBatch):
-                    yield from flush()
-                    yield seg
-                elif isinstance(seg, serde.HostBatch):
-                    absorb(seg)
-                elif isinstance(seg, (bytes, bytearray, memoryview)):
-                    if hsup:
-                        absorb(serde.deserialize_batch_host(
-                            bytes(seg), self._schema))
-                    else:
-                        yield serde.deserialize_batch(bytes(seg),
-                                                      self._schema)
-                else:  # file-like
-                    if hsup:
-                        for hb in serde.read_batches_host(seg,
-                                                          self._schema):
-                            absorb(hb)
-                            if pending_bytes >= target:
-                                yield from flush()
-                    else:
-                        for b in serde.read_batches(seg, self._schema):
-                            yield b
-                if pending_bytes >= target:
-                    yield from flush()
-            yield from flush()
+            try:
+                for seg in source:
+                    ctx.check_running()
+                    if isinstance(seg, ColumnBatch):
+                        yield from flush()
+                        yield seg
+                    elif isinstance(seg, serde.HostBatch):
+                        absorb(seg)
+                    elif isinstance(seg, (bytes, bytearray, memoryview)):
+                        if hsup:
+                            absorb(serde.deserialize_batch_host(
+                                bytes(seg), self._schema))
+                        else:
+                            yield serde.deserialize_batch(bytes(seg),
+                                                          self._schema)
+                    else:  # file-like
+                        if hsup:
+                            for hb in serde.read_batches_host(seg,
+                                                              self._schema):
+                                absorb(hb)
+                                if pending_bytes >= target:
+                                    yield from flush()
+                        else:
+                            for b in serde.read_batches(seg, self._schema):
+                                yield b
+                    if pending_bytes >= target:
+                        yield from flush()
+                yield from flush()
+            finally:
+                # providers may hand back a pipelined readahead stream
+                # (shuffle_manager.get_reader_host): quiesce its producer
+                # and release reservations even when this task dies
+                # mid-stream (kill, speculation loss, downstream error)
+                close = getattr(source, "close", None)
+                if close is not None:
+                    close()
 
         return count_stream(self, gen())
 

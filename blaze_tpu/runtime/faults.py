@@ -218,6 +218,12 @@ KNOWN_POINTS = (
     "device.get",
     "exchange.stage",
     "shuffle.commit",
+    # pipeline queue hand-off (runtime/pipeline.py): fires on the I/O
+    # pool thread right before a produced item crosses to the consumer,
+    # so chaos proves pool-thread errors relay classified across the
+    # queue. Serial (pipelining gated off) it fires inline instead —
+    # armed specs without {"concurrent": true} disable the pipeline.
+    "io.prefetch",
 )
 
 _counters: Dict[str, int] = {}

@@ -64,6 +64,15 @@ class MemManager:
         self._spill_files: List[weakref.ref] = []
         self.host_spill_bytes = 0
         self.host_spill_files = 0
+        # bytes held by in-flight pipelined batches (runtime/pipeline.py)
+        # between production on an I/O pool thread and consumption. Like
+        # spill pages, these count toward the budget but are NOT a
+        # MemConsumer: they cannot be spilled (the consumer is about to
+        # use them), so joining the registry would stall the
+        # update_mem_used spill-selection loop on an unspillable
+        # "largest consumer". Over-budget pipelines stop producing
+        # instead (backpressure in PrefetchStream._over_budget_locked).
+        self.pipeline_reserved = 0
 
     # -- registry --
     def register(self, consumer: MemConsumer) -> None:
@@ -100,7 +109,16 @@ class MemManager:
     # -- accounting --
     def mem_used(self) -> int:
         return sum(c.mem_used() for c in self._consumers_snapshot()) \
-            + self.spill_pages_pending()
+            + self.spill_pages_pending() + self.pipeline_reserved
+
+    def reserve_pipeline(self, nbytes: int) -> None:
+        """Charge an in-flight pipelined batch against the budget."""
+        with self._lock:
+            self.pipeline_reserved += int(nbytes)
+
+    def release_pipeline(self, nbytes: int) -> None:
+        with self._lock:
+            self.pipeline_reserved -= int(nbytes)
 
     def spill_pages_pending(self) -> int:
         """Bytes written to tracked spill files but not yet synced to
@@ -278,24 +296,31 @@ class SpillFile:
         return freed
 
     def read(self) -> Iterator[ColumnBatch]:
-        from blaze_tpu.runtime import faults
+        from blaze_tpu.runtime import faults, pipeline
 
         if conf.fault_injection_spec:
             faults.inject("spill.read")
         self.flush_pages()
         self._fp.seek(0)
-        return serde.read_batches(self._fp, self.schema)
+        # read+decompress frames ahead on the I/O pool; the k-way merge
+        # consumer interleaves many runs, and each run's readahead is
+        # charged against the budget so merges can't silently re-inflate
+        # the memory the spill was supposed to shed
+        return pipeline.prefetch(serde.read_batches(self._fp, self.schema),
+                                 manager=self._manager, name="spill_read")
 
     def read_host(self):
         """Frames as host numpy batches (serde.HostBatch) — the spill
         merge consumes runs host-side (ops/host_sort.py)."""
-        from blaze_tpu.runtime import faults
+        from blaze_tpu.runtime import faults, pipeline
 
         if conf.fault_injection_spec:
             faults.inject("spill.read")
         self.flush_pages()
         self._fp.seek(0)
-        yield from serde.read_batches_host(self._fp, self.schema)
+        return pipeline.prefetch(
+            serde.read_batches_host(self._fp, self.schema),
+            manager=self._manager, name="spill_read")
 
     def close(self) -> None:
         if self._fp is not None:

@@ -451,6 +451,21 @@ def _stage_annotations(stage_events: List[dict]) -> str:
     return ", ".join(notes)
 
 
+def _stage_overlap(pipeline_events: List[dict]) -> Optional[int]:
+    """Producer-time-weighted overlap % across a stage's pipelined
+    streams (runtime/pipeline.py "pipeline_stats" events): the share of
+    pool-side production hidden behind the consumer's compute. None when
+    the stage ran no pipelines (serial mode or no pipelined sources)."""
+    busy = wait = 0.0
+    for e in pipeline_events:
+        a = e.get("attrs", {})
+        busy += a.get("producer_busy_ms", 0.0)
+        wait += a.get("consumer_wait_ms", 0.0)
+    if busy <= 0:
+        return None
+    return int(round(100.0 * max(0.0, 1.0 - wait / busy)))
+
+
 def explain_analyze(root, run_info: Optional[dict] = None,
                     records: Optional[Iterable[dict]] = None) -> str:
     """EXPLAIN ANALYZE-style report: the operator tree with per-operator
@@ -493,6 +508,12 @@ def explain_analyze(root, run_info: Optional[dict] = None,
                 [r for r in recs if r["type"] == "event"
                  and r.get("stage_id") == sid
                  and r["kind"] in _RESILIENCE_EVENT_KINDS])
+            ov = _stage_overlap(
+                [r for r in recs if r["type"] == "event"
+                 and r.get("stage_id") == sid
+                 and r["kind"] == "pipeline_stats"])
+            if ov is not None:
+                notes = (notes + ", " if notes else "") + f"overlap={ov}%"
             if sp.get("error"):
                 notes = (notes + ", " if notes else "") + \
                     f"error={sp['error']}"

@@ -97,6 +97,9 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
     # work dirs via BlazeShuffleManager, spill files here)
     artifacts.sweep_orphans([conf.spill_dir])
     telemetry_before = faults.TELEMETRY.snapshot()
+    from blaze_tpu.runtime import pipeline
+
+    pipeline_before = pipeline.TELEMETRY.snapshot()
     apply_strategy(root)
     from blaze_tpu.spark import converters, fallback
 
@@ -204,6 +207,15 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
     finally:
         sup.close()
         faults.run_info_delta(telemetry_before, run_info)
+        # pipelined-execution accounting for this query: streams/sinks
+        # opened, and a leak indicator (must be 0 once every task stream
+        # is torn down) — chaos_soak asserts on both
+        after = pipeline.TELEMETRY.snapshot()
+        run_info["pipeline_streams"] = (
+            after.get("streams_opened", 0) + after.get("sinks_opened", 0)
+            - pipeline_before.get("streams_opened", 0)
+            - pipeline_before.get("sinks_opened", 0))
+        run_info["pipeline_live_streams"] = pipeline.live_streams()
         # release per-query registry entries: FFI export subtrees and the
         # shuffle/broadcast providers (the mesh path's providers pin full
         # capacity-padded HBM batches — leaking them across queries would

@@ -175,6 +175,9 @@ class BlazeShuffleManager:
                     continue
                 yield from read_shuffle_partition_host(
                     st.data_path, st.index_path, partition, handle.schema)
+        # readahead happens in the consumer (IpcReaderExec wraps every
+        # provider stream in pipeline.prefetch with the task's kill scope
+        # and memory budget); this stays a plain generator
         return gen()
 
     def get_all_partitions_reader(self, handle: ShuffleHandle

@@ -10,6 +10,16 @@ relaunch instead of waiting the stall out. The overhead section gains a
 supervisor-off vs. sequential A/B backing the "disabled path is the
 PR-2 runner" claim.
 
+`--pipeline` (ISSUE 5): the same sweep with the async pipeline layer
+kept LIVE under every armed spec (specs are marked concurrent, since
+the pipeline gate otherwise falls back to serial for deterministic
+non-concurrent specs), emitting `PIPELINE_SOAK_r09.json`. This drives
+pool-thread failures — including the queue hand-off point
+`io.prefetch` — through the classification/recovery ladder; every cell
+must additionally finalize all prefetch streams and sinks
+(`pipeline_leaked` = 0; leaked MemManager pipeline reservations are
+already covered by `mem_leaked`, since `mem_used()` includes them).
+
 Each cell installs one deterministic fault spec (fail the first N calls
 of one KNOWN_POINTS prefix), runs a full driver-path query, and diffs
 the answer against the pandas oracle. A cell is
@@ -50,7 +60,7 @@ KINDS = ("io", "oom")
 
 
 def _run_cell(tables, query, mode, spec):
-    from blaze_tpu.runtime import artifacts, faults
+    from blaze_tpu.runtime import artifacts, faults, pipeline
     from blaze_tpu.runtime import memory as M
     from blaze_tpu.spark import validator
     from blaze_tpu.spark.local_runner import run_plan
@@ -84,11 +94,12 @@ def _run_cell(tables, query, mode, spec):
     for k in ("faults_injected", "retries", "degradations", "ladder_rung",
               "task_fallbacks", "stalls_injected", "hangs_detected",
               "deadline_kills", "speculations_launched", "speculations_won",
-              "breaker_trips", "breaker_reroutes"):
+              "breaker_trips", "breaker_reroutes", "pipeline_streams"):
         if info.get(k):
             cell[k] = info[k]
     cell["orphans"] = artifacts.find_orphans([work_dir])
     cell["mem_leaked"] = int(M.get_manager().mem_used())
+    cell["pipeline_leaked"] = pipeline.live_streams()
     shutil.rmtree(work_dir, ignore_errors=True)
     return cell
 
@@ -176,6 +187,10 @@ def main() -> int:
     ap.add_argument("--supervisor", action="store_true",
                     help="run the sweep under the concurrent supervised "
                          "pool (hang detection + speculation armed)")
+    ap.add_argument("--pipeline", action="store_true",
+                    help="keep the async pipeline layer live under every "
+                         "armed spec (marks specs concurrent) and fail any "
+                         "cell that leaks prefetch streams/sinks")
     ap.add_argument("--trace-dir", default=None,
                     help="enable the engine trace (conf.trace_enabled) and "
                          "export per-query Chrome traces + ledger.jsonl "
@@ -185,6 +200,7 @@ def main() -> int:
     args = ap.parse_args()
     if args.json_out is None:
         args.json_out = ("SUPERVISOR_r07.json" if args.supervisor
+                         else "PIPELINE_SOAK_r09.json" if args.pipeline
                          else "FAULTS_r06.json")
     kinds = (tuple(args.kinds.split(",")) if args.kinds
              else KINDS + ("stall",) if args.supervisor else KINDS)
@@ -195,7 +211,9 @@ def main() -> int:
 
     saved_conf = {k: getattr(conf, k) for k in (
         "max_concurrent_tasks", "hang_detect_ms", "speculation_multiplier",
-        "trace_enabled", "trace_export_dir")}
+        "trace_enabled", "trace_export_dir", "enable_pipeline")}
+    if args.pipeline:
+        conf.enable_pipeline = True
     if args.supervisor:
         conf.max_concurrent_tasks = 4
         conf.hang_detect_ms = args.hang_detect_ms
@@ -215,9 +233,11 @@ def main() -> int:
             if kind == "stall":
                 rule["ms"] = args.stall_ms
             spec = {"seed": args.seed, "points": {point: rule}}
-            if args.supervisor:
+            if args.supervisor or args.pipeline:
                 # scheduling order is part of the schedule only in the
-                # sequential harness; the supervisor soak wants the pool
+                # sequential harness; the supervisor soak wants the pool,
+                # and the pipeline soak needs the concurrent mark so the
+                # pipeline layer stays live under the armed spec
                 spec["concurrent"] = True
             for query, mode in QUERIES:
                 cell = _run_cell(tables, query, mode, spec)
@@ -238,11 +258,13 @@ def main() -> int:
     for c in cells:
         outcomes[c["outcome"]] = outcomes.get(c["outcome"], 0) + 1
     bad = ([c for c in cells if c["outcome"] == "wrong_answer"]
-           + [c for c in cells if c["orphans"] or c["mem_leaked"]])
+           + [c for c in cells if c["orphans"] or c["mem_leaked"]
+              or c["pipeline_leaked"]])
     report = {
         "rows": args.rows, "fail_times": args.fail_times,
         "seed": args.seed, "kinds": list(kinds),
         "supervisor": bool(args.supervisor),
+        "pipeline": bool(args.pipeline),
         "outcomes": outcomes, "overhead": overhead,
         "ok": not bad, "cells": cells,
     }
