@@ -8,10 +8,10 @@
 PYENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
 .PHONY: check check-fast check-faults check-supervisor check-trace \
-	check-pipeline check-pipeline-soak test test-fast validate \
-	validate-fast warm
+	check-pipeline check-pipeline-soak check-perf check-perf-update \
+	check-obs test test-fast validate validate-fast warm
 
-check: test validate
+check: test validate check-perf
 	@echo "CHECK OK — safe to commit"
 
 # The every-commit bar (< 5 min): full unit suite minus the two
@@ -77,6 +77,25 @@ check-pipeline-soak:
 # be structurally valid. Emits TRACE_r08.json.
 check-trace:
 	$(PYENV) python tools/trace_report.py --bench --json-out TRACE_r08.json
+
+# Perf-regression gate: the validator mini-catalogue against the
+# committed PERF_BASELINE.json. Durations gate loosely (x2.5 + 2s —
+# shared hosts are noisy); bytes_copied/moved per boundary gate tightly
+# (x1.25 + 64KiB — byte counts are deterministic, a copy regression
+# fails loudly). `make check-perf-update` rewrites the baseline after an
+# intended change.
+check-perf:
+	$(PYENV) python tools/perf_baseline.py
+
+check-perf-update:
+	$(PYENV) python tools/perf_baseline.py --update
+
+# Observability gate: catalogue A/B with resource accounting off vs on
+# (sampler + live /metrics endpoint scraped mid-query and
+# format-checked), one chaos cell under the monitor, and zero resource
+# leaks. Emits OBS_r10.json.
+check-obs:
+	$(PYENV) python tools/perf_baseline.py --obs --json-out OBS_r10.json
 
 # Pre-warm the persistent compile caches (runtime/compile_service):
 # replays the shape manifest + the TPC-DS catalogue into the XLA cache.

@@ -65,6 +65,32 @@ HBM_GBPS_CEILING = 1500.0   # above any current single chip's HBM bandwidth
 VS_BASELINE_CEILING = 1000.0
 
 
+def _ensure_backend():
+    """BENCH_r01+ regression: in environments with no TPU attached and no
+    JAX_PLATFORMS set, jax's backend init raises RuntimeError ("Unable to
+    initialize backend") before any work runs. Backend choice is sticky
+    per-process, so probe in a SUBPROCESS and fall back to CPU when
+    nothing initializes — the bench then measures the engine path on the
+    host instead of exiting 1."""
+    import os
+    import subprocess
+
+    if os.environ.get("JAX_PLATFORMS"):
+        return
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            timeout=300)
+        ok = probe.returncode == 0
+    except Exception:  # noqa: BLE001 — a broken probe means no backend
+        ok = False
+    if not ok:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        print("[bench] no accelerator backend initialized; falling back "
+              "to JAX_PLATFORMS=cpu", file=sys.stderr)
+
+
 def _make_data(seed):
     rng = np.random.default_rng(seed)
     return {
@@ -162,6 +188,9 @@ def _build_task(schema_fields, resource_id):
 
 
 def main():
+    global ROWS, N_BATCHES, GROUPS, REPS
+
+    _ensure_backend()
     import jax
     import jax.numpy as jnp
 
@@ -171,6 +200,19 @@ def main():
     from blaze_tpu.plan.from_proto import decode_task_definition
     from blaze_tpu.runtime import resources
     from blaze_tpu.runtime.executor import collect_fetch
+
+    if jax.devices()[0].platform != "tpu":
+        # CPU fallback sizing: the contract is that the trajectory keeps
+        # recording (the engine path end-to-end, decoded proto plan and
+        # all) — the 134M-row chip workload would take hours on one host
+        # core and measure nothing about the engine
+        ROWS = 1 << 15
+        N_BATCHES = 2
+        GROUPS = 1 << 12
+        REPS = 2
+        print("[bench] non-TPU backend: reduced workload "
+              f"(rows={ROWS} x {N_BATCHES} batches, groups={GROUPS}, "
+              f"reps={REPS})", file=sys.stderr)
 
     datas = [_make_data(seed) for seed in range(N_BATCHES)]
     input_bytes = sum(sum(a.nbytes for a in d.values()) for d in datas)

@@ -61,7 +61,7 @@ except ModuleNotFoundError:  # pragma: no cover - environment-dependent
 from blaze_tpu.columnar.batch import ColumnBatch, bucket_capacity
 from blaze_tpu.columnar.types import Schema, TypeKind
 from blaze_tpu.config import conf
-from blaze_tpu.runtime import faults
+from blaze_tpu.runtime import faults, monitor
 
 MAGIC = b"BTB1"
 
@@ -98,7 +98,12 @@ class HostBatch:
         comp = zstandard.ZstdCompressor(
             level=level if level is not None else conf.zstd_level,
         ).compress(raw)
-        return MAGIC + struct.pack("<II", len(raw), len(comp)) + comp
+        frame = MAGIC + struct.pack("<II", len(raw), len(comp)) + comp
+        if conf.monitor_enabled:
+            # copied: the raw payload rebuilt row-by-row into the frame;
+            # moved: the compressed frame that actually crosses
+            monitor.count_copy("serde", len(raw), moved=len(frame))
+        return frame
 
 
 def _write_col(out, c: _HostCol, lo: int, hi: int) -> None:
@@ -155,12 +160,34 @@ def _host_col(col, n: int) -> _HostCol:
     return _HostCol("num", d, None, validity)
 
 
+def _col_nbytes(c: _HostCol) -> int:
+    n = 0
+    for arr in (c.data, c.lengths, c.validity, c.child_offsets):
+        if arr is not None:
+            n += arr.nbytes
+    if c.child is not None:
+        n += _col_nbytes(c.child)
+    if c.children:
+        n += sum(_col_nbytes(ch) for ch in c.children)
+    return n
+
+
+def host_batch_nbytes(hb: HostBatch) -> int:
+    """Host-side footprint of a pulled batch — the unit the monitor's
+    "ffi" boundary accounts for device->host pulls and host->device
+    uploads."""
+    return sum(_col_nbytes(c) for c in hb.cols)
+
+
 def to_host(batch: ColumnBatch) -> HostBatch:
     if conf.fault_injection_spec:
         faults.inject("device.get")
     n = int(batch.num_rows)
-    return HostBatch(batch.schema, [_host_col(c, n) for c in batch.columns],
-                     n)
+    hb = HostBatch(batch.schema, [_host_col(c, n) for c in batch.columns],
+                   n)
+    if conf.monitor_enabled:
+        monitor.count_copy("ffi", host_batch_nbytes(hb))
+    return hb
 
 
 def serialize_batch(batch: ColumnBatch, level: Optional[int] = None) -> bytes:
@@ -176,7 +203,11 @@ def serialize_slice(hb: HostBatch, lo: int, hi: int) -> bytes:
                                   for c in hb.cols):
         if conf.fault_injection_spec:
             faults.inject("serde.encode")
-        return native.serialize_host_batch(hb, lo, hi, conf.zstd_level)
+        frame = native.serialize_host_batch(hb, lo, hi, conf.zstd_level)
+        if conf.monitor_enabled:
+            (raw_len,) = struct.unpack_from("<I", frame, 4)
+            monitor.count_copy("serde", raw_len, moved=len(frame))
+        return frame
     return hb.serialize(lo, hi)
 
 
@@ -203,6 +234,8 @@ def deserialize_batch(buf: bytes, schema: Schema,
     raw_len, comp_len = struct.unpack("<II", buf[4:12])
     raw = (dctx or zstandard.ZstdDecompressor()).decompress(
         buf[12:12 + comp_len], max_output_size=raw_len)
+    if conf.monitor_enabled:
+        monitor.count_copy("serde", raw_len, moved=12 + comp_len)
     return _decode(io.BytesIO(raw), schema, capacity)
 
 
@@ -223,6 +256,8 @@ def read_batch(fp: BinaryIO, schema: Schema,
     comp = _read_exact(fp, comp_len)
     raw = (dctx or zstandard.ZstdDecompressor()).decompress(
         comp, max_output_size=raw_len)
+    if conf.monitor_enabled:
+        monitor.count_copy("serde", raw_len, moved=12 + comp_len)
     return _decode(io.BytesIO(raw), schema, capacity)
 
 
@@ -251,6 +286,8 @@ def read_batch_host(fp: BinaryIO, schema: Schema,
     comp = _read_exact(fp, comp_len)
     raw = (dctx or zstandard.ZstdDecompressor()).decompress(
         comp, max_output_size=raw_len)
+    if conf.monitor_enabled:
+        monitor.count_copy("serde", raw_len, moved=12 + comp_len)
     bio = io.BytesIO(raw)
     n, ncols = struct.unpack("<IH", _read_exact(bio, 6))
     assert ncols == len(schema.fields), (ncols, len(schema.fields))

@@ -156,6 +156,28 @@ class BlazeConf:
     # against the MemManager budget (backpressure, not OOM), so raising
     # this trades memory for tolerance to bursty producers.
     prefetch_batches: int = 2
+    # -- resource accounting & live metrics (runtime/monitor.py) --
+    # Byte accounting at every copy boundary (serde framing, FFI
+    # host<->device, shuffle partition split, spill write/read,
+    # row-interpreter fallback export) with per-query/stage attribution
+    # via the trace context, rolled into the run ledger and
+    # explain_analyze. Off, every boundary call site is one truthiness
+    # check and all counters read 0. The always-on leak telemetry
+    # (resource_leak events) is independent of this flag.
+    monitor_enabled: bool = True
+    # Prometheus text-format scrape endpoint (stdlib http.server daemon
+    # thread) serving GET /metrics; 0 (default) disables. The local
+    # runner starts it lazily on the first query (monitor.ensure_started
+    # also spins up the background sampler).
+    metrics_port: int = 0
+    # background ResourceMonitor sampling period: MemManager usage incl.
+    # pipeline_reserved, spill pages, pool occupancy, pipeline queue
+    # depths, and compile-cache stats into a bounded time-series ring.
+    # <= 0 disables the sampler thread.
+    monitor_sample_ms: int = 200
+    # bounded sample-ring capacity (deque maxlen — oldest samples drop
+    # first; 2048 x 200ms ≈ the last ~7 minutes)
+    monitor_ring_samples: int = 2048
     # per-operator enable flags (tier b, spark.blaze.enable.<op>)
     enable_ops: Dict[str, bool] = dataclasses.field(default_factory=dict)
 

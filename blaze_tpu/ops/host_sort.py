@@ -307,6 +307,11 @@ def host_to_device(hb: HostBatch, capacity: Optional[int] = None):
 
     if conf.fault_injection_spec:
         faults.inject("device.put")
+    if conf.monitor_enabled:
+        from blaze_tpu.columnar.serde import host_batch_nbytes
+        from blaze_tpu.runtime import monitor
+
+        monitor.count_copy("ffi", host_batch_nbytes(hb))
     n = hb.num_rows
     cap = capacity or bucket_capacity(n)
     cols = [_upload_col(c, f, n, cap)

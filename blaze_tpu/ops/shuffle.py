@@ -34,8 +34,9 @@ from blaze_tpu.exprs import ir
 from blaze_tpu.exprs.compiler import compile_expr
 from blaze_tpu.exprs.hash import SPARK_SHUFFLE_SEED, hash_columns, pmod
 from blaze_tpu.ops.base import BatchStream, ExecContext, Operator, count_stream
+from blaze_tpu.config import conf
 from blaze_tpu.ops.join import sort_batch_by_keys
-from blaze_tpu.runtime import jit_cache, resources
+from blaze_tpu.runtime import jit_cache, monitor, resources
 
 Array = jax.Array
 
@@ -276,6 +277,8 @@ class _NativeWriterState:
         return before - self.mem_used()
 
     def push(self, p: int, frame: bytes) -> None:
+        if conf.monitor_enabled:
+            monitor.count_copy("shuffle", len(frame))
         # op_lock: serialize against host-driven release() (bn_spill)
         with self.manager.op_lock:
             self._w.push(p, frame)
@@ -337,6 +340,8 @@ class _WriterBuffers:
         return freed
 
     def push(self, p: int, frame: bytes) -> None:
+        if conf.monitor_enabled:
+            monitor.count_copy("shuffle", len(frame))
         with self.manager.op_lock:
             self.buffers[p].append(frame)
             self.bytes += len(frame)
@@ -422,8 +427,11 @@ class RssShuffleWriterExec(ShuffleWriterExec):
                 offs = np.concatenate([[0], np.cumsum(counts)])
                 for p in range(P):
                     if counts[p]:
-                        writer.write(p, serde.serialize_slice(
-                            hb, int(offs[p]), int(offs[p + 1])))
+                        frame = serde.serialize_slice(
+                            hb, int(offs[p]), int(offs[p + 1]))
+                        if conf.monitor_enabled:
+                            monitor.count_copy("shuffle", len(frame))
+                        writer.write(p, frame)
         writer.flush()
         return iter(())
 

@@ -28,7 +28,7 @@ from blaze_tpu.columnar import serde
 from blaze_tpu.columnar.batch import ColumnBatch
 from blaze_tpu.columnar.types import Schema
 from blaze_tpu.config import conf
-from blaze_tpu.runtime import trace
+from blaze_tpu.runtime import monitor, trace
 
 class MemConsumer:
     """Spillable operator state (ref MemConsumer trait)."""
@@ -73,6 +73,10 @@ class MemManager:
         # "largest consumer". Over-budget pipelines stop producing
         # instead (backpressure in PrefetchStream._over_budget_locked).
         self.pipeline_reserved = 0
+        # high-water mark of mem_used(): observed at every consumer
+        # growth (update_mem_used) and by the monitor sampler; reset at
+        # query start so per-query roll-ups report peak_mem_bytes
+        self.peak_used = 0
 
     # -- registry --
     def register(self, consumer: MemConsumer) -> None:
@@ -110,6 +114,17 @@ class MemManager:
     def mem_used(self) -> int:
         return sum(c.mem_used() for c in self._consumers_snapshot()) \
             + self.spill_pages_pending() + self.pipeline_reserved
+
+    def observe_peak(self) -> int:
+        """mem_used() with high-water-mark tracking. NOT called from
+        paths holding self._lock (mem_used walks the registry under it)."""
+        used = self.mem_used()
+        if used > self.peak_used:
+            self.peak_used = used
+        return used
+
+    def reset_peak(self) -> None:
+        self.peak_used = 0
 
     def reserve_pipeline(self, nbytes: int) -> None:
         """Charge an in-flight pipelined batch against the budget."""
@@ -149,7 +164,7 @@ class MemManager:
         min-trigger floor is intentionally not applied — tiny budgets must
         force spills, which its own fuzztests also rely on).
         """
-        used = self.mem_used()
+        used = self.observe_peak()
         if used <= self.total:
             return
         # cheapest reclaim first: sync buffered spill pages to disk —
@@ -284,6 +299,8 @@ class SpillFile:
         self.pending_bytes += n
         if self._manager is not None:
             self._manager.host_spill_bytes += n
+        if conf.monitor_enabled:
+            monitor.count_copy("spill", n)
         return n
 
     def flush_pages(self) -> int:
@@ -302,6 +319,10 @@ class SpillFile:
             faults.inject("spill.read")
         self.flush_pages()
         self._fp.seek(0)
+        if conf.monitor_enabled:
+            # the whole file is about to be re-read; counted up front
+            # (the lazy prefetch below consumes every frame)
+            monitor.count_copy("spill", self.bytes_written)
         # read+decompress frames ahead on the I/O pool; the k-way merge
         # consumer interleaves many runs, and each run's readahead is
         # charged against the budget so merges can't silently re-inflate
@@ -318,6 +339,8 @@ class SpillFile:
             faults.inject("spill.read")
         self.flush_pages()
         self._fp.seek(0)
+        if conf.monitor_enabled:
+            monitor.count_copy("spill", self.bytes_written)
         return pipeline.prefetch(
             serde.read_batches_host(self._fp, self.schema),
             manager=self._manager, name="spill_read")

@@ -1,0 +1,541 @@
+"""Resource accounting + live metrics service.
+
+ROADMAP item 4 asks for `bytes_copied` as a first-class metric before
+any zero-copy work starts (Zerrow's finding: "zero-copy" pipelines
+silently copy at boundaries — you can't drive down what you don't
+count), and ROADMAP item 1 needs per-query resource attribution as the
+billing/SLO record of the future multi-tenant service. This module is
+both: continuous byte accounting at every copy boundary, a background
+sampler, and the exporters that make the numbers visible.
+
+  accounting  `count_copy(boundary, nbytes, moved=...)` — called from
+              the five copy boundaries of the engine:
+                serde     frame encode/decode in columnar/serde.py
+                          (copied = raw payload bytes built/rebuilt,
+                          moved = compressed frame bytes crossing)
+                ffi       host<->device transfers (serde.to_host pull,
+                          host_sort.host_to_device upload) and the
+                          native-ABI result payload (native_entry)
+                shuffle   partition-split frames pushed into the
+                          writer state / RSS writer (ops/shuffle.py)
+                spill     SpillFile write + re-read (runtime/memory.py)
+                fallback  row-interpreter Arrow export (spark/fallback)
+              Counts accumulate process-wide AND per query/stage: the
+              query id comes from the trace context when tracing is on
+              (the supervisor replays it on pool threads), else from
+              the runner-registered active query. Disabled
+              (conf.monitor_enabled=False) every call is one truthiness
+              check at the call site.
+
+  sampler     ResourceMonitor — a daemon thread recording MemManager
+              usage (incl. pipeline_reserved + spill pages), pool
+              occupancy, pipeline queue depths and compile-cache stats
+              into a bounded time-series ring every
+              conf.monitor_sample_ms.
+
+  exporters   prometheus_text() — Prometheus text exposition format;
+              MetricsServer serves it over stdlib http.server on
+              conf.metrics_port (daemon thread, lazily started by the
+              local runner). tools/blaze_top.py renders the same
+              registry as a live console; per-query roll-ups merge
+              into the run ledger and explain_analyze
+              ("moved X MiB, copied Y MiB (Z%)" per stage).
+
+  leak check  finish_query() — always-on telemetry (independent of
+              monitor_enabled): live pipeline streams, pipeline
+              reservations, or nonzero MemManager consumers at query
+              end emit a `resource_leak` trace event and count in the
+              run ledger (the soak-only checks of chaos_soak, promoted
+              to every query).
+"""
+
+from __future__ import annotations
+
+import http.server
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from blaze_tpu.config import conf
+from blaze_tpu.runtime import trace
+
+BOUNDARIES = ("serde", "ffi", "shuffle", "spill", "fallback")
+
+_lock = threading.Lock()
+_copied: Dict[str, int] = {b: 0 for b in BOUNDARIES}
+_moved: Dict[str, int] = {b: 0 for b in BOUNDARIES}
+_leaks_total = 0
+# runner-registered active query: attribution fallback when tracing is
+# off (the trace context stack is only populated by enabled spans)
+_active_qid: Optional[str] = None
+_queries: Dict[str, "_QueryAcct"] = {}
+
+
+class _QueryAcct:
+    """Per-query accumulator (popped at query_end into the roll-up)."""
+
+    __slots__ = ("qid", "copied", "moved", "stage_copied", "stage_moved",
+                 "t0", "spilled0", "spill_count0", "compile0")
+
+    def __init__(self, qid: str) -> None:
+        self.qid = qid
+        self.copied: Dict[str, int] = {}
+        self.moved: Dict[str, int] = {}
+        self.stage_copied: Dict[Any, int] = {}
+        self.stage_moved: Dict[Any, int] = {}
+        self.t0 = time.time()
+        self.spilled0 = 0
+        self.spill_count0 = 0
+        self.compile0: Dict[str, int] = {}
+
+
+# -- copy/byte accounting ----------------------------------------------------
+
+
+def count_copy(boundary: str, nbytes: int, moved: Optional[int] = None
+               ) -> None:
+    """Account one copy at `boundary`: `nbytes` bytes duplicated
+    (bytes_copied), `moved` bytes crossing the boundary (bytes_moved,
+    defaults to nbytes). Call sites gate on conf.monitor_enabled so the
+    disabled hot path pays one truthiness check."""
+    if not conf.monitor_enabled:
+        return
+    n = int(nbytes)
+    m = n if moved is None else int(moved)
+    if n <= 0 and m <= 0:
+        return
+    ctx = trace.current_context()
+    sid = ctx.get("stage_id")
+    with _lock:
+        _copied[boundary] = _copied.get(boundary, 0) + n
+        _moved[boundary] = _moved.get(boundary, 0) + m
+        qid = ctx.get("query_id") or _active_qid
+        q = _queries.get(qid) if qid else None
+        if q is not None:
+            q.copied[boundary] = q.copied.get(boundary, 0) + n
+            q.moved[boundary] = q.moved.get(boundary, 0) + m
+            if sid is not None:
+                q.stage_copied[sid] = q.stage_copied.get(sid, 0) + n
+                q.stage_moved[sid] = q.stage_moved.get(sid, 0) + m
+
+
+def count_move(boundary: str, nbytes: int) -> None:
+    """Bytes that crossed `boundary` without a host-side duplication
+    (bytes_moved only) — e.g. the native-ABI result payload."""
+    count_copy(boundary, 0, moved=nbytes)
+
+
+def copy_totals() -> Tuple[Dict[str, int], Dict[str, int]]:
+    """(bytes_copied, bytes_moved) per boundary, process lifetime."""
+    with _lock:
+        return dict(_copied), dict(_moved)
+
+
+def leaks_total() -> int:
+    with _lock:
+        return _leaks_total
+
+
+def reset() -> None:
+    """Clear counters + per-query state (test/bench isolation)."""
+    global _active_qid, _leaks_total
+    with _lock:
+        for b in list(_copied):
+            _copied[b] = 0
+        for b in list(_moved):
+            _moved[b] = 0
+        _queries.clear()
+        _active_qid = None
+        _leaks_total = 0
+
+
+# -- per-query lifecycle -----------------------------------------------------
+
+
+def begin_query(qid: str, manager=None) -> None:
+    """Register `qid` as the active query (attribution fallback), reset
+    the manager's peak-usage watermark, and snapshot the process
+    counters the roll-up reports as deltas. Lazily starts the metrics
+    endpoint + sampler when conf.metrics_port is set."""
+    global _active_qid
+    if conf.metrics_port:
+        ensure_started()
+    if not conf.monitor_enabled:
+        return
+    acct = _QueryAcct(qid)
+    if manager is not None:
+        manager.reset_peak()
+        acct.spilled0 = manager.spilled_bytes
+        acct.spill_count0 = manager.spill_count
+    acct.compile0 = _compile_snapshot()
+    with _lock:
+        _queries[qid] = acct
+        _active_qid = qid
+
+
+def query_end(qid: str, manager=None) -> Dict[str, int]:
+    """Pop `qid`'s accumulator; returns the flat-int roll-up merged into
+    run_info (flat ints flow into the ledger's "counters" untouched)."""
+    global _active_qid
+    with _lock:
+        acct = _queries.pop(qid, None)
+        if _active_qid == qid:
+            _active_qid = None
+    if acct is None:
+        return {}
+    roll: Dict[str, int] = {}
+    copied_total = moved_total = 0
+    for b in BOUNDARIES:
+        c = acct.copied.get(b, 0)
+        m = acct.moved.get(b, 0)
+        roll[f"bytes_copied_{b}"] = c
+        roll[f"bytes_moved_{b}"] = m
+        copied_total += c
+        moved_total += m
+    roll["bytes_copied_total"] = copied_total
+    roll["bytes_moved_total"] = moved_total
+    if manager is not None:
+        roll["peak_mem_bytes"] = max(manager.observe_peak(),
+                                     manager.peak_used)
+        roll["spill_bytes"] = manager.spilled_bytes - acct.spilled0
+        roll["spill_count"] = manager.spill_count - acct.spill_count0
+    comp = _compile_snapshot()
+    roll["compile_ms"] = round(
+        (comp.get("compile_ns", 0)
+         - acct.compile0.get("compile_ns", 0)) / 1e6)
+    for k in ("cache_hits", "cache_misses", "compile_count"):
+        roll[f"compile_{k}"] = comp.get(k, 0) - acct.compile0.get(k, 0)
+    return roll
+
+
+def stage_span_attrs(qid: str, stage_id) -> Dict[str, int]:
+    """{moved_bytes, copied_bytes} accumulated for one stage so far —
+    the local runner stamps them onto the stage span before it closes
+    (explain_analyze renders them per stage). {} when unattributed."""
+    with _lock:
+        q = _queries.get(qid)
+        if q is None:
+            return {}
+        m = q.stage_moved.get(stage_id, 0)
+        c = q.stage_copied.get(stage_id, 0)
+    if not (m or c):
+        return {}
+    return {"moved_bytes": m, "copied_bytes": c}
+
+
+def finish_query(qid: str, run_info: Dict[str, Any], manager=None) -> None:
+    """Query-end hook: merge the roll-up into run_info and run the
+    always-on leak check (independent of conf.monitor_enabled): live
+    pipeline streams, pipeline reservations, or nonzero MemManager
+    consumers at query end are a `resource_leak` trace event and a
+    run-ledger counter — the chaos-soak checks, promoted to every
+    query."""
+    global _leaks_total
+    if conf.monitor_enabled:
+        run_info.update(query_end(qid, manager))
+    leaks: List[str] = []
+    live = run_info.get("pipeline_live_streams", 0)
+    if live:
+        leaks.append(f"pipeline_live_streams={live}")
+    if manager is not None:
+        if manager.pipeline_reserved:
+            leaks.append(
+                f"pipeline_reserved={manager.pipeline_reserved}")
+        held = [(c.name, c.mem_used())
+                for c in manager._consumers_snapshot() if c.mem_used() > 0]
+        if held:
+            leaks.append("consumers=" + ",".join(
+                f"{name}:{used}" for name, used in held))
+    run_info["resource_leaks"] = len(leaks)
+    if leaks:
+        with _lock:
+            _leaks_total += len(leaks)
+        trace.event("resource_leak", query_id=qid, leaks="; ".join(leaks))
+
+
+def _compile_snapshot() -> Dict[str, int]:
+    from blaze_tpu.runtime import compile_service
+
+    return compile_service.TELEMETRY.snapshot()
+
+
+def running_queries() -> List[Dict[str, Any]]:
+    """Live queries (id, seconds running, bytes so far) for blaze_top."""
+    now = time.time()
+    with _lock:
+        return [{"query_id": q.qid,
+                 "seconds": round(now - q.t0, 1),
+                 "bytes_copied": sum(q.copied.values()),
+                 "bytes_moved": sum(q.moved.values())}
+                for q in _queries.values()]
+
+
+# -- background sampler ------------------------------------------------------
+
+
+class ResourceMonitor:
+    """Background sampler recording engine gauges into a bounded
+    time-series ring (deque maxlen: oldest samples drop first). Explicit
+    start()/stop(); sample_now() is callable without the thread (tests,
+    blaze_top --once)."""
+
+    def __init__(self, capacity: Optional[int] = None,
+                 sample_ms: Optional[int] = None, manager=None) -> None:
+        self._cap = int(capacity or conf.monitor_ring_samples)
+        self._sample_ms = sample_ms
+        self._manager = manager
+        self._ring: deque = deque(maxlen=max(self._cap, 1))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def sample_now(self) -> Dict[str, Any]:
+        from blaze_tpu.runtime import faults, memory, pipeline, supervisor
+
+        mgr = self._manager or memory.get_manager()
+        used = mgr.observe_peak()
+        depths = pipeline.queue_depths()
+        comp = _compile_snapshot()
+        copied, moved = copy_totals()
+        s = {
+            "ts": time.time(),
+            "mem_used": used,
+            "mem_total": mgr.total,
+            "mem_peak": mgr.peak_used,
+            "pipeline_reserved": mgr.pipeline_reserved,
+            "spill_pages": mgr.spill_pages_pending(),
+            "host_spill_bytes": mgr.host_spill_bytes,
+            "spilled_bytes": mgr.spilled_bytes,
+            "pipeline_live_streams": pipeline.live_streams(),
+            "pipeline_queue_depth": sum(depths),
+            "pipeline_queue_streams": len(depths),
+            "supervisor_active_tasks": supervisor.active_tasks(),
+            "io_pool_width": max(1, int(conf.io_threads)),
+            "task_pool_width": max(1, int(conf.max_concurrent_tasks)),
+            "queries_running": len(running_queries()),
+            "bytes_copied": sum(copied.values()),
+            "bytes_moved": sum(moved.values()),
+            "compile_cache_hits": comp.get("cache_hits", 0),
+            "compile_cache_misses": comp.get("cache_misses", 0),
+            "compile_ms": round(comp.get("compile_ns", 0) / 1e6),
+            "breaker_trips": faults.TELEMETRY.snapshot().get(
+                "breaker.trips", 0),
+        }
+        self._ring.append(s)
+        return s
+
+    def ring(self) -> List[Dict[str, Any]]:
+        return list(self._ring)
+
+    def start(self) -> "ResourceMonitor":
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="blz-monitor", daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.sample_now()
+            except Exception:  # noqa: BLE001 — the sampler must never die
+                pass
+            ms = self._sample_ms
+            if ms is None:
+                ms = conf.monitor_sample_ms
+            self._stop.wait(max(int(ms), 1) / 1000.0)
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+            self._thread = None
+
+
+# -- Prometheus exporter -----------------------------------------------------
+
+
+def _prom_name(raw: str) -> str:
+    """Sanitize to the metric-name grammar [a-zA-Z_:][a-zA-Z0-9_:]*."""
+    out = [ch if (ch.isalnum() and ch.isascii()) or ch in "_:" else "_"
+           for ch in raw]
+    name = "".join(out) or "_"
+    if name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def _prom_escape(v: Any) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def prometheus_text() -> str:
+    """The whole registry in Prometheus text exposition format
+    (# HELP/# TYPE headers, one sample per line, trailing newline)."""
+    from blaze_tpu.runtime import compile_service, faults, memory, pipeline
+    from blaze_tpu.runtime import supervisor
+
+    lines: List[str] = []
+
+    def emit(name, mtype, help_text, samples):
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {mtype}")
+        for labels, value in samples:
+            lab = ""
+            if labels:
+                lab = "{" + ",".join(
+                    f'{k}="{_prom_escape(v)}"'
+                    for k, v in sorted(labels.items())) + "}"
+            lines.append(f"{name}{lab} {value}")
+
+    copied, moved = copy_totals()
+    emit("blaze_bytes_copied_total", "counter",
+         "Bytes duplicated at each copy boundary",
+         [({"boundary": b}, copied.get(b, 0)) for b in BOUNDARIES])
+    emit("blaze_bytes_moved_total", "counter",
+         "Bytes crossing each copy boundary",
+         [({"boundary": b}, moved.get(b, 0)) for b in BOUNDARIES])
+    emit("blaze_resource_leaks_total", "counter",
+         "Queries that ended with leaked streams/reservations/consumers",
+         [({}, leaks_total())])
+
+    mgr = memory.get_manager()
+    emit("blaze_mem_used_bytes", "gauge",
+         "MemManager usage (consumers + spill pages + pipeline_reserved)",
+         [({}, mgr.mem_used())])
+    emit("blaze_mem_budget_bytes", "gauge", "MemManager budget",
+         [({}, mgr.total)])
+    emit("blaze_mem_peak_bytes", "gauge",
+         "Peak MemManager usage since the last query began",
+         [({}, mgr.peak_used)])
+    emit("blaze_mem_pipeline_reserved_bytes", "gauge",
+         "Bytes held by in-flight pipelined batches",
+         [({}, mgr.pipeline_reserved)])
+    emit("blaze_spill_pages_bytes", "gauge",
+         "Spill-file pages buffered but not yet synced",
+         [({}, mgr.spill_pages_pending())])
+    emit("blaze_spilled_bytes_total", "counter",
+         "Bytes freed by consumer spills", [({}, mgr.spilled_bytes)])
+    emit("blaze_spill_count_total", "counter", "Consumer spill operations",
+         [({}, mgr.spill_count)])
+
+    depths = pipeline.queue_depths()
+    emit("blaze_pipeline_live_streams", "gauge",
+         "Prefetch streams/sinks created but not yet finalized",
+         [({}, pipeline.live_streams())])
+    emit("blaze_pipeline_queue_depth", "gauge",
+         "Items queued across live prefetch streams", [({}, sum(depths))])
+    emit("blaze_supervisor_active_tasks", "gauge",
+         "Task attempts currently executing", [({}, supervisor.active_tasks())])
+    emit("blaze_queries_running", "gauge", "Queries currently executing",
+         [({}, len(running_queries()))])
+
+    for prefix, help_text, ms in (
+            ("blaze_pipeline", "pipeline telemetry", pipeline.TELEMETRY),
+            ("blaze_faults", "resilience telemetry", faults.TELEMETRY),
+            ("blaze_compile", "compile-service telemetry",
+             compile_service.TELEMETRY)):
+        for k, v in sorted(ms.snapshot().items()):
+            if not isinstance(v, (int, float)):
+                continue
+            emit(_prom_name(f"{prefix}_{k}"), "gauge",
+                 f"{help_text}: {k}", [({}, v)])
+
+    for name, snap in sorted(trace.histograms_snapshot().items()):
+        base = _prom_name(f"blaze_hist_{name}")
+        h = trace.histogram(name)
+        lines.append(f"# HELP {base} engine histogram {name}")
+        lines.append(f"# TYPE {base} summary")
+        for q, p in ((0.5, 50), (0.95, 95), (0.99, 99)):
+            lines.append(f'{base}{{quantile="{q}"}} '
+                         f"{h.percentile(p) or 0}")
+        lines.append(f"{base}_sum {snap['total']}")
+        lines.append(f"{base}_count {snap['count']}")
+
+    return "\n".join(lines) + "\n"
+
+
+class MetricsServer:
+    """Prometheus scrape endpoint on a stdlib http.server daemon thread.
+    GET /metrics returns prometheus_text(); port 0 binds an ephemeral
+    port (tests). close() shuts the socket down and joins the thread."""
+
+    def __init__(self, port: int, host: str = "0.0.0.0") -> None:
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — http.server contract
+                if self.path.split("?")[0] not in ("/metrics", "/"):
+                    self.send_error(404)
+                    return
+                try:
+                    body = prometheus_text().encode()
+                except Exception as e:  # noqa: BLE001 — scrape, not crash
+                    self.send_error(500, str(e)[:100])
+                    return
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # silence per-scrape stderr
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer((host, port),
+                                                      _Handler)
+        self.port = int(self._httpd.server_address[1])
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="blz-metrics",
+            daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+
+# -- global endpoint + sampler (lazily started by the local runner) ----------
+
+_global_lock = threading.Lock()
+_server: Optional[MetricsServer] = None
+_sampler: Optional[ResourceMonitor] = None
+
+
+def ensure_started() -> Optional[MetricsServer]:
+    """Idempotent: serve /metrics on conf.metrics_port (restarting when
+    the port changed) and run the background sampler. No-op when
+    conf.metrics_port is 0."""
+    global _server, _sampler
+    port = int(conf.metrics_port or 0)
+    with _global_lock:
+        if port <= 0:
+            return _server
+        if _server is not None and _server.port != port:
+            _server.close()
+            _server = None
+        if _server is None:
+            _server = MetricsServer(port)
+        if _sampler is None and conf.monitor_sample_ms > 0:
+            _sampler = ResourceMonitor().start()
+        return _server
+
+
+def sampler() -> Optional[ResourceMonitor]:
+    return _sampler
+
+
+def shutdown() -> None:
+    """Stop the global endpoint + sampler (tests / embedder teardown)."""
+    global _server, _sampler
+    with _global_lock:
+        if _server is not None:
+            _server.close()
+            _server = None
+        if _sampler is not None:
+            _sampler.stop()
+            _sampler = None

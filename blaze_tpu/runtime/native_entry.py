@@ -14,6 +14,7 @@ import struct
 import threading
 
 from blaze_tpu.columnar import serde
+from blaze_tpu.config import conf
 from blaze_tpu.runtime import faults
 from blaze_tpu.runtime.executor import execute_plan
 from blaze_tpu.ops.base import ExecContext
@@ -102,6 +103,12 @@ def run_task_serialized(task_def: bytes) -> bytes:
         out = bytearray()
         for batch in execute_plan(plan, ctx):
             out += serde.serialize_batch(batch)
+        if conf.monitor_enabled:
+            from blaze_tpu.runtime import monitor
+
+            # result payload crossing the C ABI — the frames inside it
+            # were already counted as serde copies when built
+            monitor.count_move("ffi", len(out))
         return bytes(out)
     except Exception as e:  # noqa: BLE001 — classified for the C ABI
         # the faults taxonomy must cross the boundary labelled: the C++
@@ -159,6 +166,10 @@ def run_task_arrow_payload(task_def: bytes) -> bytes:
         out = bytearray(arrow_payload_header(plan.schema))
         for batch in execute_plan(plan, ctx):
             out += serde.serialize_batch(batch)
+        if conf.monitor_enabled:
+            from blaze_tpu.runtime import monitor
+
+            monitor.count_move("ffi", len(out))
         return bytes(out)
     except Exception as e:  # noqa: BLE001 — classified for the C ABI
         raise faults.ensure_classified(e) from e

@@ -61,6 +61,7 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Iterable, Iterator, Optional
 
@@ -121,18 +122,25 @@ def reset_pool() -> None:
 
 _live_lock = threading.Lock()
 _live = 0
+# weak registry of the live objects themselves so the monitor sampler
+# can report queue depths without adding state to the hot path
+_live_objs: "weakref.WeakSet" = weakref.WeakSet()
 
 
-def _live_inc() -> None:
+def _live_inc(obj=None) -> None:
     global _live
     with _live_lock:
         _live += 1
+        if obj is not None:
+            _live_objs.add(obj)
 
 
-def _live_dec() -> None:
+def _live_dec(obj=None) -> None:
     global _live
     with _live_lock:
         _live -= 1
+        if obj is not None:
+            _live_objs.discard(obj)
 
 
 def live_streams() -> int:
@@ -140,6 +148,21 @@ def live_streams() -> int:
     (chaos_soak's leaked-thread/reservation check)."""
     with _live_lock:
         return _live
+
+
+def queue_depths() -> list:
+    """Current queue depth of each live prefetch stream — a monitor
+    sampler gauge. Depths are read without the stream locks: a torn
+    read is fine for a gauge, and taking per-stream locks from the
+    sampler thread would invert lock order with producers."""
+    with _live_lock:
+        objs = list(_live_objs)
+    out = []
+    for o in objs:
+        buf = getattr(o, "_buf", None)
+        if buf is not None:
+            out.append(len(buf))
+    return out
 
 
 # -- context snapshot --------------------------------------------------------
@@ -234,7 +257,7 @@ class PrefetchStream:
         self._items = 0
         self._max_depth = 0
         TELEMETRY.add("streams_opened", 1)
-        _live_inc()
+        _live_inc(self)
         with self._lock:
             self._maybe_pump_locked()
 
@@ -400,7 +423,7 @@ class PrefetchStream:
             self._inflight = 0
         if self._manager is not None and drained:
             self._manager.release_pipeline(drained)
-        _live_dec()
+        _live_dec(self)
         TELEMETRY.add("streams_closed", 1)
         self._emit_stats()
 
@@ -514,7 +537,7 @@ class Sink:
         self._items = 0
         if not self._inline:
             TELEMETRY.add("sinks_opened", 1)
-            _live_inc()
+            _live_inc(self)
 
     def submit(self, item, nbytes: int = 0) -> None:
         if self._error is not None:
@@ -596,7 +619,7 @@ class Sink:
             self._inflight = 0
         if self._manager is not None and drained:
             self._manager.release_pipeline(drained)
-        _live_dec()
+        _live_dec(self)
         TELEMETRY.add("sinks_closed", 1)
 
     def close(self) -> None:

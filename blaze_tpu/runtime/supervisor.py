@@ -74,6 +74,22 @@ from blaze_tpu.runtime import faults, trace
 # owning it (read by fallback builders to inherit the commit gate).
 _current = threading.local()
 
+# task attempts currently executing across every Supervisor instance —
+# a pool-occupancy gauge for the monitor sampler / Prometheus endpoint
+_active_lock = threading.Lock()
+_active = 0
+
+
+def _active_delta(d: int) -> None:
+    global _active
+    with _active_lock:
+        _active += d
+
+
+def active_tasks() -> int:
+    with _active_lock:
+        return _active
+
 
 def current_kill_event() -> Optional[threading.Event]:
     att = getattr(_current, "attempt", None)
@@ -508,6 +524,7 @@ class Supervisor:
 
         prev_task = getattr(_current, "task", None)
         _current.task = task
+        _active_delta(1)
         try:
             # context on the WORKER thread so the executor's retry/ladder
             # events (emitted between attempts, outside _attempt_once's
@@ -523,6 +540,7 @@ class Supervisor:
             if not task.finish("err", e):
                 pass  # a twin already finished; its outcome stands
         finally:
+            _active_delta(-1)
             _current.task = prev_task
         task.done.wait()
         kind, value = task.outcome  # type: ignore[misc]
@@ -651,11 +669,15 @@ class Supervisor:
         # pushing, the query/stage ids are already on this thread's stack
         with trace.context(task_id=spec.what):
             started = time.monotonic()
-            value = run_task_with_resilience(
-                attempt, what=spec.what,
-                run_info=self.run_info, fallback=spec.fallback_fn,
-                ctx=ctx, deadline=self.deadline(),
-                on_error=self.breaker.note_failure)
+            _active_delta(1)
+            try:
+                value = run_task_with_resilience(
+                    attempt, what=spec.what,
+                    run_info=self.run_info, fallback=spec.fallback_fn,
+                    ctx=ctx, deadline=self.deadline(),
+                    on_error=self.breaker.note_failure)
+            finally:
+                _active_delta(-1)
             trace.record_value("task_latency_us",
                                int((time.monotonic() - started) * 1e6))
             return value

@@ -504,6 +504,12 @@ def explain_analyze(root, run_info: Optional[dict] = None,
                     f"{sp.get('dur', 0) / 1e6:.1f}ms tasks={a.get('tasks', 1)}")
             if a.get("bytes"):
                 head += f" bytes={human_bytes(a['bytes'])}"
+            mv, cp = a.get("moved_bytes", 0), a.get("copied_bytes", 0)
+            if mv or cp:
+                # copy ratio per stage: the zero-copy roadmap's target
+                pct = round(100.0 * cp / mv) if mv else 0
+                head += (f" moved {human_bytes(mv)}, copied "
+                         f"{human_bytes(cp)} ({pct}%)")
             notes = _stage_annotations(
                 [r for r in recs if r["type"] == "event"
                  and r.get("stage_id") == sid
@@ -563,7 +569,9 @@ def build_run_record(query_id: str, run_info: Optional[dict] = None,
                        "transport": a.get("transport"),
                        "ms": round(sp.get("dur", 0) / 1e6, 3),
                        "tasks": a.get("tasks", 1),
-                       "bytes": a.get("bytes", 0)})
+                       "bytes": a.get("bytes", 0),
+                       "moved_bytes": a.get("moved_bytes", 0),
+                       "copied_bytes": a.get("copied_bytes", 0)})
     event_counts: Dict[str, int] = {}
     for r in recs:
         if r["type"] == "event" and r["kind"] in _RESILIENCE_EVENT_KINDS:

@@ -417,7 +417,15 @@ def export_iterator(plan: SparkPlan, partition: int,
     from blaze_tpu.spark.converters import bridge_schema
 
     df = _execute(plan, partition, num_partitions)
-    yield _to_arrow(df, bridge_schema(plan))
+    rb = _to_arrow(df, bridge_schema(plan))
+    from blaze_tpu.config import conf as _conf
+
+    if _conf.monitor_enabled:
+        from blaze_tpu.runtime import monitor
+
+        # row-interpreter result exported as a fresh Arrow batch
+        monitor.count_copy("fallback", rb.nbytes)
+    yield rb
 
 
 _ARROW_TYPES = {

@@ -27,7 +27,7 @@ from blaze_tpu.ops.base import ExecContext
 from blaze_tpu.ops.common import concat_batches
 from blaze_tpu.plan import decode_plan
 from blaze_tpu.plan import plan_pb2 as pb
-from blaze_tpu.runtime import artifacts, faults, resources, trace
+from blaze_tpu.runtime import artifacts, faults, monitor, resources, trace
 from blaze_tpu.runtime import supervisor as supervisor_mod
 from blaze_tpu.runtime.executor import execute_plan, run_task_with_resilience
 from blaze_tpu.runtime.supervisor import Supervisor, TaskSpec
@@ -68,6 +68,13 @@ def run_plan(root: SparkPlan, num_partitions: int = 4,
         run_info = {}
     qid = run_info.get("query_id") or trace.new_query_id()
     run_info["query_id"] = qid
+    from blaze_tpu.runtime import memory
+
+    mgr = memory.get_manager()
+    # resource accounting: register the active query (copy-boundary
+    # attribution), reset the memory high-water mark, and lazily start
+    # the Prometheus endpoint + sampler when conf.metrics_port is set
+    monitor.begin_query(qid, mgr)
     try:
         with profiled_scope("run_plan"):
             with trace.span("query", query_id=qid,
@@ -76,6 +83,10 @@ def run_plan(root: SparkPlan, num_partitions: int = 4,
                 return _run_plan_inner(root, num_partitions, work_dir,
                                        mesh_exchange, mesh_quota, run_info)
     finally:
+        # roll-ups (bytes by boundary, peak memory, spill, compile ms)
+        # merged into run_info BEFORE the ledger export, plus the
+        # always-on leak check (resource_leak event + counter)
+        monitor.finish_query(qid, run_info, mgr)
         # export even on failure: a failed query's trace is the one you
         # most want to read
         if conf.trace_enabled and conf.trace_export_dir:
@@ -182,7 +193,10 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
                                 stats.get("bytes", 0)
                             run_info["mesh_stages"] += 1
                             sp.set(transport="mesh",
-                                   bytes=stats.get("bytes", 0))
+                                   bytes=stats.get("bytes", 0),
+                                   **monitor.stage_span_attrs(
+                                       run_info["query_id"],
+                                       stage.stage_id))
                             continue
                     logical = _run_shuffle_stage(stage, stages, shuffle_mgr,
                                                  sup, run_info)
@@ -191,17 +205,23 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
                     # transport-independent
                     shuffle_bytes[stage.stage_id] = logical
                     run_info["file_stages"] += 1
-                    sp.set(transport="file", bytes=logical)
+                    sp.set(transport="file", bytes=logical,
+                           **monitor.stage_span_attrs(
+                               run_info["query_id"], stage.stage_id))
             elif stage.kind == "broadcast":
                 with trace.span("stage", stage_id=stage.stage_id,
-                                stage_kind="broadcast", tasks=1):
+                                stage_kind="broadcast", tasks=1) as sp:
                     _run_broadcast_stage(stage, stages, sup, run_info)
+                    sp.set(**monitor.stage_span_attrs(
+                        run_info["query_id"], stage.stage_id))
                 run_info["broadcast_stages"] += 1
             else:
                 parts = _input_tasks(stage, stages, fallback=num_partitions)
                 with trace.span("stage", stage_id=stage.stage_id,
-                                stage_kind="result", tasks=parts):
+                                stage_kind="result", tasks=parts) as sp:
                     out = _run_result_stage(stage, parts, sup, run_info)
+                    sp.set(**monitor.stage_span_attrs(
+                        run_info["query_id"], stage.stage_id))
                 return _merge_fallback_root_sort(root, out, parts)
         raise AssertionError("no result stage produced")
     finally:

@@ -1,0 +1,299 @@
+"""Perf-regression gate + observability acceptance artifact.
+
+Two modes over the same TPC-DS-style catalogue (the validator queries
+every subsystem gate has used since FAULTS_r06):
+
+  default / --update    `make check-perf`: run the catalogue with
+                        tracing + resource accounting on, collect one
+                        record per query from the run ledger (duration,
+                        bytes_copied/moved by boundary, peak memory,
+                        spill), and compare against the committed
+                        PERF_BASELINE.json. Durations gate loosely
+                        (shared CI hosts are noisy: ratio x2.5 + 2s
+                        grace); copy counters gate tightly (x1.25 +
+                        64KiB) — byte counts are deterministic for a
+                        fixed workload, so a copy regression fails
+                        loudly while timing noise doesn't.
+                        --update rewrites the baseline instead.
+
+  --obs                 `make check-obs`: the monitor acceptance sweep —
+                        catalogue A/B with conf.monitor_enabled off vs
+                        on (sampler thread + live Prometheus endpoint
+                        scraped MID-QUERY and format-checked), one chaos
+                        cell under the monitor, and a leak count that
+                        must be 0. Emits OBS_r10.json.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/perf_baseline.py --update
+    JAX_PLATFORMS=cpu python tools/perf_baseline.py
+    JAX_PLATFORMS=cpu python tools/perf_baseline.py --obs \
+        --json-out OBS_r10.json
+"""
+
+import argparse
+import json
+import os
+import re
+import shutil
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+QUERIES = [  # same catalogue as chaos_soak/trace_report
+    ("q1_scan_filter_project", "bhj"),
+    ("q2_q06_core_agg", "bhj"),
+    ("q3_join_agg_sort", "smj"),
+]
+
+# gate thresholds (see module docstring for the asymmetry rationale)
+TIME_RATIO = 2.5
+TIME_GRACE_S = 2.0
+COPY_RATIO = 1.25
+COPY_GRACE_BYTES = 64 << 10
+
+COPY_KEYS = ("bytes_copied_serde", "bytes_copied_ffi",
+             "bytes_copied_shuffle", "bytes_copied_spill",
+             "bytes_copied_fallback", "bytes_copied_total",
+             "bytes_moved_total")
+
+
+def _catalogue_records(tables, collect=True):
+    """One timed catalogue pass; per-query {duration_s, <copy keys>,
+    peak_mem_bytes, spill_bytes, resource_leaks} when collect."""
+    from blaze_tpu.spark import validator
+    from blaze_tpu.spark.local_runner import run_plan
+
+    paths, frames = tables
+    out = {}
+    total = 0.0
+    for query, mode in QUERIES:
+        plan, _ = validator.QUERIES[query](paths, frames, mode)
+        info = {}
+        t0 = time.perf_counter()
+        run_plan(plan, num_partitions=4, mesh_exchange="off", run_info=info)
+        dt = time.perf_counter() - t0
+        total += dt
+        if collect:
+            rec = {"duration_s": round(dt, 3)}
+            for k in COPY_KEYS + ("peak_mem_bytes", "spill_bytes",
+                                  "resource_leaks"):
+                rec[k] = int(info.get(k, 0))
+            out[query] = rec
+    return out, round(total, 3)
+
+
+def _compare(baseline, current):
+    problems = []
+    for query, base in baseline["queries"].items():
+        cur = current.get(query)
+        if cur is None:
+            problems.append(f"{query}: missing from current run")
+            continue
+        bt, ct = base["duration_s"], cur["duration_s"]
+        if ct > bt * TIME_RATIO + TIME_GRACE_S:
+            problems.append(
+                f"{query}: duration {ct:.3f}s vs baseline {bt:.3f}s "
+                f"(> x{TIME_RATIO} + {TIME_GRACE_S}s)")
+        for k in COPY_KEYS:
+            bv, cv = base.get(k, 0), cur.get(k, 0)
+            if cv > bv * COPY_RATIO + COPY_GRACE_BYTES:
+                problems.append(
+                    f"{query}: {k} {cv} vs baseline {bv} "
+                    f"(> x{COPY_RATIO} + {COPY_GRACE_BYTES}B) — a copy "
+                    "regression; rerun with --update only if intended")
+        if cur.get("resource_leaks", 0):
+            problems.append(
+                f"{query}: {cur['resource_leaks']} resource leak(s)")
+    return problems
+
+
+def run_perf(args) -> int:
+    from blaze_tpu.config import conf
+    from blaze_tpu.spark import validator
+
+    baseline_path = os.path.join(REPO, args.baseline)
+    saved = (conf.trace_enabled, conf.monitor_enabled)
+    tmp = tempfile.mkdtemp(prefix="perf_baseline_")
+    try:
+        conf.update(trace_enabled=True, monitor_enabled=True)
+        tables = validator.generate_tables(tmp, rows=args.rows)
+        _catalogue_records(tables, collect=False)  # warm jit caches
+        queries, total_s = _catalogue_records(tables)
+    finally:
+        conf.trace_enabled, conf.monitor_enabled = saved
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    current = {"rows": args.rows, "catalogue_s": total_s,
+               "queries": queries}
+    if args.update:
+        with open(baseline_path, "w") as f:
+            json.dump(current, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"[perf] baseline written: {baseline_path} "
+              f"(catalogue {total_s}s)")
+        return 0
+    if not os.path.exists(baseline_path):
+        print(f"[perf] no baseline at {baseline_path}; run with --update",
+              file=sys.stderr)
+        return 1
+    with open(baseline_path) as f:
+        baseline = json.load(f)
+    if baseline.get("rows") != args.rows:
+        print(f"[perf] baseline rows={baseline.get('rows')} != "
+              f"--rows {args.rows}; not comparable", file=sys.stderr)
+        return 1
+    problems = _compare(baseline, queries)
+    for q, rec in sorted(queries.items()):
+        print(f"[perf] {q}: {rec['duration_s']}s "
+              f"copied={rec['bytes_copied_total']} "
+              f"moved={rec['bytes_moved_total']} "
+              f"peak={rec['peak_mem_bytes']}")
+    if problems:
+        for p in problems:
+            print(f"[perf] GATE FAILED: {p}", file=sys.stderr)
+        return 1
+    print(f"[perf] OK: catalogue {total_s}s vs baseline "
+          f"{baseline['catalogue_s']}s, copy counters within "
+          f"x{COPY_RATIO}")
+    return 0
+
+
+# -- observability acceptance (--obs) ----------------------------------------
+
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? -?[0-9eE+.na-]+$")
+
+
+def _scrape_check(port: int) -> dict:
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+    lines = body.splitlines()
+    bad = [l for l in lines
+           if l and not l.startswith("#") and not _PROM_LINE.match(l)]
+    return {"lines": len(lines), "format_errors": bad[:5],
+            "has_copy_metric": "blaze_bytes_copied_total" in body}
+
+
+def run_obs(args) -> int:
+    from blaze_tpu.config import conf
+    from blaze_tpu.runtime import monitor
+    from blaze_tpu.spark import validator
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import chaos_soak
+
+    out = {"rows": args.rows}
+    saved = (conf.trace_enabled, conf.monitor_enabled, conf.metrics_port,
+             dict(conf.fault_injection_spec or {}))
+    tmp = tempfile.mkdtemp(prefix="obs_gate_")
+    try:
+        conf.update(trace_enabled=True)
+        tables = validator.generate_tables(tmp, rows=args.rows)
+
+        conf.monitor_enabled = True
+        _catalogue_records(tables, collect=False)  # warm jit caches
+        # A/B: accounting off vs on (sampler + endpoint live during "on")
+        conf.monitor_enabled = False
+        _, t_off = _catalogue_records(tables, collect=False)
+        conf.monitor_enabled = True
+        srv = monitor.MetricsServer(0)
+        sampler = monitor.ResourceMonitor(sample_ms=50).start()
+        scrape = {}
+
+        def scrape_mid_query():
+            # endpoint must serve a valid payload DURING a live query
+            time.sleep(0.3)
+            try:
+                scrape.update(_scrape_check(srv.port))
+            except Exception as e:  # noqa: BLE001 — recorded, not raised
+                scrape["error"] = repr(e)
+
+        th = threading.Thread(target=scrape_mid_query, daemon=True)
+        th.start()
+        queries, t_on = _catalogue_records(tables)
+        th.join(timeout=30)
+        sampler.stop()
+        ring = sampler.ring()
+        srv.close()
+
+        out["catalogue_monitor_off_s"] = t_off
+        out["catalogue_monitor_on_s"] = t_on
+        out["overhead_pct"] = round(100.0 * (t_on - t_off) / t_off, 2)
+        out["scrape_during_query"] = scrape
+        out["sampler_samples"] = len(ring)
+        out["copy_totals_by_query"] = {
+            q: {k: rec[k] for k in COPY_KEYS} for q, rec in queries.items()}
+        out["leaks"] = sum(r.get("resource_leaks", 0)
+                           for r in queries.values())
+
+        # one chaos cell with the monitor live: recovery machinery and
+        # accounting must coexist (injected faults, retries, fallbacks)
+        cell = chaos_soak._run_cell(
+            tables, "q2_q06_core_agg", "bhj",
+            {"seed": 7, "points": {"serde.decode": {"nth": 1, "kind": "io",
+                                                    "times": 2}}})
+        out["chaos_cell"] = cell
+    finally:
+        (conf.trace_enabled, conf.monitor_enabled, conf.metrics_port,
+         spec) = saved
+        conf.fault_injection_spec = spec
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    problems = []
+    if out["leaks"]:
+        problems.append(f"{out['leaks']} resource leak(s) on a clean "
+                        "catalogue")
+    if scrape.get("error") or scrape.get("format_errors"):
+        problems.append(f"prometheus scrape invalid: {scrape}")
+    if not scrape.get("has_copy_metric"):
+        problems.append("scrape served no blaze_bytes_copied_total")
+    if out["chaos_cell"].get("outcome") not in ("recovered", "no_fire"):
+        problems.append(f"chaos cell outcome: {out['chaos_cell']}")
+    if out["chaos_cell"].get("mem_leaked") or \
+            out["chaos_cell"].get("pipeline_leaked"):
+        problems.append("chaos cell leaked memory/streams under monitor")
+    # timing gate mirrors trace_report's: noise-tolerant, catches a
+    # pathological accounting cost (the per-frame cost is one dict add)
+    if t_on > t_off * 1.5 + 1.0:
+        problems.append(
+            f"monitor-on catalogue {t_on}s vs off {t_off}s (> x1.5 + 1s)")
+    out["problems"] = problems
+
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(out, f, indent=2, sort_keys=True, default=str)
+            f.write("\n")
+    print(json.dumps({k: out[k] for k in
+                      ("catalogue_monitor_off_s", "catalogue_monitor_on_s",
+                       "overhead_pct", "sampler_samples", "leaks")},
+                     indent=2))
+    if problems:
+        for p in problems:
+            print(f"[obs] GATE FAILED: {p}", file=sys.stderr)
+        return 1
+    print("[obs] OK")
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=8000)
+    ap.add_argument("--baseline", default="PERF_BASELINE.json")
+    ap.add_argument("--update", action="store_true",
+                    help="rewrite the baseline from this run")
+    ap.add_argument("--obs", action="store_true",
+                    help="observability acceptance sweep (OBS artifact)")
+    ap.add_argument("--json-out", default=None)
+    args = ap.parse_args()
+    if args.obs:
+        return run_obs(args)
+    return run_perf(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

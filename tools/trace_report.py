@@ -108,9 +108,55 @@ def summarize(trace_dir):
             lines.append(f"  {name}: n={h['count']} p50<={h['p50']} "
                          f"p95<={h['p95']} p99<={h['p99']} max={h['max']}")
 
+    # resource roll-ups (monitor.py counters the runner merges into each
+    # ledger line): copy traffic by boundary, memory/spill high-water
+    counters = [e.get("counters") or {} for e in entries]
+
+    def csum(key):
+        return sum(int(c.get(key, 0)) for c in counters)
+
+    copied = {b: csum(f"bytes_copied_{b}")
+              for b in ("serde", "ffi", "shuffle", "spill", "fallback")}
+    if any(copied.values()) or csum("bytes_moved_total"):
+        lines.append("-- resource roll-up (all queries) --")
+        moved = csum("bytes_moved_total")
+        total = csum("bytes_copied_total")
+        pct = round(100.0 * total / moved) if moved else 0
+        lines.append(f"  moved {human_bytes(moved)}, copied "
+                     f"{human_bytes(total)} ({pct}%)")
+        lines.append("  copied by boundary: " + "  ".join(
+            f"{b}={human_bytes(n)}" for b, n in copied.items() if n))
+        peak = max((int(c.get("peak_mem_bytes", 0)) for c in counters),
+                   default=0)
+        lines.append(f"  peak_mem={human_bytes(peak)} "
+                     f"spill={human_bytes(csum('spill_bytes'))} "
+                     f"({csum('spill_count')} spills) "
+                     f"compile={csum('compile_ms')}ms")
+    leaks = csum("resource_leaks")
+    if leaks:
+        lines.append(f"  RESOURCE LEAKS: {leaks} across "
+                     f"{sum(1 for c in counters if c.get('resource_leaks'))}"
+                     " queries")
+
     dropped = sum(e.get("dropped_events") or 0 for e in entries)
     lines.append(f"dropped_events: {dropped}")
     print("\n".join(lines))
+    return 0
+
+
+def prom_snapshot(path):
+    """Dump this process's Prometheus registry to a file (or '-' for
+    stdout) — the scrape payload without standing up the HTTP server."""
+    from blaze_tpu.runtime import monitor
+
+    text = monitor.prometheus_text()
+    if path == "-":
+        sys.stdout.write(text)
+    else:
+        with open(path, "w") as f:
+            f.write(text)
+        print(f"prometheus snapshot -> {path} "
+              f"({len(text.splitlines())} lines)")
     return 0
 
 
@@ -236,7 +282,12 @@ def main():
     ap.add_argument("--rows", type=int, default=8000)
     ap.add_argument("--keep-trace-dir", action="store_true")
     ap.add_argument("--json-out", default="TRACE_r08.json")
+    ap.add_argument("--prom-snapshot", default=None, metavar="PATH",
+                    help="write this process's Prometheus registry dump "
+                         "to PATH ('-' for stdout) and exit")
     args = ap.parse_args()
+    if args.prom_snapshot:
+        return prom_snapshot(args.prom_snapshot)
     if args.bench:
         return bench(args)
     if not args.trace_dir:
