@@ -9,9 +9,9 @@ PYENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
 .PHONY: check check-fast check-faults check-supervisor check-trace \
 	check-pipeline check-pipeline-soak check-perf check-perf-update \
-	check-obs test test-fast validate validate-fast warm
+	check-obs check-history test test-fast validate validate-fast warm
 
-check: test validate check-perf
+check: test validate check-perf check-history
 	@echo "CHECK OK — safe to commit"
 
 # The every-commit bar (< 5 min): full unit suite minus the two
@@ -96,6 +96,16 @@ check-perf-update:
 # leaks. Emits OBS_r10.json.
 check-obs:
 	$(PYENV) python tools/perf_baseline.py --obs --json-out OBS_r10.json
+
+# History gate: the catalogue recorded twice into a fresh history
+# store, then a third pass with one 400ms serde.encode stall injected
+# into q2 — the cross-run regression detector must flag the slowed
+# stage with zero false positives on unperturbed stages, and the
+# history-on catalogue must stay within noise of history-off. Emits
+# HISTORY_r11.json.
+check-history:
+	$(PYENV) python tools/history_report.py --gate \
+	  --json-out HISTORY_r11.json
 
 # Pre-warm the persistent compile caches (runtime/compile_service):
 # replays the shape manifest + the TPC-DS catalogue into the XLA cache.

@@ -178,6 +178,25 @@ class BlazeConf:
     # bounded sample-ring capacity (deque maxlen — oldest samples drop
     # first; 2048 x 200ms ≈ the last ~7 minutes)
     monitor_ring_samples: int = 2048
+    # -- query history store (runtime/history.py) --
+    # Persistent per-run statistics keyed by plan fingerprint
+    # (plan/fingerprint.py): sharded JSONL under this directory, one
+    # record per query — stage wall times, copy traffic, per-operator
+    # row counts, dense-vs-fallback groupby cardinality. "" disables
+    # (every history call site is one truthiness check).
+    history_dir: str = os.environ.get("BLAZE_TPU_HISTORY_DIR", "")
+    # total run records retained across shards; also bounds the
+    # trace_export_dir rotation (ledger lines + trace_<qid>.json files
+    # kept) applied on driver start alongside the orphan sweep
+    history_retention_runs: int = 512
+    # records per JSONL shard before rotating to a new shard file
+    # (retention prunes whole oldest shards)
+    history_shard_runs: int = 128
+    # cross-run regression threshold: the latest run's per-stage wall
+    # time / copy traffic is flagged when it exceeds the fingerprint's
+    # historical median by more than this percentage (plus an absolute
+    # noise grace — see history.detect_regressions)
+    history_regression_pct: float = 25.0
     # per-operator enable flags (tier b, spark.blaze.enable.<op>)
     enable_ops: Dict[str, bool] = dataclasses.field(default_factory=dict)
 

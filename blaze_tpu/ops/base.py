@@ -131,19 +131,29 @@ def count_stream(op: Operator, stream: BatchStream) -> BatchStream:
     stats = conf.enable_input_batch_statistics
     if stats:
         from blaze_tpu.runtime.memory import batch_nbytes
+    # query-history row tap (runtime/history.py): per-operator output
+    # rows keyed by plan fingerprint — the observed-cardinality signal
+    # the statistics feed aggregates. Same posture as tracing: unset,
+    # the per-stream cost is this one truthiness check.
+    if conf.history_dir:
+        from blaze_tpu.runtime import history
+    else:
+        history = None
     fault_point = "op." + op.name()  # chaos injection at the op boundary
     try:
         for batch in stream:
             if conf.fault_injection_spec:
                 faults.inject(fault_point)
+            rows = int(batch.num_rows)
             if conf.trace_enabled:
-                trace.on_batch(op, int(batch.num_rows))
+                trace.on_batch(op, rows)
+            if history is not None:
+                history.observe_rows(op, rows)
             op.metrics.add("output_batches", 1)
-            op.metrics.add("output_rows", int(batch.num_rows))
+            op.metrics.add("output_rows", rows)
             if stats:
                 op.metrics.add("stat_bytes", batch_nbytes(batch))
-                op.metrics.set_max("stat_max_batch_rows",
-                                   int(batch.num_rows))
+                op.metrics.set_max("stat_max_batch_rows", rows)
             yield batch
     finally:
         # deterministic teardown: when the consumer abandons the stream

@@ -6,10 +6,22 @@ blaze.proto (regenerate plan_pb2.py with
 the TryInto<ExecutionPlan> dispatch (from_proto.rs:121-793).
 """
 
+from blaze_tpu.plan.fingerprint import (
+    fingerprint_operator,
+    fingerprint_plan,
+    fingerprint_query,
+)
 from blaze_tpu.plan.from_proto import (
     decode_expr,
     decode_plan,
     decode_task_definition,
 )
 
-__all__ = ["decode_expr", "decode_plan", "decode_task_definition"]
+__all__ = [
+    "decode_expr",
+    "decode_plan",
+    "decode_task_definition",
+    "fingerprint_operator",
+    "fingerprint_plan",
+    "fingerprint_query",
+]

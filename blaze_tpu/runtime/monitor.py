@@ -423,6 +423,26 @@ def prometheus_text() -> str:
     emit("blaze_spill_count_total", "counter", "Consumer spill operations",
          [({}, mgr.spill_count)])
 
+    # trace-ring health: a nonzero dropped counter means the bounded
+    # ring overflowed and the exported traces are truncated — previously
+    # visible only in the ledger, now scrapeable
+    emit("blaze_trace_dropped_events_total", "counter",
+         "Trace records dropped by the bounded ring (oldest-first)",
+         [({}, trace.TRACE.dropped)])
+    emit("blaze_trace_buffer_events", "gauge",
+         "Records currently held in the trace ring",
+         [({}, len(trace.TRACE))])
+    emit("blaze_trace_buffer_capacity", "gauge",
+         "Trace ring capacity (conf.trace_buffer_events)",
+         [({}, int(conf.trace_buffer_events))])
+    ring = _sampler.ring() if _sampler is not None else []
+    emit("blaze_monitor_ring_samples", "gauge",
+         "Samples held in the resource-monitor ring",
+         [({}, len(ring))])
+    emit("blaze_monitor_ring_capacity", "gauge",
+         "Resource-monitor ring capacity (conf.monitor_ring_samples)",
+         [({}, int(conf.monitor_ring_samples))])
+
     depths = pipeline.queue_depths()
     emit("blaze_pipeline_live_streams", "gauge",
          "Prefetch streams/sinks created but not yet finalized",

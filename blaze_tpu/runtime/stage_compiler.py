@@ -194,7 +194,8 @@ def try_run_stage(root: Operator, ctx: ExecContext, deferred: bool = False,
 
         faults.inject("op." + type(root).__name__)
     compile_service.note_stage_attempt()
-    trace.event("whole_stage_attempt", op_kind=type(root).__name__)
+    trace.event("whole_stage_attempt", op_kind=type(root).__name__,
+                fingerprint=_stage_fp(root))
     m = _match(root)
     if m is None:
         # chain_ok=False (the shuffle drivers): an agg-less chain stage
@@ -765,6 +766,10 @@ def try_run_stage(root: Operator, ctx: ExecContext, deferred: bool = False,
     root.metrics.add("output_rows", nrows)
     root.metrics.add("stage_compiled", 1)
     compile_service.note_stage_compiled()
+    # observed groupby cardinality: the dense one-hot path knows the
+    # exact group count in one number — the statistic the history feed
+    # aggregates per fingerprint (dense vs fallback)
+    _note_stage_stats(root, nrows, dense=True)
     return out
 
 
@@ -828,13 +833,52 @@ def _run_chain_stage(root: Operator, chain: List[MapLikeOp],
     root.metrics.add("output_rows", int(out.num_rows))
     root.metrics.add("stage_compiled", 1)
     compile_service.note_stage_compiled()
+    # chain stages have no group key — record output cardinality only
+    _note_stage_stats(root, None, dense=True, rows=int(out.num_rows))
     return out
+
+
+def _stage_fp(root: Operator):
+    """Operator fingerprint for whole-stage events/history taps; None
+    when neither tracing nor the history store would record it."""
+    if not (conf.trace_enabled or conf.history_dir):
+        return None
+    from blaze_tpu.runtime import history
+
+    return history.op_fingerprint(root)
+
+
+def _note_stage_stats(root: Operator, groups, dense: bool,
+                      rows=None) -> None:
+    """Feed the history taps for a whole-stage dispatch: the compiled
+    path bypasses count_stream's per-batch row tap, so output rows and
+    the dense-vs-fallback group cardinality are recorded here."""
+    fp = _stage_fp(root)
+    if fp is None:
+        return
+    trace.event("whole_stage_groups", op_kind=type(root).__name__,
+                fingerprint=fp, groups=groups, dense=dense)
+    if conf.history_dir:
+        from blaze_tpu.runtime import history
+
+        history.observe_groups(fp, type(root).__name__, groups, dense)
+        n = groups if rows is None else rows
+        if n is not None:
+            history.observe_rows(root, int(n))
 
 
 def _fallback(root, batches, source, ctx) -> ColumnBatch:
     from blaze_tpu.ops.basic import MemorySourceExec
 
-    trace.event("whole_stage_fallback", op_kind=type(root).__name__)
+    trace.event("whole_stage_fallback", op_kind=type(root).__name__,
+                fingerprint=_stage_fp(root))
+    if conf.history_dir:
+        from blaze_tpu.runtime import history
+
+        fp = _stage_fp(root)
+        if fp is not None:
+            history.observe_groups(fp, type(root).__name__, None,
+                                   dense=False)
     src = MemorySourceExec(batches, source.schema)
     return _collect_streaming(_rebuild(root, source, src), ctx)
 

@@ -494,6 +494,17 @@ def explain_analyze(root, run_info: Optional[dict] = None,
     recs = TRACE.snapshot() if records is None else list(records)
     stage_spans = [r for r in recs
                    if r["type"] == "span" and r["kind"] == "stage"]
+    # expected-vs-observed column: with a history store configured, each
+    # stage's wall time is shown against the fingerprint's historical
+    # median (runtime/history.StatisticsFeed)
+    feed = None
+    if conf.history_dir and stage_spans:
+        try:
+            from blaze_tpu.runtime.history import StatisticsFeed
+
+            feed = StatisticsFeed()
+        except Exception:  # noqa: BLE001 — reporting, never fatal
+            feed = None
     if stage_spans:
         lines.append("-- stages --")
         for sp in stage_spans:
@@ -502,6 +513,11 @@ def explain_analyze(root, run_info: Optional[dict] = None,
             head = (f"stage {sid} {a.get('stage_kind', '?')}"
                     f"[{a.get('transport', '-')}] "
                     f"{sp.get('dur', 0) / 1e6:.1f}ms tasks={a.get('tasks', 1)}")
+            if feed is not None and a.get("fingerprint"):
+                exp = feed.observed_stage_cost(a["fingerprint"])
+                if exp:
+                    head += (f" expect~{exp['ms_p50']:.1f}ms "
+                             f"(n={exp['n']})")
             if a.get("bytes"):
                 head += f" bytes={human_bytes(a['bytes'])}"
             mv, cp = a.get("moved_bytes", 0), a.get("copied_bytes", 0)
@@ -565,6 +581,7 @@ def build_run_record(query_id: str, run_info: Optional[dict] = None,
             continue
         a = sp.get("attrs", {})
         stages.append({"stage_id": sp.get("stage_id"),
+                       "fingerprint": a.get("fingerprint"),
                        "kind": a.get("stage_kind"),
                        "transport": a.get("transport"),
                        "ms": round(sp.get("dur", 0) / 1e6, 3),
@@ -605,6 +622,50 @@ def export_run_ledger(path: str, record: dict) -> None:
         os.makedirs(d, exist_ok=True)
     with open(path, "a") as f:
         f.write(json.dumps(record, default=str) + "\n")
+
+
+def rotate_export_dir(export_dir: Optional[str] = None,
+                      keep: Optional[int] = None) -> Dict[str, int]:
+    """Bound the trace export dir: trim ledger.jsonl to its last `keep`
+    lines and delete the oldest trace_<qid>.json files beyond `keep`
+    (default conf.history_retention_runs). The local runner applies
+    this on driver start alongside the orphan sweep — before it, the
+    ledger grew one line per query forever. Returns
+    {"ledger_trimmed", "traces_pruned"} (zeros when under the bound)."""
+    d = export_dir or conf.trace_export_dir
+    out = {"ledger_trimmed": 0, "traces_pruned": 0}
+    if not d or not os.path.isdir(d):
+        return out
+    if keep is None:
+        keep = conf.history_retention_runs
+    keep = max(int(keep), 1)
+    ledger = os.path.join(d, "ledger.jsonl")
+    if os.path.exists(ledger):
+        try:
+            with open(ledger) as f:
+                lines = f.readlines()
+            if len(lines) > keep:
+                tmp = ledger + ".tmp"
+                with open(tmp, "w") as f:
+                    f.writelines(lines[-keep:])
+                os.replace(tmp, ledger)  # crash-atomic, like the spills
+                out["ledger_trimmed"] = len(lines) - keep
+        except OSError:
+            pass
+    try:
+        traces = [os.path.join(d, n) for n in os.listdir(d)
+                  if n.startswith("trace_") and n.endswith(".json")]
+    except OSError:
+        return out
+    if len(traces) > keep:
+        traces.sort(key=lambda p: (os.path.getmtime(p), p))
+        for p in traces[:len(traces) - keep]:
+            try:
+                os.remove(p)
+                out["traces_pruned"] += 1
+            except OSError:
+                pass
+    return out
 
 
 def export_query(query_id: str, run_info: Optional[dict] = None,

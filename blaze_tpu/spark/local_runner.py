@@ -25,9 +25,10 @@ from typing import Dict, List, Optional
 from blaze_tpu.columnar.batch import ColumnBatch
 from blaze_tpu.ops.base import ExecContext
 from blaze_tpu.ops.common import concat_batches
-from blaze_tpu.plan import decode_plan
+from blaze_tpu.plan import decode_plan, fingerprint_plan
 from blaze_tpu.plan import plan_pb2 as pb
-from blaze_tpu.runtime import artifacts, faults, monitor, resources, trace
+from blaze_tpu.runtime import artifacts, faults, history, monitor
+from blaze_tpu.runtime import resources, trace
 from blaze_tpu.runtime import supervisor as supervisor_mod
 from blaze_tpu.runtime.executor import execute_plan, run_task_with_resilience
 from blaze_tpu.runtime.supervisor import Supervisor, TaskSpec
@@ -75,6 +76,10 @@ def run_plan(root: SparkPlan, num_partitions: int = 4,
     # attribution), reset the memory high-water mark, and lazily start
     # the Prometheus endpoint + sampler when conf.metrics_port is set
     monitor.begin_query(qid, mgr)
+    # query-history taps (runtime/history.py): per-op row counts and
+    # whole-stage group cardinality accumulate under this qid until
+    # record_run pops them at close (no-op with conf.history_dir unset)
+    history.begin_query(qid)
     try:
         with profiled_scope("run_plan"):
             with trace.span("query", query_id=qid,
@@ -91,6 +96,10 @@ def run_plan(root: SparkPlan, num_partitions: int = 4,
         # most want to read
         if conf.trace_enabled and conf.trace_export_dir:
             trace.export_query(qid, run_info)
+        # persist the run's fingerprinted statistics (after the monitor
+        # roll-up so the record carries the byte/spill/compile counters)
+        if conf.history_dir:
+            history.record_run(qid, run_info)
 
 
 def _run_plan_inner(root: SparkPlan, num_partitions: int,
@@ -105,8 +114,13 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
     from blaze_tpu.config import conf
 
     # task setup reclaims dead writers' leftovers (artifact temps in the
-    # work dirs via BlazeShuffleManager, spill files here)
+    # work dirs via BlazeShuffleManager, spill files here), and the
+    # trace export dir is bounded to conf.history_retention_runs
+    # (ledger.jsonl lines + trace_<qid>.json files — it grew without
+    # limit before)
     artifacts.sweep_orphans([conf.spill_dir])
+    if conf.trace_export_dir:
+        trace.rotate_export_dir()
     telemetry_before = faults.TELEMETRY.snapshot()
     from blaze_tpu.runtime import pipeline
 
@@ -157,10 +171,16 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
                     logging.getLogger(__name__).info(
                         "AQE: converted %d SMJ(s) to broadcast join "
                         "(stage %d)", n, stage.stage_id)
+            # canonical plan fingerprint (plan/fingerprint.py), computed
+            # AFTER AQE re-optimization — the executed shape is the one
+            # history statistics must key on. Skipped when nothing
+            # records it (neither tracing nor the history store is on).
+            fp = (fingerprint_plan(stage.plan)
+                  if conf.trace_enabled or conf.history_dir else None)
             if stage.kind == "shuffle_map":
                 shuffle_parts[stage.stage_id] = stage.num_partitions
                 with trace.span("stage", stage_id=stage.stage_id,
-                                stage_kind="shuffle_map",
+                                stage_kind="shuffle_map", fingerprint=fp,
                                 tasks=_input_tasks(stage, stages)) as sp:
                     if mesh_exchange == "auto":
                         from blaze_tpu.parallel.stage_exchange import (
@@ -210,7 +230,8 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
                                run_info["query_id"], stage.stage_id))
             elif stage.kind == "broadcast":
                 with trace.span("stage", stage_id=stage.stage_id,
-                                stage_kind="broadcast", tasks=1) as sp:
+                                stage_kind="broadcast", fingerprint=fp,
+                                tasks=1) as sp:
                     _run_broadcast_stage(stage, stages, sup, run_info)
                     sp.set(**monitor.stage_span_attrs(
                         run_info["query_id"], stage.stage_id))
@@ -218,7 +239,8 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
             else:
                 parts = _input_tasks(stage, stages, fallback=num_partitions)
                 with trace.span("stage", stage_id=stage.stage_id,
-                                stage_kind="result", tasks=parts) as sp:
+                                stage_kind="result", fingerprint=fp,
+                                tasks=parts) as sp:
                     out = _run_result_stage(stage, parts, sup, run_info)
                     sp.set(**monitor.stage_span_attrs(
                         run_info["query_id"], stage.stage_id))

@@ -1,0 +1,380 @@
+"""Query history store (runtime/history.py) + plan fingerprints
+(plan/fingerprint.py): literal-stable fingerprinting, sharded-store
+retention/rotation bounds, StatisticsFeed aggregation math, the
+cross-run regression detector's thresholds, trace-export-dir rotation,
+and the e2e record-twice-and-aggregate acceptance run against the
+pandas oracle."""
+
+import json
+import os
+
+import pytest
+
+from blaze_tpu.config import conf
+from blaze_tpu.plan import (fingerprint_operator, fingerprint_plan,
+                            fingerprint_query)
+from blaze_tpu.plan import plan_pb2 as pb
+from blaze_tpu.runtime import history, trace
+
+
+@pytest.fixture(autouse=True)
+def _clean_history_conf():
+    saved = {k: getattr(conf, k) for k in (
+        "history_dir", "history_retention_runs", "history_shard_runs",
+        "history_regression_pct", "trace_enabled", "trace_export_dir")}
+    history.reset()
+    trace.reset()
+    yield
+    for k, v in saved.items():
+        setattr(conf, k, v)
+    history.reset()
+    trace.reset()
+
+
+# ---------------------------------------------------------------------------
+# plan fingerprints
+# ---------------------------------------------------------------------------
+
+
+def _filter_plan(lit, column="x", op=pb.OP_GT):
+    n = pb.PlanNode()
+    f = n.filter
+    f.input.parquet_scan.file_schema.fields.add().name = column
+    p = f.predicates.add()
+    p.binary.op = op
+    p.binary.left.column.name = column
+    p.binary.right.literal.dtype.kind = pb.TK_INT64
+    p.binary.right.literal.int_value = lit
+    return n
+
+
+def test_fingerprint_invariant_to_literal_values():
+    # the whole point: `x > 5` and `x > 7` are the SAME plan shape, so
+    # observed statistics must aggregate across both
+    assert fingerprint_plan(_filter_plan(5)) == fingerprint_plan(
+        _filter_plan(7))
+
+
+def test_fingerprint_sensitive_to_structure():
+    base = fingerprint_plan(_filter_plan(5))
+    assert fingerprint_plan(_filter_plan(5, column="y")) != base
+    assert fingerprint_plan(_filter_plan(5, op=pb.OP_LT)) != base
+    # literal TYPE is part of the shape even though the value is masked
+    typed = _filter_plan(5)
+    typed.filter.predicates[0].binary.right.literal.dtype.kind = pb.TK_INT32
+    assert fingerprint_plan(typed) != base
+
+
+def test_fingerprint_masks_file_identity():
+    # task-scoped rewrites (shuffle temp files) and re-generated tables
+    # (path/size/mtime) must not re-key the plan
+    def writer(data_file, nparts):
+        n = pb.PlanNode()
+        w = n.shuffle_writer
+        w.input.parquet_scan.file_schema.fields.add().name = "x"
+        w.partitioning.num_partitions = nparts
+        w.data_file = data_file
+        w.index_file = data_file + ".idx"
+        return n
+
+    assert fingerprint_plan(writer("/tmp/a.data", 4)) == fingerprint_plan(
+        writer("/spill/elsewhere.data", 4))
+    assert fingerprint_plan(writer("/tmp/a.data", 4)) != fingerprint_plan(
+        writer("/tmp/a.data", 8))
+
+    def pfile(path, size, mtime):
+        f = pb.PartitionedFile()
+        f.path, f.size, f.last_modified_ns = path, size, mtime
+        return f
+
+    assert fingerprint_plan(pfile("/a", 10, 1)) == fingerprint_plan(
+        pfile("/b", 99, 2))
+
+
+def test_fingerprint_operator_and_query():
+    class _FakeOp:
+        def __init__(self, key):
+            self._key = key
+
+        def plan_key(self):
+            return self._key
+
+    a = fingerprint_operator(_FakeOp(("FilterExec", ("ScanExec",))))
+    assert a == fingerprint_operator(_FakeOp(("FilterExec", ("ScanExec",))))
+    assert a != fingerprint_operator(_FakeOp(("ProjectExec", ("ScanExec",))))
+    q = fingerprint_query(["s0", "s1"])
+    assert q == fingerprint_query(["s0", "s1"])
+    assert q != fingerprint_query(["s1", "s0"])  # stage order is shape
+
+
+# ---------------------------------------------------------------------------
+# store: sharding, rotation, retention
+# ---------------------------------------------------------------------------
+
+
+def test_store_round_trip(tmp_path):
+    s = history.HistoryStore(str(tmp_path), retention=100, shard_runs=100)
+    for i in range(5):
+        s.append({"query_id": f"q{i}", "i": i})
+    got = s.records()
+    assert [r["i"] for r in got] == [0, 1, 2, 3, 4]
+    # a fresh handle over the same directory sees the same records
+    assert history.HistoryStore(str(tmp_path)).total_records() == 5
+
+
+def test_store_rotation_and_retention_bounds(tmp_path):
+    s = history.HistoryStore(str(tmp_path), retention=10, shard_runs=4)
+    for i in range(25):
+        s.append({"i": i})
+        assert s.total_records() <= 10  # invariant holds DURING ingest
+    recs = s.records()
+    assert recs[-1]["i"] == 24  # newest always retained
+    # retained records are a contiguous suffix of what was appended
+    assert [r["i"] for r in recs] == list(range(25 - len(recs), 25))
+    assert len(s.shards()) <= 10 // 4 + 1
+
+
+def test_store_shard_cap_never_exceeds_retention(tmp_path):
+    # shard_runs > retention would make pruning (whole shards only)
+    # unable to enforce the bound; the cap clamps it
+    s = history.HistoryStore(str(tmp_path), retention=3, shard_runs=100)
+    for i in range(9):
+        s.append({"i": i})
+    assert s.total_records() <= 3
+
+
+def test_store_skips_torn_line(tmp_path):
+    s = history.HistoryStore(str(tmp_path), retention=50, shard_runs=50)
+    s.append({"i": 0})
+    with open(s.shards()[0], "a") as f:
+        f.write('{"i": 1, "truncated-mid-cr')  # crash mid-write
+    s.append({"i": 2})
+    assert [r["i"] for r in s.records()] == [0, 2]
+
+
+def test_store_singleton_cache(tmp_path):
+    assert history.store(str(tmp_path)) is history.store(str(tmp_path))
+    assert history.store("") is None
+
+
+# ---------------------------------------------------------------------------
+# statistics feed aggregation
+# ---------------------------------------------------------------------------
+
+
+def _stage_rec(qid, fp, ms, copied=0, moved=0, kind="result"):
+    return {"query_id": qid, "ts": 0.0, "plan_fingerprint": "P",
+            "duration_ms": ms,
+            "stages": [{"stage_id": 0, "fingerprint": fp, "kind": kind,
+                        "transport": None, "ms": ms,
+                        "copied_bytes": copied, "moved_bytes": moved}],
+            "ops": [], "groups": [], "counters": {}}
+
+
+def test_feed_stage_cost_percentiles():
+    recs = [_stage_rec("q", "S", ms) for ms in (10.0, 20.0, 30.0)]
+    feed = history.StatisticsFeed(recs)
+    cost = feed.observed_stage_cost("S")
+    assert cost["n"] == 3
+    assert cost["ms_p50"] == 20.0
+    assert cost["ms_p95"] == 30.0
+    assert cost["ms_mean"] == 20.0
+    assert feed.observed_stage_cost("missing") is None
+    assert feed.fingerprints()["stages"] == ["S"]
+
+
+def test_feed_cardinality_and_selectivity():
+    rec = {"query_id": "q", "ts": 0.0, "plan_fingerprint": None,
+           "duration_ms": 1.0, "stages": [], "counters": {},
+           "ops": [
+               {"fingerprint": "A", "op": "ScanExec", "rows": 100,
+                "batches": 2, "inputs": []},
+               {"fingerprint": "B", "op": "FilterExec", "rows": 40,
+                "batches": 2, "inputs": ["A"]}],
+           "groups": [{"fingerprint": "G", "op": "AggExec",
+                       "groups": 7, "dense": True},
+                      {"fingerprint": "G", "op": "AggExec",
+                       "groups": None, "dense": False}]}
+    feed = history.StatisticsFeed([rec])
+    scan = feed.observed_cardinality("A")
+    assert scan["rows_p50"] == 100.0 and scan.get("selectivity_p50") is None
+    filt = feed.observed_cardinality("B")
+    assert filt["rows_p50"] == 40.0
+    assert filt["selectivity_p50"] == pytest.approx(0.4)
+    agg = feed.observed_cardinality("G")
+    assert agg["dense_ratio"] == pytest.approx(0.5)  # 1 dense of 2 attempts
+    assert agg["groups_p50"] == 7.0
+    assert feed.observed_cardinality("nope") is None
+
+
+# ---------------------------------------------------------------------------
+# regression detector
+# ---------------------------------------------------------------------------
+
+
+def test_detector_flags_wall_time_regression():
+    recs = [_stage_rec("q", "F", 100.0) for _ in range(3)]
+    recs.append(_stage_rec("q-slow", "F", 300.0))
+    found = history.detect_regressions(recs)
+    assert len(found) == 1
+    f = found[0]
+    assert f["metric"] == "wall_ms" and f["fingerprint"] == "F"
+    assert f["latest"] == 300.0 and f["median"] == 100.0
+    # threshold = median * 1.25 (conf default 25%) + 100ms jitter grace
+    assert f["threshold"] == pytest.approx(225.0)
+    assert f["query_id"] == "q-slow"
+
+
+def test_detector_quiet_within_threshold_and_grace():
+    # 120ms vs 100ms median: over the 25% bar alone but inside grace
+    recs = [_stage_rec("q", "F", 100.0) for _ in range(3)]
+    recs.append(_stage_rec("q", "F", 120.0))
+    assert history.detect_regressions(recs) == []
+    # tiny stages: grace absorbs absolute noise entirely
+    tiny = [_stage_rec("q", "T", 1.0) for _ in range(3)]
+    tiny.append(_stage_rec("q", "T", 50.0))
+    assert history.detect_regressions(tiny) == []
+
+
+def test_detector_needs_min_history():
+    # one prior run is not a distribution — never flag
+    recs = [_stage_rec("q", "F", 100.0), _stage_rec("q", "F", 500.0)]
+    assert history.detect_regressions(recs) == []
+
+
+def test_detector_flags_copy_traffic():
+    mb = 1 << 20
+    recs = [_stage_rec("q", "F", 10.0, copied=mb) for _ in range(3)]
+    recs.append(_stage_rec("q", "F", 10.0, copied=2 * mb))
+    found = history.detect_regressions(recs)
+    assert [f["metric"] for f in found] == ["copied_bytes"]
+    assert found[0]["latest"] == float(2 * mb)
+
+
+def test_detector_sums_repeated_fingerprint_within_run():
+    # the same subtree executing twice IN ONE run is intra-run shape,
+    # not history: per-run sums are compared, so 2 x 60ms after a
+    # 100ms-median history is quiet (120 < 225)...
+    recs = [_stage_rec("q", "F", 100.0) for _ in range(3)]
+    twice = _stage_rec("q", "F", 60.0)
+    twice["stages"].append(dict(twice["stages"][0], ms=60.0))
+    found = history.detect_regressions(recs + [twice])
+    assert found == []
+    # ...while 2 x 150ms is a real 300ms regression
+    twice = _stage_rec("q", "F", 150.0)
+    twice["stages"].append(dict(twice["stages"][0], ms=150.0))
+    found = history.detect_regressions(recs + [twice])
+    assert [f["latest"] for f in found] == [300.0]
+
+
+def test_detector_pct_knob():
+    recs = [_stage_rec("q", "F", 1000.0) for _ in range(3)]
+    recs.append(_stage_rec("q", "F", 1300.0))
+    assert history.detect_regressions(recs) == []  # 30% < default-off 25%+grace
+    assert len(history.detect_regressions(recs, pct=10.0)) == 1
+
+
+# ---------------------------------------------------------------------------
+# trace-export-dir rotation (satellite of the retention story)
+# ---------------------------------------------------------------------------
+
+
+def test_rotate_export_dir_bounds_ledger_and_traces(tmp_path):
+    d = str(tmp_path)
+    with open(os.path.join(d, "ledger.jsonl"), "w") as f:
+        for i in range(20):
+            f.write(json.dumps({"query_id": f"q{i}"}) + "\n")
+    for i in range(15):
+        with open(os.path.join(d, f"trace_q{i}.json"), "w") as f:
+            f.write("{}")
+        os.utime(os.path.join(d, f"trace_q{i}.json"), (i, i))
+    stats = trace.rotate_export_dir(d, keep=5)
+    assert stats == {"ledger_trimmed": 15, "traces_pruned": 10}
+    with open(os.path.join(d, "ledger.jsonl")) as f:
+        kept = [json.loads(x)["query_id"] for x in f]
+    assert kept == [f"q{i}" for i in range(15, 20)]  # newest survive
+    left = sorted(n for n in os.listdir(d) if n.startswith("trace_"))
+    assert left == [f"trace_q{i}.json" for i in range(10, 15)]
+    # idempotent once within bounds
+    assert trace.rotate_export_dir(d, keep=5) == {"ledger_trimmed": 0,
+                                                  "traces_pruned": 0}
+
+
+def test_rotate_export_dir_missing_dir_is_noop(tmp_path):
+    assert trace.rotate_export_dir(str(tmp_path / "nope"), keep=5) == {
+        "ledger_trimmed": 0, "traces_pruned": 0}
+
+
+# ---------------------------------------------------------------------------
+# e2e: record real catalogue runs, aggregate, stay true to the oracle
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tables(tmp_path_factory):
+    from blaze_tpu.spark import validator
+
+    d = str(tmp_path_factory.mktemp("history_tables"))
+    return validator.generate_tables(d, rows=2500)
+
+
+def _run_q2(tables, work_dir):
+    from blaze_tpu.spark import validator
+    from blaze_tpu.spark.local_runner import run_plan
+
+    paths, frames = tables
+    plan, oracle = validator.QUERIES["q2_q06_core_agg"](paths, frames, "bhj")
+    out = run_plan(plan, num_partitions=4, work_dir=work_dir,
+                   mesh_exchange="off")
+    diff = validator._compare(
+        validator._to_pandas(out).reset_index(drop=True),
+        oracle().reset_index(drop=True))
+    assert diff is None, diff
+
+
+def test_e2e_record_twice_and_aggregate(tables, tmp_path):
+    conf.update(history_dir=str(tmp_path / "hist"), trace_enabled=True)
+    _run_q2(tables, str(tmp_path / "w0"))
+    _run_q2(tables, str(tmp_path / "w1"))
+    recs = history.store().records()
+    assert len(recs) == 2
+    # same plan shape both runs -> same query fingerprint, and every
+    # stage carries one
+    assert recs[0]["plan_fingerprint"] == recs[1]["plan_fingerprint"]
+    assert recs[0]["plan_fingerprint"]
+    for r in recs:
+        assert r["duration_ms"] > 0
+        assert r["stages"] and all(s["fingerprint"] for s in r["stages"])
+        assert r["ops"]  # batch taps (or whole-stage notes) landed
+    feed = history.StatisticsFeed()
+    fp = recs[0]["stages"][0]["fingerprint"]
+    cost = feed.observed_stage_cost(fp)
+    assert cost and cost["n"] == 2 and cost["ms_p50"] > 0
+    card = feed.observed_cardinality(recs[0]["ops"][0]["fingerprint"])
+    assert card and card["n"] == 2 and card["rows_p50"] >= 0
+    # two clean runs of the same plan: nothing to flag
+    assert history.detect_regressions(recs) == []
+
+
+def test_e2e_fingerprint_stable_across_table_regeneration(
+        tables, tmp_path, tmp_path_factory):
+    from blaze_tpu.spark import validator
+
+    conf.update(history_dir=str(tmp_path / "hist"), trace_enabled=True)
+    _run_q2(tables, str(tmp_path / "w0"))
+    # regenerate the SAME schema elsewhere: new paths, sizes, mtimes —
+    # the fingerprint must not move (file identity is masked)
+    d = str(tmp_path_factory.mktemp("history_tables_regen"))
+    _run_q2(validator.generate_tables(d, rows=2500), str(tmp_path / "w1"))
+    recs = history.store().records()
+    assert recs[0]["plan_fingerprint"] == recs[1]["plan_fingerprint"]
+
+
+def test_e2e_history_without_trace_still_records_ops(tables, tmp_path):
+    conf.update(history_dir=str(tmp_path / "hist"), trace_enabled=False)
+    _run_q2(tables, str(tmp_path / "w0"))
+    recs = history.store().records()
+    assert len(recs) == 1
+    # no trace -> no stage spans to fingerprint, but the op taps run
+    assert recs[0]["stages"] == []
+    assert recs[0]["ops"]

@@ -81,6 +81,14 @@ def render(metrics: dict, source: str) -> str:
         f"compile  hits={int(g('blaze_compile_cache_hits'))} "
         f"misses={int(g('blaze_compile_cache_misses'))} "
         f"compiled={int(g('blaze_compile_compile_count'))}")
+    dropped = int(g("blaze_trace_dropped_events_total"))
+    lines.append(
+        f"trace    buffered={int(g('blaze_trace_buffer_events'))}"
+        f"/{int(g('blaze_trace_buffer_capacity'))} "
+        f"dropped={dropped}"
+        + ("  ** TRACE RING OVERFLOWED **" if dropped else "")
+        + f"  monitor_ring={int(g('blaze_monitor_ring_samples'))}"
+        f"/{int(g('blaze_monitor_ring_capacity'))}")
     trips = int(g("blaze_faults_breaker_trips"))
     lines.append(
         f"faults   retries={int(g('blaze_faults_retries'))} "
