@@ -9,10 +9,20 @@ PYENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
 .PHONY: check check-fast check-faults check-supervisor check-trace \
 	check-pipeline check-pipeline-soak check-perf check-perf-update \
-	check-obs check-history test test-fast validate validate-fast warm
+	check-obs check-history check-lint test test-fast validate \
+	validate-fast warm
 
-check: test validate check-perf check-history
+check: check-lint test validate check-perf check-history
 	@echo "CHECK OK — safe to commit"
+
+# Static invariant gate (tools/blazelint): lock discipline, knob
+# registry sync, resource pairing, hot-path gating, name-registry sync
+# and a pyflakes-equivalent pass — stdlib ast only, no jax import, so
+# it runs first (seconds) and fails fast. New findings must be fixed
+# or added to LINT_BASELINE.json with a justification (README "Static
+# analysis"). Emits LINT_r12.json.
+check-lint:
+	python -m tools.blazelint --json-out LINT_r12.json
 
 # The every-commit bar (< 5 min): full unit suite minus the two
 # slowest end-to-end suites, plus a 3-cell validator subset. Slow gates

@@ -27,7 +27,7 @@ from __future__ import annotations
 import dataclasses
 import io
 import struct
-from typing import BinaryIO, Iterator, List, Optional, Sequence
+from typing import BinaryIO, Iterator, List, Optional
 
 import numpy as np
 

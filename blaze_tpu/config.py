@@ -5,210 +5,300 @@ from native over JNI). Here the native side IS this process, so the conf is a
 plain singleton the JVM bridge (or tests) can populate; defaults mirror the
 reference's (BlazeConf.java:23-70) where semantics carry over, with
 TPU-specific knobs added.
+
+The ``KNOBS`` registry below is the SINGLE SOURCE OF TRUTH for every knob:
+name, default, type, doc string, and env-var override live in one ``Knob``
+declaration, and everything else derives from it — ``BlazeConf`` instances
+are built from the registry, ``tools/blazelint``'s knob-registry checker
+validates every ``conf.<name>`` access (and the README catalog) against it,
+and ``knob_catalog_md()`` renders the README table. To add a knob: add one
+``Knob(...)`` entry here, read it somewhere in the runtime, and document it
+in README.md ("Configuration knobs") — `make check-lint` fails until all
+three agree.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import os
-from typing import Any, Dict
+from typing import Any, Callable, Dict, Optional, Tuple
 
 
-@dataclasses.dataclass
-class BlazeConf:
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    """One declared configuration knob.
+
+    ``default_factory`` (mutable defaults: dicts) wins over ``default``;
+    ``env`` names an environment variable consulted once at BlazeConf
+    construction (the value is cast through ``type``)."""
+
+    name: str
+    default: Any = None
+    doc: str = ""
+    env: str = ""
+    default_factory: Optional[Callable[[], Any]] = None
+
+    @property
+    def type(self) -> type:
+        if self.default_factory is not None:
+            return type(self.default_factory())
+        return type(self.default)
+
+    def resolve(self) -> Any:
+        if self.env:
+            raw = os.environ.get(self.env)
+            if raw is not None:
+                t = self.type
+                if t is bool:
+                    return raw.lower() in ("1", "true", "yes", "on")
+                return t(raw)
+        if self.default_factory is not None:
+            return self.default_factory()
+        return self.default
+
+
+_DECLARATIONS: Tuple[Knob, ...] = (
     # -- reference-equivalent knobs (BlazeConf.java) --
-    batch_size: int = 8192  # ref default 10000; 8192 is TPU/XLA tile friendly
-    memory_fraction: float = 0.6
-    enable_smj_inequality_join: bool = False
-    enable_bhj_fallbacks_to_smj: bool = True
-    bhj_fallback_rows_threshold: int = 1_000_000
-    bhj_fallback_mem_threshold: int = 128 << 20
-    enable_caseconvert_functions: bool = False
-    udf_wrapper_num_threads: int = 1
-    enable_input_batch_statistics: bool = False
-    ignore_corrupt_files: bool = False
+    Knob("batch_size", 8192,
+         doc="Rows per batch; ref default 10000 — 8192 is TPU/XLA tile "
+             "friendly."),
+    Knob("enable_smj_inequality_join", False,
+         doc="Allow sort-merge joins with inequality conditions."),
+    Knob("enable_bhj_fallbacks_to_smj", True,
+         doc="Fall back from broadcast-hash join to sort-merge join when "
+             "the build side exceeds the thresholds below."),
+    Knob("bhj_fallback_rows_threshold", 1_000_000,
+         doc="Build-side row count above which BHJ falls back to SMJ."),
+    Knob("bhj_fallback_mem_threshold", 128 << 20,
+         doc="Build-side byte size above which BHJ falls back to SMJ."),
+    Knob("enable_input_batch_statistics", False,
+         doc="Per-operator input-batch byte/row statistics at every "
+             "stream boundary (ref batch_statisitcs module)."),
+    Knob("ignore_corrupt_files", False,
+         doc="Skip unreadable/corrupt input files instead of failing the "
+             "task."),
 
     # -- TPU-native knobs --
-    # capacity buckets are powers of two: jit cache is keyed on (plan, capacity,
-    # string-width) so padding to buckets bounds the number of compilations.
-    min_capacity: int = 1024
-    # string columns are fixed-width uint8 matrices; width is bucketed too.
-    min_string_width: int = 4
-    max_string_width: int = 4096
-    # HBM budget for MemManager (bytes); 0 = derive from device memory stats.
-    memory_budget: int = 0
-    # spill directory for host spill files
-    spill_dir: str = os.environ.get("BLAZE_TPU_SPILL_DIR", "/tmp/blaze_tpu_spill")
-    # zstd level for shuffle/spill/broadcast frames (ref uses level 1)
-    zstd_level: int = 1
-    # whole-stage single-dispatch compiler (runtime/stage_compiler.py):
-    # amortizes the ~90ms-per-dispatch cost of remote-attached TPUs
-    enable_stage_compiler: bool = True
-    # dense grouped-agg key range for the MXU one-hot path (<= 2^16:
-    # 256x256 byte decomposition); stages whose keys exceed it fall back
-    dense_agg_range: int = 1 << 16
-    # precision policy for FLOAT sums on the MXU digit-plane path: each
-    # plane is one base-256 digit of the per-stage max magnitude. The
-    # default 6 planes digitize to 46 bits — the TPU's emulated-f64
-    # mantissa class, so float sums stay in the same precision class as
-    # every other f64 op. Lowering to 5 (38-bit, relative sum error
-    # ~2^-38 per value) is a documented opt-in perf setting that cuts
-    # one-hot matmul FLOPs ~14%; raise to 7 for stricter accumulation
-    # (int sums always use the exact 8-chunk int64 path).
-    float_sum_digit_planes: int = 6
-    # external-sort spill frame rows: merge cost is one dispatch trio
-    # per pooled frame, so bigger frames amortize the fixed per-dispatch
-    # overhead (~90ms each on the remote-attached chip)
-    spill_frame_rows: int = 1 << 16
-    # adaptive macro-batching: batch sources (scan, shuffle/broadcast
-    # readers) size batches toward this many bytes, clamped by the
-    # memory budget (ops/common.adaptive_batch_rows). On a
-    # remote-attached chip every per-batch dispatch/pull carries a fixed
-    # ~90ms round trip, so fewer, larger batches are strictly better
-    # until HBM pressure; under a small spill budget the clamp restores
-    # small bounded batches.
-    target_batch_bytes: int = 128 << 20
-    max_batch_rows: int = 1 << 21
-    # AQE dynamic join selection: a planned SMJ whose shuffled input came
-    # in under this many bytes becomes a broadcast join (Spark's
-    # autoBroadcastJoinThreshold analog; 0 disables)
-    aqe_broadcast_threshold: int = 10 << 20
-    # compile-service shape canonicalization (runtime/compile_service.py):
-    # above canonical_pow2_limit, power-of-two capacity buckets collapse
-    # onto power-of-four rungs anchored at the limit, halving the large
-    # end of the compiled-program shape space. At or below the limit
-    # shapes are identical to the plain pow2 buckets.
-    enable_compile_canonicalization: bool = True
-    canonical_pow2_limit: int = 1 << 14
-    # JAX profiler trace output dir ("" disables) — runtime/tracing.py
-    profiler_dir: str = os.environ.get("BLAZE_TPU_PROFILE_DIR", "")
+    Knob("min_capacity", 1024,
+         doc="Smallest power-of-two capacity bucket: the jit cache is "
+             "keyed on (plan, capacity, string-width), so padding to "
+             "buckets bounds the number of compilations."),
+    Knob("min_string_width", 4,
+         doc="Smallest fixed string width (string columns are fixed-width "
+             "uint8 matrices; width is bucketed like capacity)."),
+    Knob("max_string_width", 4096,
+         doc="Cap on the bucketed fixed string width."),
+    Knob("memory_budget", 0,
+         doc="HBM budget for MemManager in bytes; 0 = derive from device "
+             "memory stats."),
+    Knob("spill_dir", "/tmp/blaze_tpu_spill", env="BLAZE_TPU_SPILL_DIR",
+         doc="Directory for host spill files (MemManager/SpillFile)."),
+    Knob("zstd_level", 1,
+         doc="Compression level for shuffle/spill/broadcast frames (ref "
+             "uses zstd level 1; this build's frame codec is zlib at the "
+             "same level knob)."),
+    Knob("enable_stage_compiler", True,
+         doc="Whole-stage single-dispatch compiler "
+             "(runtime/stage_compiler.py): amortizes the ~90ms-per-"
+             "dispatch cost of remote-attached TPUs."),
+    Knob("dense_agg_range", 1 << 16,
+         doc="Dense grouped-agg key range for the MXU one-hot path "
+             "(<= 2^16: 256x256 byte decomposition); stages whose keys "
+             "exceed it fall back."),
+    Knob("float_sum_digit_planes", 6,
+         doc="Precision policy for FLOAT sums on the MXU digit-plane "
+             "path: 6 planes digitize to 46 bits (the TPU's emulated-f64 "
+             "mantissa class). 5 is a documented perf opt-in (~14% fewer "
+             "one-hot matmul FLOPs, ~2^-38 relative error); 7 is "
+             "stricter. Int sums always use the exact 8-chunk int64 "
+             "path."),
+    Knob("spill_frame_rows", 1 << 16,
+         doc="External-sort spill frame rows: merge cost is one dispatch "
+             "trio per pooled frame, so bigger frames amortize the fixed "
+             "per-dispatch overhead."),
+    Knob("target_batch_bytes", 128 << 20,
+         doc="Adaptive macro-batching target: batch sources size batches "
+             "toward this many bytes, clamped by the memory budget "
+             "(ops/common.adaptive_batch_rows)."),
+    Knob("max_batch_rows", 1 << 21,
+         doc="Hard row cap on adaptive macro-batches."),
+    Knob("aqe_broadcast_threshold", 10 << 20,
+         doc="AQE dynamic join selection: a planned SMJ whose shuffled "
+             "input came in under this many bytes becomes a broadcast "
+             "join (Spark autoBroadcastJoinThreshold analog; 0 "
+             "disables)."),
+    Knob("enable_compile_canonicalization", True,
+         doc="Compile-service shape canonicalization: above "
+             "canonical_pow2_limit, power-of-two capacity buckets "
+             "collapse onto power-of-four rungs, halving the large end "
+             "of the compiled-program shape space."),
+    Knob("canonical_pow2_limit", 1 << 14,
+         doc="Capacity above which canonicalization switches to "
+             "power-of-four rungs."),
+    Knob("profiler_dir", "", env="BLAZE_TPU_PROFILE_DIR",
+         doc="JAX profiler trace output dir ('' disables) — consumed by "
+             "the LEGACY low-level profiler module runtime/tracing.py "
+             "(jax.profiler TensorBoard traces), not by the structured "
+             "engine trace in runtime/trace.py."),
+
     # -- structured query tracing (runtime/trace.py) --
-    # Record correlated span/event records (query/stage/task/attempt ids)
-    # for every runtime decision: stage transport, task attempts, retries,
-    # ladder rungs, speculation, breaker trips, spills, compile cache
-    # traffic. Off (default) every trace call is one truthiness check.
-    trace_enabled: bool = False
-    # bounded ring capacity of the process-global TraceLog; overflow
-    # drops the OLDEST record and counts it (TraceLog.dropped — surfaced
-    # in the run ledger so a truncated trace is never mistaken for a
-    # quiet one)
-    trace_buffer_events: int = 1 << 17
-    # per-query export dir ("" disables): the local runner writes
-    # trace_<query_id>.json (Chrome/Perfetto trace-event JSON) and
-    # appends one JSONL line to ledger.jsonl per query
-    trace_export_dir: str = os.environ.get("BLAZE_TPU_TRACE_DIR", "")
+    Knob("trace_enabled", False,
+         doc="Record correlated span/event records (query/stage/task/"
+             "attempt ids) for every runtime decision. Off (default) "
+             "every trace call site is one truthiness check."),
+    Knob("trace_buffer_events", 1 << 17,
+         doc="Bounded ring capacity of the process-global TraceLog; "
+             "overflow drops the OLDEST record and counts it "
+             "(TraceLog.dropped)."),
+    Knob("trace_export_dir", "", env="BLAZE_TPU_TRACE_DIR",
+         doc="Per-query export dir ('' disables): trace_<query_id>.json "
+             "(Chrome/Perfetto) plus one ledger.jsonl line per query."),
+
     # -- execution resilience (runtime/faults.py, runtime/executor.py) --
-    # fault-injection spec ({} disables; see faults.py docstring for the
-    # {"seed": ..., "points": {...}} shape). Install via faults.install()
-    # so the deterministic schedule state resets with the spec.
-    fault_injection_spec: Dict[str, Any] = dataclasses.field(
-        default_factory=dict)
-    # bounded per-task retries for RetryableError-classified failures
-    max_task_retries: int = 2
-    # base backoff before retry i is ~retry_backoff_ms * 2^i (+-25% jitter)
-    retry_backoff_ms: int = 10
-    # resource-exhaustion degradation ladder: halve macro-batch ->
-    # force MemManager spill -> route the task to the CPU fallback
-    # interpreter. Off = resource errors get plain bounded retries.
-    enable_degradation_ladder: bool = True
+    Knob("fault_injection_spec", default_factory=dict,
+         doc="Fault-injection spec ({} disables; see faults.py docstring "
+             "for the {'seed':..., 'points':...} shape). Install via "
+             "faults.install() so the deterministic schedule state "
+             "resets with the spec."),
+    Knob("max_task_retries", 2,
+         doc="Bounded per-task retries for RetryableError-classified "
+             "failures."),
+    Knob("retry_backoff_ms", 10,
+         doc="Base backoff before retry i is ~retry_backoff_ms * 2^i "
+             "(+-25% jitter)."),
+    Knob("enable_degradation_ladder", True,
+         doc="Resource-exhaustion degradation ladder: halve macro-batch "
+             "-> force MemManager spill -> CPU fallback interpreter. "
+             "Off = resource errors get plain bounded retries."),
+
     # -- task supervisor (runtime/supervisor.py) --
-    # Off = the PR-2 sequential runner: tasks run inline on the driver
-    # thread with retries/ladder only (no pool, watchdog, speculation).
-    enable_supervisor: bool = True
-    # bounded worker pool for shuffle-map / broadcast / result tasks.
-    # Deterministic chaos replay forces this to 1 while a fault spec
-    # without {"concurrent": true} is armed (scheduling order is part of
-    # the injection schedule).
-    max_concurrent_tasks: int = 4
-    # wall-clock budget per task (all attempts incl. retries/backoff) and
-    # per query; 0 = unlimited. Exhaustion raises faults.DeadlineError.
-    task_deadline_ms: int = 0
-    query_deadline_ms: int = 0
-    # watchdog hang detection: an attempt whose heartbeat (kill-flag
-    # checks at batch boundaries) stalls past this is cancelled and
-    # relaunched under the resilience ladder. 0 disables — a first jit
-    # compile can legitimately sit minutes without a batch boundary.
-    hang_detect_ms: int = 0
-    # straggler speculation: a running attempt exceeding multiplier x the
-    # median completed-attempt duration of its stage gets a speculative
-    # twin; first commit wins, the loser is cancelled. 0 disables
-    # (Spark's spark.speculation default; its multiplier default is 1.5).
-    speculation_multiplier: float = 0.0
-    # per-operator circuit breaker: after this many classified failures
-    # attributed to one operator kind within a query, that operator trips
-    # to the row-interpreter fallback for the rest of the run. 0 disables.
-    breaker_failure_threshold: int = 4
+    Knob("enable_supervisor", True,
+         doc="Off = the PR-2 sequential runner: tasks run inline on the "
+             "driver thread with retries/ladder only (no pool, watchdog, "
+             "speculation)."),
+    Knob("max_concurrent_tasks", 4,
+         doc="Bounded worker pool for shuffle-map/broadcast/result "
+             "tasks. Deterministic chaos replay forces 1 while a fault "
+             "spec without {'concurrent': true} is armed."),
+    Knob("task_deadline_ms", 0,
+         doc="Wall-clock budget per task (all attempts incl. retries/"
+             "backoff); 0 = unlimited. Exhaustion raises "
+             "faults.DeadlineError."),
+    Knob("query_deadline_ms", 0,
+         doc="Wall-clock budget per query; 0 = unlimited."),
+    Knob("hang_detect_ms", 0,
+         doc="Watchdog hang detection: an attempt whose heartbeat stalls "
+             "past this is cancelled and relaunched under the resilience "
+             "ladder. 0 disables."),
+    Knob("speculation_multiplier", 0.0,
+         doc="Straggler speculation: a running attempt exceeding "
+             "multiplier x the median completed-attempt duration of its "
+             "stage gets a speculative twin; first commit wins. 0 "
+             "disables."),
+    Knob("breaker_failure_threshold", 4,
+         doc="Per-operator circuit breaker: after this many classified "
+             "failures attributed to one operator kind within a query, "
+             "that operator trips to the row-interpreter fallback. 0 "
+             "disables."),
+
     # -- pipelined async execution (runtime/pipeline.py) --
-    # Overlap host-side stages (parquet read+decode, serde compress/
-    # decompress, shuffle frame write + read-side readahead, spill I/O)
-    # with device compute: producers run on a shared I/O thread pool
-    # behind bounded queues while the consumer thread keeps the device
-    # busy. False restores the serial streams; an armed fault spec
-    # without {"concurrent": true} also forces serial (thread timing
-    # would otherwise perturb deterministic chaos schedules).
-    enable_pipeline: bool = True
-    # shared I/O pool width (pipeline.io_pool). Host stages are
-    # zlib/zstd + numpy + file I/O — they release the GIL, so a few
-    # threads overlap well even under CPython.
-    io_threads: int = 4
-    # bounded queue depth per pipelined stream: at most this many
-    # batches sit decoded-but-unconsumed. In-flight bytes are reserved
-    # against the MemManager budget (backpressure, not OOM), so raising
-    # this trades memory for tolerance to bursty producers.
-    prefetch_batches: int = 2
+    Knob("enable_pipeline", True,
+         doc="Overlap host-side stages (parquet read+decode, serde, "
+             "shuffle frame I/O, spill I/O) with device compute via a "
+             "shared I/O pool behind bounded queues. False restores the "
+             "serial streams; an armed fault spec without "
+             "{'concurrent': true} also forces serial."),
+    Knob("io_threads", 4,
+         doc="Shared I/O pool width (pipeline.io_pool). Host stages "
+             "release the GIL (zlib + numpy + file I/O), so a few "
+             "threads overlap well even under CPython."),
+    Knob("prefetch_batches", 2,
+         doc="Bounded queue depth per pipelined stream; in-flight bytes "
+             "are reserved against the MemManager budget (backpressure, "
+             "not OOM)."),
+
     # -- resource accounting & live metrics (runtime/monitor.py) --
-    # Byte accounting at every copy boundary (serde framing, FFI
-    # host<->device, shuffle partition split, spill write/read,
-    # row-interpreter fallback export) with per-query/stage attribution
-    # via the trace context, rolled into the run ledger and
-    # explain_analyze. Off, every boundary call site is one truthiness
-    # check and all counters read 0. The always-on leak telemetry
-    # (resource_leak events) is independent of this flag.
-    monitor_enabled: bool = True
-    # Prometheus text-format scrape endpoint (stdlib http.server daemon
-    # thread) serving GET /metrics; 0 (default) disables. The local
-    # runner starts it lazily on the first query (monitor.ensure_started
-    # also spins up the background sampler).
-    metrics_port: int = 0
-    # background ResourceMonitor sampling period: MemManager usage incl.
-    # pipeline_reserved, spill pages, pool occupancy, pipeline queue
-    # depths, and compile-cache stats into a bounded time-series ring.
-    # <= 0 disables the sampler thread.
-    monitor_sample_ms: int = 200
-    # bounded sample-ring capacity (deque maxlen — oldest samples drop
-    # first; 2048 x 200ms ≈ the last ~7 minutes)
-    monitor_ring_samples: int = 2048
+    Knob("monitor_enabled", True,
+         doc="Byte accounting at every copy boundary with per-query/"
+             "stage attribution. Off, every boundary call site is one "
+             "truthiness check and all counters read 0; the always-on "
+             "leak telemetry is independent of this flag."),
+    Knob("metrics_port", 0,
+         doc="Prometheus text-format scrape endpoint (stdlib http.server "
+             "daemon thread) serving GET /metrics; 0 disables."),
+    Knob("monitor_sample_ms", 200,
+         doc="Background ResourceMonitor sampling period (MemManager "
+             "usage, spill pages, pool occupancy, queue depths, "
+             "compile-cache stats); <= 0 disables the sampler thread."),
+    Knob("monitor_ring_samples", 2048,
+         doc="Bounded sample-ring capacity (deque maxlen; 2048 x 200ms "
+             "is about the last ~7 minutes)."),
+
     # -- query history store (runtime/history.py) --
-    # Persistent per-run statistics keyed by plan fingerprint
-    # (plan/fingerprint.py): sharded JSONL under this directory, one
-    # record per query — stage wall times, copy traffic, per-operator
-    # row counts, dense-vs-fallback groupby cardinality. "" disables
-    # (every history call site is one truthiness check).
-    history_dir: str = os.environ.get("BLAZE_TPU_HISTORY_DIR", "")
-    # total run records retained across shards; also bounds the
-    # trace_export_dir rotation (ledger lines + trace_<qid>.json files
-    # kept) applied on driver start alongside the orphan sweep
-    history_retention_runs: int = 512
-    # records per JSONL shard before rotating to a new shard file
-    # (retention prunes whole oldest shards)
-    history_shard_runs: int = 128
-    # cross-run regression threshold: the latest run's per-stage wall
-    # time / copy traffic is flagged when it exceeds the fingerprint's
-    # historical median by more than this percentage (plus an absolute
-    # noise grace — see history.detect_regressions)
-    history_regression_pct: float = 25.0
-    # per-operator enable flags (tier b, spark.blaze.enable.<op>)
-    enable_ops: Dict[str, bool] = dataclasses.field(default_factory=dict)
+    Knob("history_dir", "", env="BLAZE_TPU_HISTORY_DIR",
+         doc="Persistent per-run statistics keyed by plan fingerprint: "
+             "sharded JSONL under this directory. '' disables (every "
+             "history call site is one truthiness check)."),
+    Knob("history_retention_runs", 512,
+         doc="Total run records retained across shards; also bounds the "
+             "trace_export_dir rotation applied on driver start."),
+    Knob("history_shard_runs", 128,
+         doc="Records per JSONL shard before rotating to a new shard "
+             "file (retention prunes whole oldest shards)."),
+    Knob("history_regression_pct", 25.0,
+         doc="Cross-run regression threshold: latest per-stage wall time "
+             "/ copy traffic flagged when it exceeds the fingerprint's "
+             "historical median by more than this percentage (plus an "
+             "absolute noise grace — history.detect_regressions)."),
+
+    # -- per-operator enable flags (tier b, spark.blaze.enable.<op>) --
+    Knob("enable_ops", default_factory=dict,
+         doc="Per-operator enable flags ({'filter': False} routes that "
+             "operator to the fallback path); read through "
+             "conf.op_enabled(op)."),
+)
+
+KNOBS: Dict[str, Knob] = {k.name: k for k in _DECLARATIONS}
+
+
+class BlazeConf:
+    """The process-wide knob singleton, built from ``KNOBS``.
+
+    Attribute surface is exactly the registry: reading/writing an
+    undeclared name is an AttributeError/blazelint finding, and
+    ``update()`` keeps the historical KeyError contract for the JVM
+    bridge's property plumbing."""
+
+    __slots__ = tuple(KNOBS)
+
+    def __init__(self) -> None:
+        for knob in KNOBS.values():
+            setattr(self, knob.name, knob.resolve())
 
     def op_enabled(self, op: str) -> bool:
         return self.enable_ops.get(op, True)
 
     def update(self, **kwargs: Any) -> "BlazeConf":
         for k, v in kwargs.items():
-            if not hasattr(self, k):
+            if k not in KNOBS:
                 raise KeyError(f"unknown conf key: {k}")
             setattr(self, k, v)
         return self
+
+
+def knob_catalog_md() -> str:
+    """Render the README 'Configuration knobs' table from the registry
+    (python -c "from blaze_tpu.config import knob_catalog_md; ..." — or
+    regenerate via tools/blazelint's docs helper)."""
+    lines = ["| knob | default | env | purpose |",
+             "|---|---|---|---|"]
+    for k in _DECLARATIONS:
+        default = "`{}`".format(
+            "{}" if k.default_factory is not None else repr(k.default))
+        env = f"`{k.env}`" if k.env else ""
+        doc = " ".join(k.doc.split())
+        lines.append(f"| `{k.name}` | {default} | {env} | {doc} |")
+    return "\n".join(lines)
 
 
 conf = BlazeConf()

@@ -14,15 +14,14 @@ are Spark's (strict nulls for most ops, Kleene AND/OR, null-prop selects).
 
 from __future__ import annotations
 
-from typing import Callable, Optional, Sequence
+from typing import Callable, Optional
 
 import jax.numpy as jnp
 import numpy as np
 
 from blaze_tpu.columnar.batch import Column, ColumnBatch, StringData
-from blaze_tpu.columnar.types import (
-    BOOLEAN, DataType, FLOAT64, INT32, INT64, STRING, TypeKind,
-)
+from blaze_tpu.columnar.types import (BOOLEAN, DataType, FLOAT64, INT64,
+    TypeKind)
 from blaze_tpu.exprs import ir
 from blaze_tpu.exprs import strings as S
 from blaze_tpu.exprs.cast import cast_column, check_overflow, _const_string, _and_valid

@@ -18,9 +18,7 @@ from typing import Callable, Dict, List
 import jax.numpy as jnp
 
 from blaze_tpu.columnar.batch import Column, ColumnBatch, StringData
-from blaze_tpu.columnar.types import (
-    BOOLEAN, DataType, FLOAT64, INT32, INT64, STRING, TypeKind,
-)
+from blaze_tpu.columnar.types import DataType, FLOAT64, INT32, INT64, STRING
 from blaze_tpu.exprs import ir
 from blaze_tpu.exprs import strings as S
 from blaze_tpu.exprs.cast import _and_valid, civil_from_days

@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from blaze_tpu.columnar.batch import Column, ColumnBatch, StringData, bucket_width
+from blaze_tpu.columnar.batch import Column, ColumnBatch, StringData
 from blaze_tpu.columnar.types import INT64, STRING
 
 # ---------------------------------------------------------------------------

@@ -27,9 +27,7 @@ import numpy as np
 
 from blaze_tpu.columnar import int128 as i128
 from blaze_tpu.columnar.batch import Column, StructData
-from blaze_tpu.columnar.types import (
-    BOOLEAN, FLOAT64, INT64, DataType, TypeKind,
-)
+from blaze_tpu.columnar.types import FLOAT64, INT64, DataType, TypeKind
 from blaze_tpu.exprs import ir
 
 Array = jax.Array

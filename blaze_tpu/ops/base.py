@@ -12,7 +12,7 @@ chain is a single XLA executable instead of three interpreted operators.
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Iterator, List, Optional, Tuple
+from typing import Callable, Iterator, List, Optional
 
 from blaze_tpu.columnar.batch import ColumnBatch
 from blaze_tpu.columnar.types import Schema

@@ -11,11 +11,11 @@ CachedExprsEvaluator instead, cached_exprs_evaluator.rs:38-60).
 from __future__ import annotations
 
 import logging
-from typing import Callable, List, Optional, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence
 
 import jax.numpy as jnp
 
-from blaze_tpu.columnar.batch import Column, ColumnBatch, bucket_capacity
+from blaze_tpu.columnar.batch import ColumnBatch, bucket_capacity
 from blaze_tpu.columnar.types import Field, Schema
 from blaze_tpu.config import conf
 from blaze_tpu.exprs import ir

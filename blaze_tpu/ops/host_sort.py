@@ -44,7 +44,6 @@ from typing import Iterator, List, Optional, Sequence
 
 import numpy as np
 
-from blaze_tpu.columnar import serde
 from blaze_tpu.columnar.serde import HostBatch, _HostCol
 from blaze_tpu.columnar.types import Schema, TypeKind
 from blaze_tpu.ops.sort_keys import DEFAULT_MAX_STRING_WORDS, SortSpec

@@ -32,15 +32,13 @@ from __future__ import annotations
 
 import dataclasses
 import enum
-from typing import Callable, List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from blaze_tpu.columnar import types as T
-from blaze_tpu.columnar.batch import (
-    Column, ColumnBatch, StringData, bucket_capacity,
-)
+from blaze_tpu.columnar.batch import Column, ColumnBatch, bucket_capacity
 from blaze_tpu.columnar.types import Field, Schema
 from blaze_tpu.config import conf
 from blaze_tpu.exprs import ir
@@ -48,7 +46,7 @@ from blaze_tpu.exprs.compiler import compile_expr
 from blaze_tpu.ops import segment as seg
 from blaze_tpu.ops.base import BatchStream, ExecContext, Operator, count_stream
 from blaze_tpu.ops.common import concat_batches
-from blaze_tpu.ops.sort_keys import SortSpec, encode_column, sort_batch
+from blaze_tpu.ops.sort_keys import encode_column
 from blaze_tpu.runtime import compile_service, jit_cache
 
 Array = jax.Array

@@ -24,11 +24,10 @@ import pyarrow as pa
 import pyarrow.parquet as pq
 
 from blaze_tpu.columnar import types as T
-from blaze_tpu.columnar.arrow_io import (
-    batch_from_arrow, batch_to_arrow, dtype_to_arrow, schema_to_arrow,
-)
-from blaze_tpu.columnar.batch import Column, ColumnBatch, bucket_capacity
-from blaze_tpu.columnar.types import Field, Schema, TypeKind
+from blaze_tpu.columnar.arrow_io import (batch_from_arrow, batch_to_arrow,
+    schema_to_arrow)
+from blaze_tpu.columnar.batch import ColumnBatch
+from blaze_tpu.columnar.types import Field, Schema
 from blaze_tpu.config import conf
 from blaze_tpu.exprs import ir
 from blaze_tpu.ops.base import BatchStream, ExecContext, Operator, count_stream
@@ -186,7 +185,6 @@ class ParquetScanExec(Operator):
 
     def _to_device(self, rb: pa.RecordBatch, part_values: list
                    ) -> ColumnBatch:
-        import jax.numpy as jnp
 
         read_schema = Schema([self.file_schema.fields[i]
                               for i in self.projection])

@@ -21,7 +21,7 @@ from __future__ import annotations
 import dataclasses
 import inspect
 import os
-from typing import BinaryIO, Callable, Iterable, Iterator, List, Optional, Sequence
+from typing import Callable, Iterator, List
 
 import jax
 import jax.numpy as jnp

@@ -29,10 +29,10 @@ from blaze_tpu.columnar.types import DataType, Field, Schema
 from blaze_tpu.exprs import ir
 from blaze_tpu.exprs.compiler import compile_expr
 from blaze_tpu.ops import segment as seg
-from blaze_tpu.ops.agg import AggCall, _sum_state_dtype
+from blaze_tpu.ops.agg import _sum_state_dtype
 from blaze_tpu.ops.base import BatchStream, ExecContext, Operator, count_stream
 from blaze_tpu.ops.common import concat_batches
-from blaze_tpu.ops.sort_keys import SortSpec, sort_batch
+from blaze_tpu.ops.sort_keys import SortSpec
 from blaze_tpu.runtime import jit_cache
 
 Array = jax.Array

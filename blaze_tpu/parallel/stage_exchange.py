@@ -16,7 +16,7 @@ path and readers cannot tell them apart.
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
+from typing import List, Optional
 
 import jax
 import jax.numpy as jnp

@@ -6,7 +6,7 @@ and the expression/type/scalar deserialization of blaze-serde lib.rs:191-535.
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import List, Tuple
 
 from blaze_tpu.columnar import types as T
 from blaze_tpu.exprs import ir

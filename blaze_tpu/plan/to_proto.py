@@ -8,7 +8,6 @@ the engine IR, which the JVM shim (or tests) produce.
 
 from __future__ import annotations
 
-from typing import Optional
 
 from blaze_tpu.columnar import types as T
 from blaze_tpu.exprs import ir

@@ -48,7 +48,7 @@ import json
 import os
 import threading
 import time
-from typing import Any, Dict, Iterable, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from blaze_tpu.config import conf
 from blaze_tpu.runtime import jit_cache, trace

@@ -10,7 +10,7 @@ the chain's root source.
 
 from __future__ import annotations
 
-from typing import Callable, Iterator, List, Optional
+from typing import Callable, Optional
 
 from blaze_tpu.columnar.batch import ColumnBatch
 from blaze_tpu.ops.base import BatchStream, ExecContext, MapLikeOp, Operator, count_stream

@@ -380,7 +380,8 @@ def backoff_ms(attempt: int) -> float:
     The jitter rng is seeded from the fault spec's seed when one is
     installed, so chaos replays sleep identically."""
     base = max(float(conf.retry_backoff_ms), 0.0)
-    rng = _rngs.get("__jitter__", _default_jitter)
+    with _sched_lock:
+        rng = _rngs.get("__jitter__", _default_jitter)
     return base * (2.0 ** attempt) * (0.75 + 0.5 * rng.random())
 
 

@@ -222,10 +222,13 @@ def begin_query(qid: str) -> None:
 
 
 def _current_acc() -> Optional[_QueryAcc]:
-    qid = trace.current_context().get("query_id") or _active_qid
-    if qid is None:
-        return None
-    return _accs.get(qid)
+    qid = trace.current_context().get("query_id")
+    with _acc_lock:
+        if qid is None:
+            qid = _active_qid
+        if qid is None:
+            return None
+        return _accs.get(qid)
 
 
 def op_fingerprint(op) -> str:

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Callable, Dict, Hashable, Tuple
+from typing import Callable, Dict, Hashable
 
 import jax
 
@@ -131,7 +131,8 @@ _retry: Dict[Hashable, list] = {}
 
 
 def stats() -> Dict[str, int]:
-    return dict(_stats)
+    with _lock:
+        return dict(_stats)
 
 
 def clear() -> None:

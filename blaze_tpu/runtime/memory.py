@@ -112,8 +112,10 @@ class MemManager:
 
     # -- accounting --
     def mem_used(self) -> int:
-        return sum(c.mem_used() for c in self._consumers_snapshot()) \
-            + self.spill_pages_pending() + self.pipeline_reserved
+        consumed = sum(c.mem_used() for c in self._consumers_snapshot())
+        with self._lock:
+            reserved = self.pipeline_reserved
+        return consumed + self.spill_pages_pending() + reserved
 
     def observe_peak(self) -> int:
         """mem_used() with high-water-mark tracking. NOT called from

@@ -62,7 +62,8 @@ class MetricsSet:
             self.values.clear()
 
     def __getitem__(self, name: str) -> int:
-        return self.values.get(name, 0)
+        with self._lock:
+            return self.values.get(name, 0)
 
 
 class _Timer:
@@ -198,8 +199,9 @@ class Histogram:
 
     def summary(self) -> str:
         """One-line 'n= p50= p95= p99= max=' rendering ('' when empty)."""
-        if not self.count:
+        snap = self.snapshot()
+        if not snap["count"]:
             return ""
-        return (f"{self.name}: n={self.count} p50={self.percentile(50)} "
+        return (f"{self.name}: n={snap['count']} p50={self.percentile(50)} "
                 f"p95={self.percentile(95)} p99={self.percentile(99)} "
-                f"max={self.vmax}")
+                f"max={snap['max']}")

@@ -357,6 +357,41 @@ class ResourceMonitor:
 
 # -- Prometheus exporter -----------------------------------------------------
 
+# The scrape contract: every fixed sample family prometheus_text() emits,
+# declared up front. Dashboards/alerts key on these names — renaming one is
+# a breaking change, so tools/blazelint's registry-sync checker verifies
+# each emit() literal appears here AND that each entry is still emitted
+# (a stale registry row means a dashboard series silently went dark).
+# Dynamic telemetry families (per-counter gauges minted from MetricsSet
+# keys, histogram summaries) are constrained to GAUGE_PREFIXES instead.
+GAUGE_NAMES = (
+    "blaze_bytes_copied_total",
+    "blaze_bytes_moved_total",
+    "blaze_resource_leaks_total",
+    "blaze_mem_used_bytes",
+    "blaze_mem_budget_bytes",
+    "blaze_mem_peak_bytes",
+    "blaze_mem_pipeline_reserved_bytes",
+    "blaze_spill_pages_bytes",
+    "blaze_spilled_bytes_total",
+    "blaze_spill_count_total",
+    "blaze_trace_dropped_events_total",
+    "blaze_trace_buffer_events",
+    "blaze_trace_buffer_capacity",
+    "blaze_monitor_ring_samples",
+    "blaze_monitor_ring_capacity",
+    "blaze_pipeline_live_streams",
+    "blaze_pipeline_queue_depth",
+    "blaze_supervisor_active_tasks",
+    "blaze_queries_running",
+)
+GAUGE_PREFIXES = (
+    "blaze_pipeline_",  # pipeline.TELEMETRY counters
+    "blaze_faults_",    # faults.TELEMETRY counters
+    "blaze_compile_",   # compile_service.TELEMETRY counters
+    "blaze_hist_",      # trace histogram summaries
+)
+
 
 def _prom_name(raw: str) -> str:
     """Sanitize to the metric-name grammar [a-zA-Z_:][a-zA-Z0-9_:]*."""
@@ -435,7 +470,8 @@ def prometheus_text() -> str:
     emit("blaze_trace_buffer_capacity", "gauge",
          "Trace ring capacity (conf.trace_buffer_events)",
          [({}, int(conf.trace_buffer_events))])
-    ring = _sampler.ring() if _sampler is not None else []
+    s = sampler()
+    ring = s.ring() if s is not None else []
     emit("blaze_monitor_ring_samples", "gauge",
          "Samples held in the resource-monitor ring",
          [({}, len(ring))])
@@ -546,7 +582,8 @@ def ensure_started() -> Optional[MetricsServer]:
 
 
 def sampler() -> Optional[ResourceMonitor]:
-    return _sampler
+    with _global_lock:
+        return _sampler
 
 
 def shutdown() -> None:

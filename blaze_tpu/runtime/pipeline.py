@@ -386,14 +386,16 @@ class PrefetchStream:
                 self._maybe_pump_locked()
             else:
                 item, nbytes = None, -1
+                # queue empty and producer done: items first, then the
+                # error — exactly where the serial stream would have
+                # raised. Capture+clear under the lock (raise once;
+                # re-next() after the error ends clean).
+                err = self._error
+                self._error = None
         if nbytes >= 0:
             if self._manager is not None and nbytes:
                 self._manager.release_pipeline(nbytes)
             return item
-        # queue empty and producer done: items first, then the error —
-        # exactly where the serial stream would have raised
-        err = self._error
-        self._error = None  # raise once; re-next() after error ends clean
         self.close()
         if err is not None:
             raise err
@@ -430,14 +432,17 @@ class PrefetchStream:
     def stats(self) -> dict:
         """Occupancy snapshot. overlap_pct is the share of producer work
         hidden from the consumer: 100 means the consumer never waited."""
-        busy = self._producer_busy_ns
-        wait = self._consumer_wait_ns
+        with self._lock:
+            busy = self._producer_busy_ns
+            wait = self._consumer_wait_ns
+            items = self._items
+            max_depth = self._max_depth
         overlap = (100.0 * max(0.0, 1.0 - wait / busy)) if busy else 0.0
         wall = max(time.monotonic_ns() - self._t_start, 1)
         return {
             "pipeline": self._name,
-            "items": self._items,
-            "max_depth": self._max_depth,
+            "items": items,
+            "max_depth": max_depth,
             "producer_busy_ms": round(busy / 1e6, 3),
             "consumer_wait_ms": round(wait / 1e6, 3),
             "producer_occupancy_pct": round(100.0 * busy / wall, 1),
@@ -445,14 +450,16 @@ class PrefetchStream:
         }
 
     def _emit_stats(self) -> None:
-        if not conf.trace_enabled or not self._items:
+        if not conf.trace_enabled:
             return
         s = self.stats()
+        if not s["items"]:
+            return
         trace.record_value("pipeline_overlap_pct", int(s["overlap_pct"]))
         trace.record_value("pipeline_producer_busy_us",
-                           int(self._producer_busy_ns // 1000))
+                           int(s["producer_busy_ms"] * 1000))
         trace.record_value("pipeline_consumer_wait_us",
-                           int(self._consumer_wait_ns // 1000))
+                           int(s["consumer_wait_ms"] * 1000))
         with trace.context(**self._snap.trace_ctx):
             trace.event("pipeline_stats", **s)
 
@@ -568,10 +575,11 @@ class Sink:
                 if not self._working:
                     self._working = True
                     io_pool().submit(self._work)
+            qlen = len(self._q)
         if failed:
             self._raise_pending()
         if conf.trace_enabled:
-            trace.record_value("pipeline_queue_depth", len(self._q))
+            trace.record_value("pipeline_queue_depth", qlen)
 
     def _work(self) -> None:
         from blaze_tpu.runtime import faults
@@ -601,7 +609,8 @@ class Sink:
                 self._cond.notify_all()
 
     def _raise_pending(self):
-        err = self._error
+        with self._lock:
+            err = self._error
         self.abort()
         raise err
 
@@ -632,7 +641,7 @@ class Sink:
                 self._cond.wait(_POLL_S)
                 if self._ctx is not None:
                     self._ctx.check_running()
-        err = self._error
+            err = self._error
         self._quiesce()
         if err is not None:
             raise err

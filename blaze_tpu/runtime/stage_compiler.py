@@ -24,7 +24,7 @@ free); this is TPU-first design for the remote-accelerator reality.
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -34,7 +34,6 @@ from blaze_tpu.columnar import types as T
 from blaze_tpu.columnar.batch import Column, ColumnBatch, bucket_capacity
 from blaze_tpu.columnar.types import TypeKind
 from blaze_tpu.config import conf
-from blaze_tpu.exprs import ir
 from blaze_tpu.ops import mxu_agg
 from blaze_tpu.ops.agg import (
     AggExec, AggMode, result_field, state_fields,

@@ -151,6 +151,53 @@ class TraceLog:
 
 TRACE = TraceLog()
 
+# -- declared record-kind registries -----------------------------------------
+# Every event/span kind emitted anywhere in the engine, declared up front:
+# exporters and trend tooling key on these strings, so an ad-hoc kind is a
+# silent contract break. tools/blazelint's registry-sync checker verifies
+# every `trace.event(...)`/`trace.span(...)` literal (and the static prefix
+# of dynamic names like f"compile_{event}") resolves here, and flags
+# registered-but-never-emitted kinds as stale. Add the kind HERE in the
+# same change that introduces the call site.
+
+EVENT_KINDS = (
+    "artifact_commit",      # runtime/artifacts.py: first-commit-wins publish
+    "batch",                # ops/base.count_stream batch boundary
+    "breaker_trip",         # supervisor: per-operator circuit breaker
+    "compile_compiled",     # compile_service: fresh XLA compilation
+    "compile_hit",          # compile_service: persistent-cache hit
+    "compile_miss",         # compile_service: persistent-cache miss
+    "deadline_exceeded",    # executor: task/query budget exhausted
+    "deadline_kill",        # supervisor: budget exhausted mid-attempt
+    "degrade",              # executor: resilience-ladder rung taken
+    "fault_injected",       # faults.inject: armed point fired
+    "hang_detected",        # supervisor watchdog: heartbeat stale
+    "hang_relaunch",        # supervisor: killed attempt relaunched
+    "ladder_rung",          # executor: degradation ladder transition
+    "mem_release",          # memory: reservation released by sweep
+    "orphan_sweep",         # artifacts: stale attempt files removed
+    "pipeline_stats",       # pipeline: per-stream close statistics
+    "queue_depth",          # pipeline: sampler queue-depth reading
+    "resource_leak",        # monitor: leaked reservation/stream detected
+    "retry",                # executor: retryable failure retried
+    "speculation_launch",   # supervisor: straggler twin launched
+    "speculation_loss",     # supervisor: attempt lost the commit race
+    "speculation_win",      # supervisor: speculative twin won
+    "spill",                # memory: spill file written
+    "spill_pages_flush",    # memory: spill page pool flushed
+    "task_abandoned",       # supervisor: attempt abandoned post-kill
+    "task_error",           # supervisor: classified attempt failure
+    "whole_stage_attempt",  # stage_compiler: fused single-dispatch try
+    "whole_stage_fallback", # stage_compiler: fused path bailed out
+    "whole_stage_groups",   # stage_compiler: dense-agg group stats
+)
+
+SPAN_KINDS = (
+    "query",         # local_runner: one per query
+    "stage",         # executor: shuffle-map/broadcast/result stage
+    "task_attempt",  # supervisor: one per (task, attempt)
+)
+
 # -- named histogram registry ------------------------------------------------
 
 _hist_lock = threading.Lock()

@@ -1,4 +1,11 @@
-"""Tracing / profiling hooks (SURVEY §5.4).
+"""LEGACY low-level profiler hooks (SURVEY §5.4) — NOT the engine tracer.
+
+Role split (also recorded on the `profiler_dir` knob in config.py): this
+module owns the *device-side* XLA profiler capture and the textual
+metric-tree report; the *engine-side* structured span/event log, its
+exporters and EXPLAIN ANALYZE live in runtime/trace.py. New
+instrumentation belongs in trace.py; this module only changes when the
+JAX profiler integration does.
 
 The reference's profiling story is per-operator timing metrics surfaced in
 the Spark UI plus DebugExecNode batch logging (debug_exec.rs); it has no

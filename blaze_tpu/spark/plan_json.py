@@ -21,7 +21,7 @@ across self-joins cannot alias.
 from __future__ import annotations
 
 import json
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import List, Optional, Tuple
 
 from blaze_tpu.columnar import types as T
 from blaze_tpu.exprs import ir

@@ -18,7 +18,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-from blaze_tpu.columnar.types import Field, Schema
+from blaze_tpu.columnar.types import Schema
 from blaze_tpu.exprs import ir
 
 

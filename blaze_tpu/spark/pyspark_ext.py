@@ -19,12 +19,10 @@ the module is a no-op without it. Usage with a live Spark session:
 
 from __future__ import annotations
 
-from typing import Optional
 
 
 def pyspark_available() -> bool:
     try:
-        import pyspark  # noqa: F401
 
         return True
     except ImportError:

@@ -18,9 +18,8 @@ would make — and a deployment embeds it by delegating those four calls.
 from __future__ import annotations
 
 import os
-import shutil
-from dataclasses import dataclass, field
-from typing import Dict, Iterator, List, Optional
+from dataclasses import dataclass
+from typing import Dict, Iterator, List
 
 import numpy as np
 

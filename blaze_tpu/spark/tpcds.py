@@ -24,7 +24,6 @@ from typing import Callable, Dict
 
 import numpy as np
 import pandas as pd
-import pyarrow as pa
 import pyarrow.parquet as pq
 
 from blaze_tpu.columnar import types as T

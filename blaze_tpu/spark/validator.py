@@ -16,7 +16,7 @@ from __future__ import annotations
 import dataclasses
 import time
 import traceback
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional
 
 import numpy as np
 import pandas as pd

@@ -1,0 +1,604 @@
+"""blazelint checker suite tests (tools/blazelint).
+
+Each checker gets fixture snippets both ways: a seeded violation must
+produce its finding, the corrected shape must not. The CLI tests prove
+the `make check-lint` contract — exit 1 on a seeded violation of every
+checker, exit 0 on the committed tree modulo LINT_BASELINE.json — and
+the baseline/pragma tests cover the two suppression channels.
+
+blazelint never imports blaze_tpu (the package __init__ pulls in jax),
+so neither do these tests; everything runs on synthetic trees under
+tmp_path except the meta-test over the real repo.
+"""
+
+import json
+import shutil
+import textwrap
+from pathlib import Path
+
+from tools.blazelint import default_checkers, run_checkers
+from tools.blazelint.__main__ import main as blazelint_main
+from tools.blazelint.hot_path_gating import HotPathGating
+from tools.blazelint.knob_registry import KnobRegistry
+from tools.blazelint.lock_discipline import LockDiscipline
+from tools.blazelint.pyflakes_lite import PyflakesLite
+from tools.blazelint.registry_sync import RegistrySync
+from tools.blazelint.resource_pairing import ResourcePairing
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+def lint(tmp_path, files, checkers, baseline=None):
+    """Write {rel: source} under tmp_path and run the checkers."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return run_checkers(tmp_path, sorted({r.split("/")[0] for r in files}),
+                        checkers, baseline)
+
+
+def rules(result):
+    return sorted(f.rule for f in result.findings)
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline
+# ---------------------------------------------------------------------------
+
+
+LOCKED_CLASS_BAD = """\
+    import threading
+
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+
+        def add(self):
+            with self._lock:
+                self._n += 1
+
+        def peek(self):
+            return self._n
+
+        def reset(self):
+            self._n = 0
+"""
+
+LOCKED_CLASS_GOOD = """\
+    import threading
+
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+
+        def add(self):
+            with self._lock:
+                self._n += 1
+
+        def peek(self):
+            with self._lock:
+                return self._n
+"""
+
+
+def test_lock_discipline_flags_unguarded_access(tmp_path):
+    r = lint(tmp_path, {"pkg/c.py": LOCKED_CLASS_BAD}, [LockDiscipline()])
+    assert rules(r) == ["unguarded-read", "unguarded-write"]
+    read = next(f for f in r.findings if f.rule == "unguarded-read")
+    assert read.severity == "warning"
+    assert read.id == "lock-discipline:unguarded-read:pkg/c.py:Counter.peek._n.r"
+    write = next(f for f in r.findings if f.rule == "unguarded-write")
+    assert write.severity == "error"
+    assert "reset" in write.symbol
+
+
+def test_lock_discipline_clean_class(tmp_path):
+    r = lint(tmp_path, {"pkg/c.py": LOCKED_CLASS_GOOD}, [LockDiscipline()])
+    assert r.findings == []
+
+
+def test_lock_discipline_module_globals(tmp_path):
+    src = """\
+        import threading
+
+        _lock = threading.Lock()
+        _state = {}
+
+
+        def put(k, v):
+            with _lock:
+                _state[k] = v
+
+
+        def get(k):
+            return _state.get(k)
+    """
+    r = lint(tmp_path, {"pkg/m.py": src}, [LockDiscipline()])
+    assert rules(r) == ["unguarded-read"]
+    assert r.findings[0].symbol == "<module>.get._state.r"
+
+
+def test_lock_discipline_order_cycle(tmp_path):
+    src = """\
+        import threading
+
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+
+        def f():
+            with _a:
+                with _b:
+                    pass
+
+
+        def g():
+            with _b:
+                with _a:
+                    pass
+    """
+    r = lint(tmp_path, {"pkg/cyc.py": src}, [LockDiscipline()])
+    assert rules(r) == ["lock-order-cycle"]
+    assert "_a" in r.findings[0].message and "_b" in r.findings[0].message
+
+
+def test_lock_discipline_consistent_order_is_clean(tmp_path):
+    src = """\
+        import threading
+
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+
+        def f():
+            with _a:
+                with _b:
+                    pass
+
+
+        def g():
+            with _a:
+                with _b:
+                    pass
+    """
+    r = lint(tmp_path, {"pkg/ok.py": src}, [LockDiscipline()])
+    assert r.findings == []
+
+
+def test_lock_discipline_inline_pragma_suppresses(tmp_path):
+    src = LOCKED_CLASS_BAD.replace(
+        "return self._n",
+        "return self._n  # blazelint: ignore[unguarded-read]")
+    r = lint(tmp_path, {"pkg/c.py": src}, [LockDiscipline()])
+    assert rules(r) == ["unguarded-write"]
+
+
+# ---------------------------------------------------------------------------
+# knob-registry
+# ---------------------------------------------------------------------------
+
+
+def knob_checker(**kw):
+    defaults = dict(knobs={"alpha": None, "beta": None},
+                    methods={"update", "op_enabled"},
+                    readme_text="alpha and beta are documented")
+    defaults.update(kw)
+    return KnobRegistry(**defaults)
+
+
+def test_knob_registry_undeclared_access(tmp_path):
+    src = """\
+        from blaze_tpu.config import conf
+
+        x = conf.alpha
+        y = conf.gamma
+        z = conf.beta
+        conf.update(delta=3)
+    """
+    r = lint(tmp_path, {"pkg/u.py": src}, [knob_checker()])
+    assert rules(r) == ["undeclared-knob", "undeclared-knob"]
+    assert {f.symbol for f in r.findings} == {"gamma", "delta"}
+
+
+def test_knob_registry_dead_and_undocumented(tmp_path):
+    src = "from blaze_tpu.config import conf\nx = conf.alpha\n"
+    chk = knob_checker(readme_text="only alpha appears here")
+    r = lint(tmp_path, {"pkg/u.py": src}, [chk])
+    assert rules(r) == ["dead-knob", "undocumented-knob"]
+    assert all(f.symbol == "beta" for f in r.findings)
+
+
+def test_knob_registry_clean(tmp_path):
+    src = """\
+        from blaze_tpu.config import conf
+
+        x = conf.alpha
+        y = conf.beta
+        ok = conf.op_enabled("filter")
+        conf.update(alpha=2)
+    """
+    r = lint(tmp_path, {"pkg/u.py": src}, [knob_checker()])
+    assert r.findings == []
+
+
+def test_knob_registry_loads_real_registry():
+    """The real config.py registry loads standalone (no jax import)."""
+    chk = KnobRegistry(root=REPO_ROOT)
+    assert "batch_size" in chk.knobs
+    assert "op_enabled" in chk.methods
+
+
+# ---------------------------------------------------------------------------
+# resource-pairing
+# ---------------------------------------------------------------------------
+
+
+def test_resource_pairing_unreleased_reserve(tmp_path):
+    src = """\
+        def f(mgr, n):
+            mgr.reserve(n)
+            return work(n)
+    """
+    r = lint(tmp_path, {"pkg/r.py": src}, [ResourcePairing()])
+    assert rules(r) == ["unreleased-acquire"]
+    assert r.findings[0].symbol == "f.reserve"
+
+
+def test_resource_pairing_try_finally_is_clean(tmp_path):
+    src = """\
+        def f(mgr, n):
+            mgr.reserve(n)
+            try:
+                return work(n)
+            finally:
+                mgr.release(n)
+
+
+        def g(gate):
+            with gate.claim():
+                return work(0)
+    """
+    r = lint(tmp_path, {"pkg/r.py": src}, [ResourcePairing()])
+    assert r.findings == []
+
+
+def test_resource_pairing_class_teardown_is_clean(tmp_path):
+    src = """\
+        class Stream:
+            def start(self, n):
+                self._mgr.reserve_pipeline(n)
+
+            def close(self):
+                self._mgr.release_pipeline(self._n)
+    """
+    r = lint(tmp_path, {"pkg/r.py": src}, [ResourcePairing()])
+    assert r.findings == []
+
+
+def test_resource_pairing_unclosed_local_open(tmp_path):
+    src = """\
+        def f(path):
+            fh = open(path)
+            return fh.read()
+    """
+    r = lint(tmp_path, {"pkg/r.py": src}, [ResourcePairing()])
+    assert rules(r) == ["unclosed-local"]
+    assert r.findings[0].symbol == "f.fh"
+
+
+def test_resource_pairing_with_open_is_clean(tmp_path):
+    src = """\
+        def f(path):
+            with open(path) as fh:
+                return fh.read()
+
+
+        def g(path):
+            fh = open(path)
+            try:
+                return fh.read()
+            finally:
+                fh.close()
+
+
+        def h(path):
+            fh = open(path)
+            return fh  # ownership escapes to the caller
+    """
+    r = lint(tmp_path, {"pkg/r.py": src}, [ResourcePairing()])
+    assert r.findings == []
+
+
+def test_resource_pairing_bare_enter(tmp_path):
+    src = """\
+        def f(span):
+            s = span.__enter__()
+            return s
+    """
+    r = lint(tmp_path, {"pkg/r.py": src}, [ResourcePairing()])
+    assert rules(r) == ["bare-enter"]
+
+
+# ---------------------------------------------------------------------------
+# hot-path-gating
+# ---------------------------------------------------------------------------
+
+
+def hot_checker():
+    return HotPathGating(hot_predicate=lambda rel: True)
+
+
+def test_hot_path_ungated_record(tmp_path):
+    src = """\
+        from blaze_tpu.runtime import trace
+
+
+        def step(batch):
+            trace.event("batch", rows=len(batch))
+            return batch
+    """
+    r = lint(tmp_path, {"pkg/h.py": src}, [hot_checker()])
+    assert rules(r) == ["ungated-record"]
+    assert "trace_enabled" in r.findings[0].message
+
+
+def test_hot_path_gated_call_is_clean(tmp_path):
+    src = """\
+        from blaze_tpu.config import conf
+        from blaze_tpu.runtime import trace, monitor
+
+
+        def step(batch):
+            if conf.trace_enabled:
+                trace.event("batch", rows=len(batch))
+            enabled = conf.monitor_enabled
+            if enabled:
+                monitor.count_copy(len(batch))
+            return batch
+
+
+        def early(batch):
+            if not conf.trace_enabled:
+                return batch
+            trace.event("batch", rows=len(batch))
+            return batch
+    """
+    r = lint(tmp_path, {"pkg/h.py": src}, [hot_checker()])
+    assert r.findings == []
+
+
+def test_hot_path_cold_files_exempt(tmp_path):
+    src = """\
+        from blaze_tpu.runtime import trace
+
+
+        def teardown():
+            trace.event("batch")
+    """
+    r = lint(tmp_path, {"pkg/h.py": src}, [HotPathGating()])
+    assert r.findings == []  # pkg/ is not a hot prefix
+
+
+# ---------------------------------------------------------------------------
+# registry-sync
+# ---------------------------------------------------------------------------
+
+
+def sync_checker():
+    return RegistrySync(known_points=["op", "io.prefetch"],
+                        event_kinds=["retry", "compile_hit"],
+                        span_kinds=["stage"],
+                        gauge_names=["blaze_x"],
+                        gauge_prefixes=["blaze_dyn_"])
+
+
+def test_registry_sync_unregistered_names(tmp_path):
+    src = """\
+        from blaze_tpu.runtime import faults, trace
+
+
+        def f(k):
+            faults.inject("bogus.point")
+            trace.event("unknown_kind")
+            with trace.span("nope"):
+                pass
+            trace.event(f"mystery_{k}")
+    """
+    r = lint(tmp_path, {"pkg/s.py": src}, [sync_checker()])
+    errors = sorted(f.rule for f in r.findings if f.severity == "error")
+    assert errors == ["unregistered-event", "unregistered-event",
+                      "unregistered-fault-point", "unregistered-span"]
+
+
+def test_registry_sync_prefix_rules_clean(tmp_path):
+    src = """\
+        from blaze_tpu.runtime import faults, trace
+
+
+        def f(kind):
+            faults.inject("op." + kind)     # prefix rule: "op" covers it
+            faults.inject("io.prefetch")
+            trace.event("retry", n=2)
+            trace.event(f"compile_{kind}")  # static prefix matches
+            with trace.span("stage"):
+                pass
+    """
+    r = lint(tmp_path, {"pkg/s.py": src}, [sync_checker()])
+    assert r.findings == []
+
+
+def test_registry_sync_missing_registry(tmp_path):
+    # non-injected checker extracts registries from the canonical module
+    # paths; a faults.py without KNOWN_POINTS is itself a finding
+    files = {"blaze_tpu/runtime/faults.py":
+             "def inject(point):\n    pass\n"}
+    r = lint(tmp_path, {**files}, [RegistrySync()])
+    assert "missing-registry" in rules(r)
+
+
+def test_registry_sync_stale_entry(tmp_path):
+    src = """\
+        from blaze_tpu.runtime import faults, trace
+
+
+        def f():
+            trace.event("retry")
+            faults.inject("op.Filter")
+            faults.inject("io.prefetch")
+    """
+    r = lint(tmp_path, {"pkg/s.py": src}, [sync_checker()])
+    stale = [f for f in r.findings if f.rule == "stale-registry"]
+    assert [f.symbol for f in stale] == ["event.compile_hit"]
+    assert all(f.severity == "warning" for f in stale)
+
+
+# ---------------------------------------------------------------------------
+# pyflakes pass
+# ---------------------------------------------------------------------------
+
+
+def test_pyflakes_unused_import_and_undefined_name(tmp_path):
+    src = """\
+        import os
+        import sys
+
+
+        def f():
+            return sys.platform + missing_helper()
+    """
+    r = lint(tmp_path, {"pkg/p.py": src}, [PyflakesLite()])
+    assert rules(r) == ["undefined-name", "unused-import"]
+    assert {f.symbol for f in r.findings} == {"os", "missing_helper"}
+
+
+def test_pyflakes_syntax_error(tmp_path):
+    r = lint(tmp_path, {"pkg/p.py": "def broken(:\n    pass\n"},
+             [PyflakesLite()])
+    assert rules(r) == ["syntax-error"]
+
+
+# ---------------------------------------------------------------------------
+# baseline workflow
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_suppresses_known_finding(tmp_path):
+    r = lint(tmp_path, {"pkg/c.py": LOCKED_CLASS_BAD}, [LockDiscipline()])
+    baseline = {f.id: "accepted for the test" for f in r.findings}
+    r2 = lint(tmp_path, {"pkg/c.py": LOCKED_CLASS_BAD}, [LockDiscipline()],
+              baseline=baseline)
+    assert r2.findings == []
+    assert len(r2.baselined) == 2
+    assert r2.stale_baseline == []
+
+
+def test_baseline_reports_stale_entries(tmp_path):
+    baseline = {"lock-discipline:unguarded-read:pkg/c.py:Gone.peek._n.r":
+                "the finding this covered was fixed"}
+    r = lint(tmp_path, {"pkg/c.py": LOCKED_CLASS_GOOD}, [LockDiscipline()],
+             baseline=baseline)
+    assert r.findings == []
+    assert r.stale_baseline == list(baseline)
+
+
+# ---------------------------------------------------------------------------
+# CLI / make check-lint contract
+# ---------------------------------------------------------------------------
+
+
+def mini_repo(tmp_path):
+    """A lint-clean miniature repo: the real knob registry + catalog and
+    one module that reads every declared knob."""
+    (tmp_path / "blaze_tpu").mkdir(parents=True)
+    shutil.copy(REPO_ROOT / "blaze_tpu/config.py",
+                tmp_path / "blaze_tpu/config.py")
+    shutil.copy(REPO_ROOT / "README.md", tmp_path / "README.md")
+    from tools.blazelint.core import load_config_module
+    cfg = load_config_module(tmp_path / "blaze_tpu/config.py")
+    reads = "\n".join(f"_{i} = conf.{name}"
+                      for i, name in enumerate(sorted(cfg.KNOBS)))
+    (tmp_path / "blaze_tpu/uses.py").write_text(
+        "from blaze_tpu.config import conf\n\n" + reads + "\n")
+    return tmp_path
+
+
+def cli(root, json_out):
+    return blazelint_main(["--root", str(root), "blaze_tpu",
+                           "--json-out", str(json_out)])
+
+
+def test_cli_clean_mini_repo_exits_zero(tmp_path):
+    root = mini_repo(tmp_path)
+    out = tmp_path / "lint.json"
+    assert cli(root, out) == 0
+    report = json.loads(out.read_text())
+    assert report["ok"] is True
+    assert set(report["per_checker"]) == {
+        "lock-discipline", "knob-registry", "resource-pairing",
+        "hot-path-gating", "registry-sync", "pyflakes"}
+
+
+SEEDS = {
+    "lock-discipline": ("blaze_tpu/seed.py", LOCKED_CLASS_BAD,
+                        "unguarded-write"),
+    "knob-registry": ("blaze_tpu/seed.py",
+                      "from blaze_tpu.config import conf\n"
+                      "x = conf.totally_bogus_knob\n",
+                      "undeclared-knob"),
+    "resource-pairing": ("blaze_tpu/seed.py",
+                         "def f(mgr, n):\n"
+                         "    mgr.reserve(n)\n"
+                         "    return n\n",
+                         "unreleased-acquire"),
+    "hot-path-gating": ("blaze_tpu/ops/seed.py",
+                        "from blaze_tpu.runtime import trace\n\n\n"
+                        "def f(batch):\n"
+                        "    trace.record_value('x', 1)\n"
+                        "    return batch\n",
+                        "ungated-record"),
+    "registry-sync": ("blaze_tpu/seed.py",
+                      "from blaze_tpu.runtime import faults\n\n\n"
+                      "def f():\n"
+                      "    faults.inject('bogus.unregistered.point')\n",
+                      "unregistered-fault-point"),
+    "pyflakes": ("blaze_tpu/seed.py", "x = undefined_everywhere\n",
+                 "undefined-name"),
+}
+
+
+def test_cli_seeded_violations_exit_nonzero(tmp_path):
+    for checker, (rel, src, rule) in SEEDS.items():
+        root = mini_repo(tmp_path / checker)
+        seed = root / rel
+        seed.parent.mkdir(parents=True, exist_ok=True)
+        seed.write_text(textwrap.dedent(src))
+        out = root / "lint.json"
+        assert cli(root, out) == 1, f"{checker} seed did not fail the gate"
+        report = json.loads(out.read_text())
+        seen = {(f["checker"], f["rule"]) for f in report["new_findings"]}
+        assert (checker, rule) in seen, (checker, sorted(seen))
+
+
+# ---------------------------------------------------------------------------
+# meta: the committed tree is clean modulo the committed baseline
+# ---------------------------------------------------------------------------
+
+
+def test_real_tree_clean_modulo_baseline():
+    from tools.blazelint.core import load_baseline
+    baseline = load_baseline(REPO_ROOT / "LINT_BASELINE.json")
+    result = run_checkers(REPO_ROOT, ["blaze_tpu"],
+                          default_checkers(REPO_ROOT), baseline)
+    assert result.findings == [], \
+        "new findings:\n" + "\n".join(f.render() for f in result.findings)
+    assert result.stale_baseline == []
+    # the baseline is small and every entry carries a real justification
+    data = json.loads((REPO_ROOT / "LINT_BASELINE.json").read_text())
+    for entry in data["entries"]:
+        assert entry["justification"]
+        assert not entry["justification"].startswith("TODO")

@@ -1,0 +1,36 @@
+"""blazelint — stdlib-ast invariant checkers for the concurrent runtime.
+
+Run from the repo root:
+
+    python -m tools.blazelint                    # lint blaze_tpu/
+    python -m tools.blazelint --update-baseline  # accept current findings
+    python -m tools.blazelint --json-out LINT_r12.json
+
+See README "Static analysis" for the checker catalog and the baseline
+workflow. The package never imports ``blaze_tpu`` (its ``__init__``
+pulls in jax); sources are parsed, and ``config.py`` is loaded
+standalone by file path.
+"""
+
+from tools.blazelint.core import (Checker, Finding, ModuleInfo,  # noqa: F401
+                                  RunResult, load_baseline, run_checkers,
+                                  save_baseline)
+
+
+def default_checkers(root):
+    """The five production checkers + the pyflakes-equivalent pass."""
+    from tools.blazelint.hot_path_gating import HotPathGating
+    from tools.blazelint.knob_registry import KnobRegistry
+    from tools.blazelint.lock_discipline import LockDiscipline
+    from tools.blazelint.pyflakes_lite import PyflakesLite
+    from tools.blazelint.registry_sync import RegistrySync
+    from tools.blazelint.resource_pairing import ResourcePairing
+
+    return [
+        LockDiscipline(),
+        KnobRegistry(root=root),
+        ResourcePairing(),
+        HotPathGating(),
+        RegistrySync(),
+        PyflakesLite(),
+    ]
