@@ -9,10 +9,10 @@ PYENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
 .PHONY: check check-fast check-faults check-supervisor check-trace \
 	check-pipeline check-pipeline-soak check-perf check-perf-update \
-	check-obs check-history check-lint test test-fast validate \
-	validate-fast warm
+	check-obs check-history check-lint check-service test test-fast \
+	validate validate-fast warm
 
-check: check-lint test validate check-perf check-history
+check: check-lint test validate check-perf check-history check-service
 	@echo "CHECK OK — safe to commit"
 
 # Static invariant gate (tools/blazelint): lock discipline, knob
@@ -116,6 +116,19 @@ check-obs:
 check-history:
 	$(PYENV) python tools/history_report.py --gate \
 	  --json-out HISTORY_r11.json
+
+# Multi-tenant service soak: 8 concurrent client sessions across 3
+# tenants through runtime/service.QueryService — a clean round, a
+# deterministic weighted-fairness probe, one round per representative
+# (fault point x kind) with {"concurrent": true} specs, and an
+# admission-stress round (1 slot, tiny queue). Every session must match
+# the pandas oracle, rounds must leak nothing (consumers, pipeline
+# streams, namespaced resources, orphans), breaker state must stay
+# per-query, and overload must shed with typed rejections. Emits
+# SERVICE_r13.json.
+check-service:
+	$(PYENV) python tools/chaos_soak.py --service \
+	  --json-out SERVICE_r13.json
 
 # Pre-warm the persistent compile caches (runtime/compile_service):
 # replays the shape manifest + the TPC-DS catalogue into the XLA cache.

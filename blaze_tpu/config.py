@@ -201,6 +201,25 @@ _DECLARATIONS: Tuple[Knob, ...] = (
              "that operator trips to the row-interpreter fallback. 0 "
              "disables."),
 
+    # -- multi-tenant query service (runtime/service.py) --
+    Knob("max_concurrent_queries", 4,
+         doc="QueryService admission control: queries running at once. "
+             "Arrivals beyond this park in the bounded admission queue "
+             "(wait counts against query_deadline_ms)."),
+    Knob("admission_queue_depth", 16,
+         doc="Bounded admission queue: parked queries waiting for a run "
+             "slot. A full queue load-sheds new arrivals with a typed "
+             "faults.AdmissionRejected (and a run-ledger line)."),
+    Knob("tenant_quota_spec", default_factory=dict,
+         doc="Per-tenant MemManager quota ({'tenant': bytes} or a 0-1 "
+             "float fraction of the budget; {} = no quotas). An "
+             "over-quota tenant spills/parks its OWN consumers; it "
+             "cannot evict another tenant's working set."),
+    Knob("tenant_priority_spec", default_factory=dict,
+         doc="Per-tenant scheduling weight ({'tenant': weight}, default "
+             "1.0): the service pool dispatches TaskSpecs deficit-"
+             "weighted round robin across live sessions, not FIFO."),
+
     # -- pipelined async execution (runtime/pipeline.py) --
     Knob("enable_pipeline", True,
          doc="Overlap host-side stages (parquet read+decode, serde, "

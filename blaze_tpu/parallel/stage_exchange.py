@@ -63,7 +63,8 @@ def mesh_key_indices(writer: pb.ShuffleWriterNode,
 def run_mesh_shuffle_stage(stage_plan: pb.PlanNode, stage_id: int,
                            ntasks: int, quota: Optional[int] = None,
                            work_dir: Optional[str] = None,
-                           stats: Optional[dict] = None) -> bool:
+                           stats: Optional[dict] = None,
+                           namespace: str = "") -> bool:
     """Execute one shuffle_map stage's exchange over the device mesh.
 
     STREAMS: each map-output batch is exchanged as it is produced — the
@@ -290,5 +291,5 @@ def run_mesh_shuffle_stage(stage_plan: pb.PlanNode, stage_id: int,
                 total += batch_nbytes(b) * int(b.num_rows) // cap
         total += sum(_os.path.getsize(d) for d, _ in file_outputs)
         stats["bytes"] = int(total)
-    resources.put(f"shuffle:{stage_id}", provider)
+    resources.put(f"{namespace}shuffle:{stage_id}", provider)
     return True

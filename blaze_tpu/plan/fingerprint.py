@@ -15,6 +15,10 @@ exactly those:
   file facts   PartitionedFile path/size/range/mtime and the shuffle
                writer's data_file/index_file are dropped — the scan
                *schema* and projection stay in
+  namespaces   `*resource_id` fields hash only their local part — the
+               per-query "qNNN-N/" prefix the multi-tenant service
+               prepends (spark/stages.py) varies every run; the local
+               "shuffle:0" / "broadcast:1" form is real plan shape
   everything   else — node kinds, expression operators, column names,
                function/agg enums, join types, partition counts — is
                hashed structurally, so any shape change re-keys
@@ -48,6 +52,11 @@ _MASKED_FIELDS = frozenset({
     "path", "size", "range_start",       # PartitionedFile / ParquetSink
     "range_end", "last_modified_ns",
 })
+
+# resource ids carry a per-query namespace under the multi-tenant
+# service ("q123-4/shuffle:0" — spark/stages.py); only the local part
+# is plan shape, the qid prefix varies every run
+_RESOURCE_ID_SUFFIX = "resource_id"
 
 _HEX_CHARS = 16  # 64 bits of sha256 — plenty for a per-project store
 
@@ -91,6 +100,8 @@ def _walk(msg, out: List[str]) -> None:
                 _walk(val, out)
         elif _is_repeated(fd):
             out.extend(str(v) for v in val)
+        elif fd.name.endswith(_RESOURCE_ID_SUFFIX):
+            out.append(str(val).rsplit("/", 1)[-1])
         else:
             out.append(str(val))
     out.append(")")

@@ -127,6 +127,20 @@ class DeadlineError(FatalError):
     backoff), so callers see "deadline", not a half-slept retry."""
 
 
+class AdmissionRejected(FatalError):
+    """Load shed at the QueryService front door: the admission queue was
+    full (or the query's deadline expired while parked). The query never
+    ran — no partial state to clean up, nothing to retry locally; callers
+    should back off and resubmit. Carries the tenant id and the wall time
+    the query spent parked so SLO accounting can bill the shed."""
+
+    def __init__(self, msg: str, *, tenant_id: str = "",
+                 wait_ms: float = 0.0) -> None:
+        super().__init__(msg)
+        self.tenant_id = tenant_id
+        self.wait_ms = wait_ms
+
+
 CATEGORY_CLASSES = {
     "retryable": RetryableError,
     "resource": ResourceExhaustedError,

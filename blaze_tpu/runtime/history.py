@@ -320,6 +320,7 @@ def record_run(qid: str, run_info: Optional[dict] = None,
     stage_fps = [s.get("fingerprint") or "" for s in stages]
     record: Dict[str, Any] = {
         "query_id": qid,
+        "tenant_id": (run_info or {}).get("tenant_id", ""),
         "ts": round(time.time(), 3),
         "plan_fingerprint": (fingerprint_query(stage_fps)
                              if stages else None),

@@ -77,16 +77,28 @@ class MemManager:
         # growth (update_mem_used) and by the monitor sampler; reset at
         # query start so per-query roll-ups report peak_mem_bytes
         self.peak_used = 0
+        # -- multi-tenant quota ledger (runtime/service.py) --
+        # conf.tenant_quota_spec carves per-tenant ceilings out of the
+        # budget; consumers and pipeline reservations are tagged with the
+        # registering thread's tenant (trace context). Empty quotas =
+        # single-tenant fast path: one dict-emptiness check per update.
+        self._quotas: dict = {}
+        self._tenant_of: dict = {}          # id(consumer) -> tenant id
+        self._tenant_pipeline: dict = {}    # tenant id -> reserved bytes
 
     # -- registry --
     def register(self, consumer: MemConsumer) -> None:
+        tid = trace.current_context().get("tenant_id", "")
         with self._lock:
             self._consumers.append(consumer)
+            if tid:
+                self._tenant_of[id(consumer)] = tid
 
     def unregister(self, consumer: MemConsumer) -> None:
         with self._lock:
             if consumer in self._consumers:
                 self._consumers.remove(consumer)
+            self._tenant_of.pop(id(consumer), None)
 
     def track_spill(self, sf: "SpillFile") -> None:
         with self._lock:
@@ -129,13 +141,23 @@ class MemManager:
         self.peak_used = 0
 
     def reserve_pipeline(self, nbytes: int) -> None:
-        """Charge an in-flight pipelined batch against the budget."""
+        """Charge an in-flight pipelined batch against the budget (and
+        the reserving thread's tenant ledger when quotas are active)."""
         with self._lock:
             self.pipeline_reserved += int(nbytes)
+            if self._quotas:
+                tid = trace.current_context().get("tenant_id", "")
+                if tid:
+                    self._tenant_pipeline[tid] = \
+                        self._tenant_pipeline.get(tid, 0) + int(nbytes)
 
     def release_pipeline(self, nbytes: int) -> None:
         with self._lock:
             self.pipeline_reserved -= int(nbytes)
+            if self._quotas:
+                tid = trace.current_context().get("tenant_id", "")
+                if tid and tid in self._tenant_pipeline:
+                    self._tenant_pipeline[tid] -= int(nbytes)
 
     def spill_pages_pending(self) -> int:
         """Bytes written to tracked spill files but not yet synced to
@@ -157,6 +179,43 @@ class MemManager:
             n = max(len(self._consumers), 1)
         return self.total // n
 
+    # -- tenant quotas --
+    def set_tenant_quotas(self, spec: Optional[dict]) -> None:
+        """Install per-tenant ceilings from conf.tenant_quota_spec: int
+        values are bytes, floats in (0, 1] are fractions of the budget.
+        None/{} clears quotas (single-tenant fast path)."""
+        quotas: dict = {}
+        for tid, v in (spec or {}).items():
+            if isinstance(v, float) and 0 < v <= 1:
+                quotas[tid] = int(self.total * v)
+            else:
+                quotas[tid] = int(v)
+        with self._lock:
+            self._quotas = quotas
+            self._tenant_pipeline = {}
+
+    def tenant_quota(self, tenant: str) -> Optional[int]:
+        with self._lock:
+            return self._quotas.get(tenant)
+
+    def _tenant_consumers(self, tenant: str) -> List[MemConsumer]:
+        with self._lock:
+            return [c for c in self._consumers
+                    if self._tenant_of.get(id(c), "") == tenant]
+
+    def tenant_used(self, tenant: str) -> int:
+        used = sum(c.mem_used() for c in self._tenant_consumers(tenant))
+        with self._lock:
+            return used + self._tenant_pipeline.get(tenant, 0)
+
+    def tenant_usage(self) -> dict:
+        """{tenant: bytes in use} over every tenant with tagged state or
+        a declared quota — the Prometheus per-tenant gauge source."""
+        with self._lock:
+            tids = set(self._quotas) | set(self._tenant_of.values()) \
+                | set(self._tenant_pipeline)
+        return {tid: self.tenant_used(tid) for tid in sorted(tids)}
+
     def update_mem_used(self, updater: MemConsumer) -> None:
         """Called by a consumer after growing; triggers spills if needed.
 
@@ -167,6 +226,35 @@ class MemManager:
         force spills, which its own fuzztests also rely on).
         """
         used = self.observe_peak()
+        with self._lock:
+            tenant = (self._tenant_of.get(id(updater), "")
+                      if self._quotas else "")
+            quota = self._quotas.get(tenant)
+        if quota:
+            # quota enforcement BEFORE the global check: an over-quota
+            # tenant sheds its OWN working set (grower first, then its
+            # largest same-tenant sibling) — it can never reach across
+            # and evict another tenant's state
+            t_over = self.tenant_used(tenant) - quota
+            if t_over > 0:
+                trace.event("tenant_over_quota", tenant_id=tenant,
+                            over_bytes=t_over, quota_bytes=quota)
+                freed = updater.spill()
+                self._note_spill(freed)
+                t_over -= freed
+                while t_over > 0:
+                    sibs = sorted(
+                        (c for c in self._tenant_consumers(tenant)
+                         if c is not updater and c.mem_used() > 0),
+                        key=lambda c: -c.mem_used())
+                    if not sibs:
+                        break
+                    freed = sibs[0].spill()
+                    self._note_spill(freed)
+                    if freed <= 0:
+                        break
+                    t_over -= freed
+                used = self.mem_used()
         if used <= self.total:
             return
         # cheapest reclaim first: sync buffered spill pages to disk —
@@ -182,9 +270,18 @@ class MemManager:
             self._note_spill(freed)
             over -= freed
         while over > 0:
+            # with quotas active the grower's spill pressure stays inside
+            # its own tenant while that tenant still has spillable state;
+            # cross-tenant eviction is the last resort before OOM
             others = sorted((c for c in self._consumers_snapshot()
                              if c is not updater and c.mem_used() > 0),
                             key=lambda c: -c.mem_used())
+            if tenant:
+                with self._lock:
+                    same = [c for c in others
+                            if self._tenant_of.get(id(c), "") == tenant]
+                if same:
+                    others = same
             if not others:
                 if updater.mem_used() > 0:
                     freed = updater.spill()
@@ -206,7 +303,8 @@ class MemManager:
             self.spilled_bytes += freed
             trace.event("spill", spill_bytes=freed)
 
-    def release(self, bytes_needed: int) -> int:
+    def release(self, bytes_needed: int,
+                tenant: Optional[str] = None) -> int:
         """Host-driven reclamation (ref OnHeapSpillManager.scala:61-144:
         Spark's memory manager can force executor spill state to disk
         under heap pressure; the C ABI exposes this as bn_spill so the
@@ -214,12 +312,18 @@ class MemManager:
         the largest consumers first until `bytes_needed` is freed; a
         consumer that yields nothing is skipped, not a stop condition
         (smaller spillable consumers behind it must still drain).
+        `tenant` scopes the sweep to one tenant's consumers — the
+        degradation ladder's force-spill rung passes the failing query's
+        tenant so its recovery can't evict other tenants' working sets.
         Returns bytes actually freed."""
         freed = 0
         with self.op_lock:
             with self._lock:
-                candidates = sorted(list(self._consumers),
-                                    key=lambda c: -c.mem_used())
+                candidates = sorted(
+                    (c for c in self._consumers
+                     if not tenant
+                     or self._tenant_of.get(id(c), "") == tenant),
+                    key=lambda c: -c.mem_used())
             for c in candidates:
                 if freed >= bytes_needed:
                     break

@@ -321,6 +321,12 @@ class ResourceMonitor:
             "breaker_trips": faults.TELEMETRY.snapshot().get(
                 "breaker.trips", 0),
         }
+        from blaze_tpu.runtime import service
+
+        st = service.stats()
+        s["admission_queue_depth"] = st["queue_depth"]
+        s["admission_parked"] = st["parked"]
+        s["admission_rejected"] = st["rejected"]
         self._ring.append(s)
         return s
 
@@ -384,6 +390,11 @@ GAUGE_NAMES = (
     "blaze_pipeline_queue_depth",
     "blaze_supervisor_active_tasks",
     "blaze_queries_running",
+    "blaze_admission_queue_depth",
+    "blaze_admission_admitted_total",
+    "blaze_admission_parked_total",
+    "blaze_admission_rejected_total",
+    "blaze_tenant_mem_used_bytes",
 )
 GAUGE_PREFIXES = (
     "blaze_pipeline_",  # pipeline.TELEMETRY counters
@@ -489,6 +500,28 @@ def prometheus_text() -> str:
          "Task attempts currently executing", [({}, supervisor.active_tasks())])
     emit("blaze_queries_running", "gauge", "Queries currently executing",
          [({}, len(running_queries()))])
+
+    # multi-tenant service (runtime/service.py): admission control +
+    # per-tenant memory attribution. All-zero with no service running.
+    from blaze_tpu.runtime import service
+
+    st = service.stats()
+    emit("blaze_admission_queue_depth", "gauge",
+         "Queries parked in the service admission queue",
+         [({}, st["queue_depth"])])
+    emit("blaze_admission_admitted_total", "counter",
+         "Queries granted a run slot by admission control",
+         [({}, st["admitted"])])
+    emit("blaze_admission_parked_total", "counter",
+         "Queries that waited in the admission queue before running",
+         [({}, st["parked"])])
+    emit("blaze_admission_rejected_total", "counter",
+         "Queries load-shed at admission (queue full or deadline)",
+         [({}, st["rejected"])])
+    emit("blaze_tenant_mem_used_bytes", "gauge",
+         "MemManager bytes in use per tenant (consumers + pipeline)",
+         [({"tenant": t}, v)
+          for t, v in sorted(mgr.tenant_usage().items())])
 
     for prefix, help_text, ms in (
             ("blaze_pipeline", "pipeline telemetry", pipeline.TELEMETRY),

@@ -44,6 +44,13 @@ def pop(key: str) -> Any:
         return _resources.pop(key, None)
 
 
+def keys() -> list:
+    """Snapshot of registered resource ids — leak checks walk this for
+    leftover query-namespaced entries after a run finishes."""
+    with _lock:
+        return sorted(_resources)
+
+
 def clear() -> None:
     with _lock:
         _resources.clear()

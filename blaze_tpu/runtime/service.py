@@ -1,0 +1,338 @@
+"""Multi-tenant query service: admission control, per-tenant quotas,
+fair scheduling, and overload shedding (ROADMAP item 1).
+
+The single-query driver (spark/local_runner.run_plan) assumes it owns
+the process: one Supervisor pool, one global memory budget, one breaker.
+`QueryService` turns that driver into a shared service — concurrent
+query sessions tagged with a tenant id and priority, with the engine's
+existing resilience machinery scoped per query instead of per process:
+
+  admission    a bounded waiting room in front of the run slots
+               (conf.max_concurrent_queries running,
+               conf.admission_queue_depth parked). A query that arrives
+               when every slot is busy PARKS; once the queue is full the
+               service load-sheds by REJECTING new arrivals with a typed
+               `faults.AdmissionRejected` instead of letting them pile
+               up. The absolute query deadline is stamped at ARRIVAL, so
+               time spent parked counts against conf.query_deadline_ms —
+               a query whose budget expires while parked is shed, not
+               started doomed.
+
+  quotas       `MemManager.set_tenant_quotas(conf.tenant_quota_spec)`
+               carves per-tenant ceilings out of the shared budget; a
+               tenant over its ceiling spills its OWN consumers first
+               (memory.py), so one tenant's spill pressure cannot evict
+               another's working set.
+
+  fairness     every admitted query submits its TaskSpecs to one shared
+               `supervisor.FairScheduler` (stride scheduling across
+               session queues, weighted by conf.tenant_priority_spec)
+               instead of a private FIFO pool — under contention a
+               weight-3 tenant gets ~3x the dispatch share of a
+               weight-1 tenant, and no session starves.
+
+  isolation    the breaker stays per-Supervisor (= per query), resource
+               ids are namespaced by query id (spark/stages.py), and
+               monitor/history attribute by the per-thread trace
+               context — query A tripping its breaker or leaking a
+               stream never reroutes or bills query B.
+
+Every outcome lands in the run ledger (trace.export_run_ledger): an
+admitted query's line carries `tenant_id`, `admission_outcome`
+("admitted" | "parked") and `admission_wait_ms`; a shed query gets its
+own line with outcome "rejected" — the ledger is the billing/SLO record
+for all arrivals, not just the ones that ran.
+
+Synchronous submission from N caller threads and async submission via
+`submit()` futures are both supported; `run()` is submit + result.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional
+
+from blaze_tpu.config import conf
+from blaze_tpu.runtime import faults, memory, supervisor, trace
+
+__all__ = ["QuerySession", "QueryService", "stats"]
+
+
+class QuerySession:
+    """Identity + budgets for one query's lifetime inside the service.
+
+    Duck-typed consumers (Supervisor, executor ladder, ops/common
+    adaptive batching) read: `tenant_id`, `query_id`, `priority`,
+    `deadline_at` (absolute monotonic, admission-stamped, or None),
+    `scheduler` (the shared FairScheduler, or None), and `batch_target`
+    (session-scoped ladder override of conf.target_batch_bytes; 0 = no
+    override)."""
+
+    __slots__ = ("tenant_id", "query_id", "priority", "deadline_at",
+                 "scheduler", "batch_target", "arrived_at",
+                 "admission_outcome", "admission_wait_ms")
+
+    def __init__(self, tenant_id: str, priority: Optional[float] = None,
+                 scheduler=None) -> None:
+        self.tenant_id = tenant_id
+        self.query_id = trace.new_query_id()
+        if priority is None:
+            priority = float(
+                (conf.tenant_priority_spec or {}).get(tenant_id, 1.0))
+        self.priority = max(float(priority), 1e-6)
+        self.arrived_at = time.monotonic()
+        self.deadline_at: Optional[float] = None
+        if conf.query_deadline_ms and conf.query_deadline_ms > 0:
+            self.deadline_at = (self.arrived_at
+                                + conf.query_deadline_ms / 1000.0)
+        self.scheduler = scheduler
+        self.batch_target = 0
+        self.admission_outcome = ""
+        self.admission_wait_ms = 0.0
+
+
+class QueryService:
+    """Shared driver accepting concurrent query sessions.
+
+    Use as a context manager (or start()/close()). `run(root, tenant_id,
+    ...)` admits, executes, and returns the result batch; `submit(...)`
+    does the same asynchronously on a per-query driver thread and
+    returns a Future. Both raise `faults.AdmissionRejected` when the
+    query is shed (queue full, or deadline expired while parked)."""
+
+    def __init__(self, max_concurrent: Optional[int] = None,
+                 queue_depth: Optional[int] = None) -> None:
+        self.max_concurrent = max(1, int(
+            max_concurrent if max_concurrent is not None
+            else conf.max_concurrent_queries))
+        self.queue_depth = max(0, int(
+            queue_depth if queue_depth is not None
+            else conf.admission_queue_depth))
+        self._lock = threading.Lock()
+        self._slot_free = threading.Condition(self._lock)
+        self._running = 0
+        self._parked = 0
+        self._admitted_total = 0
+        self._parked_total = 0
+        self._rejected_total = 0
+        self._threads: List[threading.Thread] = []
+        self.scheduler: Optional[supervisor.FairScheduler] = None
+        self._open = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "QueryService":
+        global _active
+        self.scheduler = supervisor.FairScheduler(
+            max(1, int(conf.max_concurrent_tasks)))
+        memory.get_manager().set_tenant_quotas(conf.tenant_quota_spec)
+        with self._lock:
+            self._open = True
+        _active = self
+        return self
+
+    def close(self) -> None:
+        global _active
+        with self._lock:
+            self._open = False
+            self._slot_free.notify_all()
+            drivers = list(self._threads)
+        for t in drivers:
+            t.join(timeout=30.0)
+        if self.scheduler is not None:
+            self.scheduler.close()
+        memory.get_manager().set_tenant_quotas(None)
+        if _active is self:
+            _active = None
+
+    def __enter__(self) -> "QueryService":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- admission ---------------------------------------------------------
+
+    def _shed_locked(self, session: QuerySession, reason: str,
+                     wait_ms: float) -> None:
+        """Reject (caller holds self._lock): count, trace, write the
+        ledger line — shed queries are billed too — raise the typed
+        error."""
+        self._rejected_total += 1
+        session.admission_outcome = "rejected"
+        session.admission_wait_ms = wait_ms
+        trace.event("admission_rejected", query_id=session.query_id,
+                    tenant_id=session.tenant_id, reason=reason,
+                    wait_ms=round(wait_ms, 1))
+        self._export_shed_ledger(session, reason)
+        raise faults.AdmissionRejected(
+            f"query {session.query_id} (tenant {session.tenant_id!r}) "
+            f"shed at admission: {reason} "
+            f"(waited {wait_ms:.0f}ms)",
+            tenant_id=session.tenant_id, wait_ms=wait_ms)
+
+    def _export_shed_ledger(self, session: QuerySession,
+                            reason: str) -> None:
+        d = conf.trace_export_dir
+        if not (conf.trace_enabled and d):
+            return
+        info = {"tenant_id": session.tenant_id,
+                "admission_outcome": "rejected",
+                "admission_wait_ms": round(session.admission_wait_ms, 1),
+                "admission_reject_reason": reason}
+        rec = trace.build_run_record(session.query_id, info)
+        trace.export_run_ledger(os.path.join(d, "ledger.jsonl"), rec)
+
+    def admit(self, tenant_id: str,
+              priority: Optional[float] = None) -> QuerySession:
+        """Block until the session holds a run slot (or shed it).
+
+        Immediate admit when a slot is free; PARK while the bounded
+        queue has room, waking on slot release; REJECT when the queue is
+        full or the parked session's deadline expires. The returned
+        session owns a slot — `_release` it exactly once (run/submit do
+        this internally)."""
+        session = QuerySession(tenant_id, priority, self.scheduler)
+        parked = False
+        with self._slot_free:
+            if not self._open:
+                raise RuntimeError("QueryService is closed")
+            if self._running >= self.max_concurrent:
+                if self._parked >= self.queue_depth:
+                    self._shed_locked(session, "queue_full", 0.0)
+                parked = True
+                self._parked += 1
+                self._parked_total += 1
+                trace.event("admission_parked", query_id=session.query_id,
+                            tenant_id=session.tenant_id,
+                            queue_depth=self._parked)
+                try:
+                    while self._open and self._running >= self.max_concurrent:
+                        timeout = None
+                        if session.deadline_at is not None:
+                            timeout = session.deadline_at - time.monotonic()
+                            if timeout <= 0:
+                                break
+                        self._slot_free.wait(timeout)
+                finally:
+                    self._parked -= 1
+                wait_ms = (time.monotonic() - session.arrived_at) * 1000.0
+                if not self._open:
+                    raise RuntimeError("QueryService closed while parked")
+                if self._running >= self.max_concurrent:
+                    # deadline expired in the waiting room — shed without
+                    # starting a run that could only end in DeadlineError
+                    self._shed_locked(session, "deadline_while_parked",
+                                      wait_ms)
+            self._running += 1
+            self._admitted_total += 1
+        wait_ms = (time.monotonic() - session.arrived_at) * 1000.0
+        session.admission_outcome = "parked" if parked else "admitted"
+        session.admission_wait_ms = wait_ms
+        trace.event("admission_admitted", query_id=session.query_id,
+                    tenant_id=session.tenant_id,
+                    wait_ms=round(wait_ms, 1), parked=parked)
+        return session
+
+    def _release(self, session: QuerySession) -> None:
+        if self.scheduler is not None:
+            self.scheduler.forget(session)
+        with self._slot_free:
+            self._running -= 1
+            self._slot_free.notify_all()
+
+    # -- execution ---------------------------------------------------------
+
+    def run(self, root, tenant_id: str = "", *,
+            priority: Optional[float] = None,
+            run_info: Optional[Dict[str, Any]] = None,
+            **run_plan_kwargs):
+        """Admit + execute on the CALLING thread; returns the result
+        batch. Raises faults.AdmissionRejected when shed."""
+        from blaze_tpu.spark import local_runner
+
+        session = self.admit(tenant_id, priority)
+        if run_info is None:
+            run_info = {}
+        run_info["tenant_id"] = session.tenant_id
+        run_info["admission_outcome"] = session.admission_outcome
+        run_info["admission_wait_ms"] = round(session.admission_wait_ms, 1)
+        try:
+            return local_runner.run_plan(root, run_info=run_info,
+                                         session=session,
+                                         **run_plan_kwargs)
+        finally:
+            self._release(session)
+
+    def submit(self, root, tenant_id: str = "", *,
+               priority: Optional[float] = None,
+               run_info: Optional[Dict[str, Any]] = None,
+               **run_plan_kwargs) -> Future:
+        """Admit on the calling thread (so AdmissionRejected raises
+        HERE, synchronously — shedding must push back on the submitter),
+        then execute on a per-query driver thread; returns a Future."""
+        from blaze_tpu.spark import local_runner
+
+        session = self.admit(tenant_id, priority)
+        if run_info is None:
+            run_info = {}
+        run_info["tenant_id"] = session.tenant_id
+        run_info["admission_outcome"] = session.admission_outcome
+        run_info["admission_wait_ms"] = round(session.admission_wait_ms, 1)
+        fut: Future = Future()
+
+        def drive() -> None:
+            if not fut.set_running_or_notify_cancel():
+                self._release(session)
+                return
+            try:
+                fut.set_result(local_runner.run_plan(
+                    root, run_info=run_info, session=session,
+                    **run_plan_kwargs))
+            except BaseException as e:  # noqa: BLE001 — relay via future
+                fut.set_exception(e)
+            finally:
+                self._release(session)
+
+        t = threading.Thread(target=drive,
+                             name=f"blz-query-{session.query_id}",
+                             daemon=True)
+        with self._lock:
+            # bounded bookkeeping: drop finished driver threads
+            self._threads = [x for x in self._threads if x.is_alive()]
+            self._threads.append(t)
+        t.start()
+        return fut
+
+    # -- introspection -----------------------------------------------------
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "running": self._running,
+                "queue_depth": self._parked,
+                "admitted": self._admitted_total,
+                "parked": self._parked_total,
+                "rejected": self._rejected_total,
+            }
+
+
+_active: Optional[QueryService] = None
+
+
+def active() -> Optional[QueryService]:
+    return _active
+
+
+def stats() -> Dict[str, int]:
+    """Admission stats of the active service; all-zero when none is
+    running (monitor.py imports this unconditionally for the Prometheus
+    gauges and blaze_top rows)."""
+    svc = _active
+    if svc is None:
+        return {"running": 0, "queue_depth": 0, "admitted": 0,
+                "parked": 0, "rejected": 0}
+    return svc.stats()

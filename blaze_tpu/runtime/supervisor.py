@@ -56,12 +56,13 @@ retries/ladder only — overhead is one branch per stage.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import itertools
 import statistics
 import threading
 import time
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import Future, ThreadPoolExecutor
 from concurrent.futures import TimeoutError as FutureTimeoutError
 from typing import Any, Callable, Dict, FrozenSet, List, Optional, Tuple
 
@@ -99,6 +100,19 @@ def current_kill_event() -> Optional[threading.Event]:
 def current_commit_gate():
     task = getattr(_current, "task", None)
     return task.gate if task is not None else None
+
+
+def current_session():
+    """The QuerySession (runtime/service.py) owning the work on THIS
+    thread, or None outside the multi-tenant service. Pool workers reach
+    it through their task; the query's driver thread through the
+    thread-local run_plan pushes for the run's duration."""
+    task = getattr(_current, "task", None)
+    if task is not None:
+        sess = getattr(task, "session", None)
+        if sess is not None:
+            return sess
+    return getattr(_current, "session", None)
 
 
 class TaskAttempt:
@@ -206,6 +220,125 @@ class CircuitBreaker:
             return not self._tripped.isdisjoint(op_kinds)
 
 
+class _SessionQueue:
+    """FairScheduler-internal per-session run queue (stride scheduling
+    state): FIFO within the session, virtual time across sessions."""
+
+    __slots__ = ("tenant_id", "query_id", "weight", "vt", "items")
+
+    def __init__(self, tenant_id: str, query_id: str, weight: float,
+                 vt: float) -> None:
+        self.tenant_id = tenant_id
+        self.query_id = query_id
+        self.weight = max(float(weight), 1e-6)
+        self.vt = vt
+        self.items: collections.deque = collections.deque()
+
+
+class FairScheduler:
+    """Shared worker pool dispatching TaskSpecs across live query
+    sessions with deficit-weighted round robin (stride scheduling).
+
+    The single-query Supervisor submits FIFO into its own pool; under
+    the multi-tenant service every live query submits HERE instead, and
+    each free worker runs the head of the non-empty session queue with
+    the smallest virtual time, then advances that queue's clock by
+    1/weight (weight = the tenant's conf.tenant_priority_spec entry).
+    Under contention a weight-3 tenant gets ~3x the dispatch share of a
+    weight-1 tenant, order within one session stays submission order,
+    and no session starves (every dispatch monotonically advances the
+    running queue's clock past its peers'). A session entering mid-run
+    starts at the scheduler's current clock — it competes from now on,
+    it does not get retroactive catch-up dispatches."""
+
+    def __init__(self, width: int) -> None:
+        self.width = max(1, int(width))
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queues: Dict[str, _SessionQueue] = {}
+        self._vclock = 0.0
+        self._closed = False
+        # (tenant_id, query_id, what) per dispatch, in dispatch order —
+        # how tests observe weighted fairness without timing assertions
+        self.dispatch_log: List[Tuple[str, str, str]] = []
+        self._threads = [
+            threading.Thread(target=self._worker, name=f"blz-svc-{i}",
+                             daemon=True)
+            for i in range(self.width)]
+        for t in self._threads:
+            t.start()
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return sum(len(q.items) for q in self._queues.values())
+
+    def submit(self, session, fn: Callable[[], Any],
+               what: str = "") -> Future:
+        """Enqueue fn under the session's queue; returns a Future that a
+        worker completes (cancel() works while still queued)."""
+        fut: Future = Future()
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("FairScheduler is closed")
+            q = self._queues.get(session.query_id)
+            if q is None:
+                q = _SessionQueue(session.tenant_id, session.query_id,
+                                  session.priority, self._vclock)
+                self._queues[session.query_id] = q
+            q.items.append((fut, fn, what))
+            self._cond.notify()
+        return fut
+
+    def forget(self, session) -> None:
+        """Drop a finished session's queue (cancelling stragglers)."""
+        with self._cond:
+            q = self._queues.pop(session.query_id, None)
+        if q is not None:
+            for fut, _fn, _what in q.items:
+                fut.cancel()
+
+    def _pick_locked(self) -> Optional[Tuple[Future, Callable, str]]:
+        ready = [q for q in self._queues.values() if q.items]
+        if not ready:
+            return None
+        q = min(ready, key=lambda s: (s.vt, s.query_id))
+        item = q.items.popleft()
+        q.vt += 1.0 / q.weight
+        if q.vt > self._vclock:
+            self._vclock = q.vt
+        self.dispatch_log.append((q.tenant_id, q.query_id, item[2]))
+        return item
+
+    def _worker(self) -> None:
+        while True:
+            with self._cond:
+                item = self._pick_locked()
+                while item is None and not self._closed:
+                    self._cond.wait()
+                    item = self._pick_locked()
+                if item is None:
+                    return  # closed and drained
+            fut, fn, _what = item
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                fut.set_result(fn())
+            except BaseException as e:  # noqa: BLE001 — relay via future
+                fut.set_exception(e)
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            for q in self._queues.values():
+                for fut, _fn, _what in q.items:
+                    fut.cancel()
+                q.items.clear()
+            self._queues.clear()
+            self._cond.notify_all()
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+
 @dataclasses.dataclass
 class TaskSpec:
     """One schedulable unit handed to Supervisor.run_tasks.
@@ -232,10 +365,12 @@ class _Task:
     first-finish-wins outcome."""
 
     def __init__(self, spec: TaskSpec, stage_key, deadline: Optional[float],
-                 trace_ctx: Optional[Dict[str, Any]] = None) -> None:
+                 trace_ctx: Optional[Dict[str, Any]] = None,
+                 session=None) -> None:
         self.spec = spec
         self.stage_key = stage_key
         self.deadline = deadline
+        self.session = session
         self.gate = CommitGate()
         self.done = threading.Event()
         self._lock = threading.Lock()
@@ -298,12 +433,19 @@ class Supervisor:
     _WATCHDOG_TICK = 0.05
     _ABANDON_GRACE = 2.0  # slack past a deadline before abandoning a thread
 
-    def __init__(self, run_info: Optional[dict] = None) -> None:
+    def __init__(self, run_info: Optional[dict] = None,
+                 session=None) -> None:
         self.run_info = run_info
+        self.session = session
         self.enabled = bool(conf.enable_supervisor)
         self.breaker = CircuitBreaker(run_info)
         self.query_deadline: Optional[float] = None
-        if conf.query_deadline_ms and conf.query_deadline_ms > 0:
+        if session is not None and session.deadline_at is not None:
+            # admission-aware budget: the service stamped the absolute
+            # deadline when the query ARRIVED, so time parked in the
+            # admission queue counts against conf.query_deadline_ms
+            self.query_deadline = session.deadline_at
+        elif conf.query_deadline_ms and conf.query_deadline_ms > 0:
             self.query_deadline = (time.monotonic()
                                    + conf.query_deadline_ms / 1000.0)
         self._lock = threading.Lock()
@@ -567,7 +709,7 @@ class Supervisor:
         value = run_task_with_resilience(
             attempt, what=spec.what, run_info=self.run_info,
             fallback=spec.fallback_fn, deadline=task.deadline,
-            on_error=self.breaker.note_failure)
+            on_error=self.breaker.note_failure, session=self.session)
         if task.finish("ok", value):
             self._record_duration(task.stage_key,
                                   time.monotonic() - started)
@@ -599,17 +741,30 @@ class Supervisor:
             return []
         if not self.enabled:
             return [self._run_sequential(spec) for spec in specs]
-        pool = self._ensure_pool()
         deadline = self.deadline()
         # snapshot the driver's query/stage ids here, on the submitting
         # thread — pool workers and twins replay them via task.trace_ctx
         ctx_snap = trace.current_context()
-        tasks = [_Task(spec, stage_key, deadline, ctx_snap)
+        tasks = [_Task(spec, stage_key, deadline, ctx_snap,
+                       session=self.session)
                  for spec in specs]
         with self._lock:
             self._tasks.extend(tasks)
         self._ensure_watchdog()
-        futures = [pool.submit(self._run_supervised, t) for t in tasks]
+        sched = (self.session.scheduler
+                 if self.session is not None else None)
+        if sched is not None:
+            # multi-tenant service: the SHARED pool interleaves this
+            # stage's tasks with other live queries', weighted by tenant
+            # priority (FairScheduler) — not this query's private FIFO
+            futures = [sched.submit(self.session,
+                                    lambda t=t: self._run_supervised(t),
+                                    what=t.spec.what)
+                       for t in tasks]
+        else:
+            pool = self._ensure_pool()
+            futures = [pool.submit(self._run_supervised, t)
+                       for t in tasks]
         results: List[Any] = [None] * len(tasks)
         first_err: Optional[BaseException] = None
         for i, (task, fut) in enumerate(zip(tasks, futures)):
@@ -675,7 +830,8 @@ class Supervisor:
                     attempt, what=spec.what,
                     run_info=self.run_info, fallback=spec.fallback_fn,
                     ctx=ctx, deadline=self.deadline(),
-                    on_error=self.breaker.note_failure)
+                    on_error=self.breaker.note_failure,
+                    session=self.session)
             finally:
                 _active_delta(-1)
             trace.record_value("task_latency_us",

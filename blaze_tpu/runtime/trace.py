@@ -63,7 +63,7 @@ from blaze_tpu.runtime.metrics import Histogram
 
 # correlation-id keys: hoisted out of attrs onto the record top level and
 # inherited by nested records through the thread-local context stack
-ID_KEYS = ("query_id", "stage_id", "task_id", "attempt_id")
+ID_KEYS = ("query_id", "tenant_id", "stage_id", "task_id", "attempt_id")
 
 _ctx = threading.local()
 _qid_seq = itertools.count(1)
@@ -161,6 +161,9 @@ TRACE = TraceLog()
 # same change that introduces the call site.
 
 EVENT_KINDS = (
+    "admission_admitted",   # service: query granted a run slot
+    "admission_parked",     # service: query queued behind a full pool
+    "admission_rejected",   # service: load shed (queue full / deadline)
     "artifact_commit",      # runtime/artifacts.py: first-commit-wins publish
     "batch",                # ops/base.count_stream batch boundary
     "breaker_trip",         # supervisor: per-operator circuit breaker
@@ -187,6 +190,7 @@ EVENT_KINDS = (
     "spill_pages_flush",    # memory: spill page pool flushed
     "task_abandoned",       # supervisor: attempt abandoned post-kill
     "task_error",           # supervisor: classified attempt failure
+    "tenant_over_quota",    # memory: tenant ceiling hit, self-spilling
     "whole_stage_attempt",  # stage_compiler: fused single-dispatch try
     "whole_stage_fallback", # stage_compiler: fused path bailed out
     "whole_stage_groups",   # stage_compiler: dense-agg group stats
@@ -640,8 +644,16 @@ def build_run_record(query_id: str, run_info: Optional[dict] = None,
     for r in recs:
         if r["type"] == "event" and r["kind"] in _RESILIENCE_EVENT_KINDS:
             event_counts[r["kind"]] = event_counts.get(r["kind"], 0) + 1
+    info = run_info or {}
     return {
         "query_id": query_id,
+        # billing/SLO attribution: every ledger line names its tenant and
+        # how admission handled the query (admitted/parked/rejected +
+        # wait); the service also writes lines for queries SHED at
+        # admission, which never reach a query span
+        "tenant_id": info.get("tenant_id", ""),
+        "admission_outcome": info.get("admission_outcome", "admitted"),
+        "admission_wait_ms": info.get("admission_wait_ms", 0),
         "wall_ns": qspan.get("wall") if qspan else None,
         "duration_ms": (round(qspan.get("dur", 0) / 1e6, 3)
                         if qspan else None),

@@ -34,9 +34,12 @@ def _reader_shuffle_sid(node: pb.PlanNode) -> Optional[Tuple[int, str]]:
     if which != "ipc_reader":
         return None
     rid = node.ipc_reader.provider_resource_id
-    if not rid.startswith("shuffle:"):
+    # rids may carry a "<query_id>/" namespace prefix (concurrent queries);
+    # parse the local part, keep the full rid for resource lookups
+    local = rid.rsplit("/", 1)[-1]
+    if not local.startswith("shuffle:"):
         return None
-    return int(rid.split(":", 1)[1]), rid
+    return int(local.split(":", 1)[1]), rid
 
 
 def _all_partitions_resource(rid: str, nparts: int) -> str:

@@ -525,7 +525,8 @@ def _is_broadcast_child(child: SparkPlan) -> bool:
     if child.kind == "BroadcastExchangeExec":
         return True
     rid = child.attrs.get("resource_id", "")
-    return child.kind == "__IpcReader" and rid.startswith("broadcast:")
+    local = rid.rsplit("/", 1)[-1]  # strip any "<query_id>/" namespace
+    return child.kind == "__IpcReader" and local.startswith("broadcast:")
 
 
 def _convert_bnlj(plan: SparkPlan) -> pb.PlanNode:

@@ -34,14 +34,22 @@ from blaze_tpu.runtime.executor import execute_plan, run_task_with_resilience
 from blaze_tpu.runtime.supervisor import Supervisor, TaskSpec
 from blaze_tpu.spark.convert_strategy import apply_strategy
 from blaze_tpu.spark.plan_model import SparkPlan
-from blaze_tpu.spark.stages import Stage, plan_stages
+from blaze_tpu.spark.stages import Stage, local_resource_id, plan_stages
+
+import threading
+
+# Conversion critical section: converters._pending_exports is a process
+# global, so [discard stale, convert, drain] must be atomic per query or
+# a concurrent query's drain swallows this one's FFI exports.
+_convert_lock = threading.Lock()
 
 
 def run_plan(root: SparkPlan, num_partitions: int = 4,
              work_dir: Optional[str] = None,
              mesh_exchange: str = "auto",
              mesh_quota: Optional[int] = None,
-             run_info: Optional[Dict[str, int]] = None) -> ColumnBatch:
+             run_info: Optional[Dict[str, int]] = None,
+             session=None) -> ColumnBatch:
     """Convert + execute a Spark plan tree locally; returns the collected
     result batch.
 
@@ -61,14 +69,24 @@ def run_plan(root: SparkPlan, num_partitions: int = 4,
     engine trace (runtime/trace.py) and every stage/task below inherits
     its query_id; with conf.trace_export_dir set, the Chrome trace and a
     run-ledger line are exported on completion (README "Observability").
+
+    session: the QuerySession (runtime/service.py) when running under
+    the multi-tenant service — carries tenant id, priority, the shared
+    fair scheduler, the admission-stamped deadline, and the per-session
+    batch-target override. None = standalone single-query driver.
     """
     from blaze_tpu.config import conf
     from blaze_tpu.runtime.tracing import profiled_scope
 
     if run_info is None:
         run_info = {}
-    qid = run_info.get("query_id") or trace.new_query_id()
+    qid = (session.query_id if session is not None
+           else run_info.get("query_id")) or trace.new_query_id()
     run_info["query_id"] = qid
+    tenant = (session.tenant_id if session is not None
+              else run_info.get("tenant_id", "")) or ""
+    if tenant:
+        run_info["tenant_id"] = tenant
     from blaze_tpu.runtime import memory
 
     mgr = memory.get_manager()
@@ -80,14 +98,27 @@ def run_plan(root: SparkPlan, num_partitions: int = 4,
     # whole-stage group cardinality accumulate under this qid until
     # record_run pops them at close (no-op with conf.history_dir unset)
     history.begin_query(qid)
+    # the query's driver thread advertises its session for ladder/batch
+    # scoping (supervisor.current_session) — pool workers inherit it
+    # through their _Task instead
+    prev_session = getattr(supervisor_mod._current, "session", None)
+    supervisor_mod._current.session = session
     try:
-        with profiled_scope("run_plan"):
-            with trace.span("query", query_id=qid,
-                            num_partitions=num_partitions,
-                            mesh_exchange=mesh_exchange):
-                return _run_plan_inner(root, num_partitions, work_dir,
-                                       mesh_exchange, mesh_quota, run_info)
+        # correlation ids pushed UNCONDITIONALLY (trace.context is a
+        # cheap stack push, not gated on trace_enabled): with several
+        # queries live at once, monitor/history attribution must read
+        # the per-thread context — the single-slot _active_qid fallback
+        # can't name this thread's query
+        with trace.context(query_id=qid, tenant_id=tenant or None):
+            with profiled_scope("run_plan"):
+                with trace.span("query", query_id=qid,
+                                num_partitions=num_partitions,
+                                mesh_exchange=mesh_exchange):
+                    return _run_plan_inner(root, num_partitions, work_dir,
+                                           mesh_exchange, mesh_quota,
+                                           run_info, session)
     finally:
+        supervisor_mod._current.session = prev_session
         # roll-ups (bytes by boundary, peak memory, spill, compile ms)
         # merged into run_info BEFORE the ledger export, plus the
         # always-on leak check (resource_leak event + counter)
@@ -105,7 +136,8 @@ def run_plan(root: SparkPlan, num_partitions: int = 4,
 def _run_plan_inner(root: SparkPlan, num_partitions: int,
                     work_dir: Optional[str], mesh_exchange: str,
                     mesh_quota: Optional[int],
-                    run_info: Optional[Dict[str, int]] = None) -> ColumnBatch:
+                    run_info: Optional[Dict[str, int]] = None,
+                    session=None) -> ColumnBatch:
     if run_info is None:
         run_info = {}
     run_info.setdefault("mesh_stages", 0)
@@ -125,15 +157,22 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
     from blaze_tpu.runtime import pipeline
 
     pipeline_before = pipeline.TELEMETRY.snapshot()
-    apply_strategy(root)
     from blaze_tpu.spark import converters, fallback
 
-    converters.drain_exports()  # discard leftovers from prior conversions
-    stages = plan_stages(root, default_partitions=num_partitions)
-    # Register a row-export iterator for every FFI-bridged (NeverConvert)
-    # subtree — the ConvertToNativeBase.scala:59-98 handshake: the subtree
-    # runs on the row engine (fallback.py) and feeds native FfiReaderExec.
-    exports = converters.drain_exports()
+    # per-query resource namespace: concurrent queries both number their
+    # stages from 0, so every shuffle/broadcast registry key is prefixed
+    # with this query's id ("<qid>/shuffle:<sid>")
+    ns = f"{run_info['query_id']}/" if run_info.get("query_id") else ""
+    with _convert_lock:
+        apply_strategy(root)
+        converters.drain_exports()  # discard stale prior conversions
+        stages = plan_stages(root, default_partitions=num_partitions,
+                             namespace=run_info.get("query_id", ""))
+        # Register a row-export iterator for every FFI-bridged
+        # (NeverConvert) subtree — the ConvertToNativeBase.scala:59-98
+        # handshake: the subtree runs on the row engine (fallback.py)
+        # and feeds native FfiReaderExec.
+        exports = converters.drain_exports()
     for rid, subtree in exports.items():
         def provider(partition, nparts, _p=subtree):
             return fallback.export_iterator(_p, partition, nparts)
@@ -156,8 +195,11 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
     # the task supervisor owns this query's worker pool, watchdog (hang
     # detection + deadlines), straggler speculation and the per-operator
     # circuit breaker (runtime/supervisor.py); disabled it degrades each
-    # stage to the sequential inline path
-    sup = Supervisor(run_info)
+    # stage to the sequential inline path. Under the service the session
+    # routes tasks through the SHARED fair scheduler and carries the
+    # admission-stamped query deadline; breaker state stays per-query
+    # (one CircuitBreaker per Supervisor, one Supervisor per run_plan).
+    sup = Supervisor(run_info, session=session)
     try:
         for stage in stages:
             # re-optimize THIS stage with the statistics of completed
@@ -197,7 +239,8 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
                                 stage.plan, stage.stage_id,
                                 _input_tasks(stage, stages),
                                 quota=mesh_quota,
-                                work_dir=work_dir, stats=stats)
+                                work_dir=work_dir, stats=stats,
+                                namespace=ns)
                         except Exception as e:  # noqa: BLE001 — classified
                             cat = faults.classify(e)
                             if cat in ("killed", "fatal", "plan"):
@@ -219,7 +262,7 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
                                        stage.stage_id))
                             continue
                     logical = _run_shuffle_stage(stage, stages, shuffle_mgr,
-                                                 sup, run_info)
+                                                 sup, run_info, ns=ns)
                     # logical (uncompressed) bytes: the mesh path reports
                     # the same unit, so the AQE threshold is
                     # transport-independent
@@ -232,7 +275,8 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
                 with trace.span("stage", stage_id=stage.stage_id,
                                 stage_kind="broadcast", fingerprint=fp,
                                 tasks=1) as sp:
-                    _run_broadcast_stage(stage, stages, sup, run_info)
+                    _run_broadcast_stage(stage, stages, sup, run_info,
+                                         ns=ns)
                     sp.set(**monitor.stage_span_attrs(
                         run_info["query_id"], stage.stage_id))
                 run_info["broadcast_stages"] += 1
@@ -265,10 +309,10 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
         for rid in exports:
             resources.pop(rid)
         for stage in stages:
-            for key in (f"shuffle:{stage.stage_id}",
-                        f"shuffle:{stage.stage_id}:all",
-                        f"broadcast:{stage.stage_id}",
-                        f"broadcast_sink:{stage.stage_id}"):
+            for key in (f"{ns}shuffle:{stage.stage_id}",
+                        f"{ns}shuffle:{stage.stage_id}:all",
+                        f"{ns}broadcast:{stage.stage_id}",
+                        f"{ns}broadcast_sink:{stage.stage_id}"):
                 resources.pop(key)
             shuffle_mgr.unregister_shuffle(stage.stage_id)
 
@@ -311,7 +355,8 @@ def _schema_of_reader(node: pb.PlanNode):
 
 
 def _run_shuffle_stage(stage: Stage, stages: List[Stage],
-                       shuffle_mgr, sup: Supervisor, run_info=None) -> int:
+                       shuffle_mgr, sup: Supervisor, run_info=None,
+                       ns: str = "") -> int:
     """Runs the map tasks through the shuffle manager (register ->
     per-task writer slot -> commit MapStatus -> reduce-side reader
     resource); returns the stage's total LOGICAL output bytes
@@ -361,7 +406,7 @@ def _run_shuffle_stage(stage: Stage, stages: List[Stage],
         logical += written
         slot.commit()
 
-    resources.put(f"shuffle:{stage.stage_id}",
+    resources.put(f"{ns}shuffle:{stage.stage_id}",
                   lambda partition: shuffle_mgr.get_reader_host(handle,
                                                                 partition))
     return logical
@@ -380,7 +425,10 @@ def _fallback_shuffle_task(stage: Stage, node: pb.PlanNode, task: int,
     from blaze_tpu.spark.converters import bridge_schema
 
     sch = bridge_schema(stage.source)
-    rid = f"__fallback_src:{stage.stage_id}:{task}"
+    # qid-prefixed: concurrent queries run fallback tasks with the same
+    # (sid, task) pair; the worker thread's trace context names the query
+    qid = trace.current_context().get("query_id", "")
+    rid = f"{qid}/__fallback_src:{stage.stage_id}:{task}"
 
     def provider(partition=task, nparts=ntasks):
         for rb in fallback.export_iterator(stage.source, partition, nparts):
@@ -407,14 +455,15 @@ def _fallback_shuffle_task(stage: Stage, node: pb.PlanNode, task: int,
 
 
 def _run_broadcast_stage(stage: Stage, stages: List[Stage],
-                         sup: Supervisor, run_info=None) -> None:
+                         sup: Supervisor, run_info=None,
+                         ns: str = "") -> None:
     # a broadcast stage runs ONE task but must see its upstream shuffles'
     # WHOLE output — a plan like broadcast(final_agg(exchange(...)))
     # would otherwise read only partition 0 and broadcast a quarter of
     # the relation (caught by the tpcds q01 catalogue cell)
     _rewrite_shuffle_readers_all(stage.plan, stages)
     frames: List[bytes] = []
-    resources.put(f"broadcast_sink:{stage.stage_id}", frames.append)
+    resources.put(f"{ns}broadcast_sink:{stage.stage_id}", frames.append)
 
     def attempt(ctx):
         del frames[:]  # a half-pushed earlier attempt must not leak frames
@@ -429,7 +478,7 @@ def _run_broadcast_stage(stage: Stage, stages: List[Stage],
         what=f"broadcast[{stage.stage_id}]", attempt_fn=attempt,
         partition=0, num_partitions=1, fallback_fn=fb,
         op_kinds=stage.op_kinds(), speculatable=False)])
-    resources.put(f"broadcast:{stage.stage_id}",
+    resources.put(f"{ns}broadcast:{stage.stage_id}",
                   lambda partition=0: iter(list(frames)))
 
 
@@ -462,8 +511,9 @@ def _copy_tree_readers_all(plan: SparkPlan, stages: List[Stage]) -> SparkPlan:
     attrs = dict(plan.attrs)
     if plan.kind == "__IpcReader":
         rid = attrs.get("resource_id", "")
-        if rid.startswith("shuffle:") and not rid.endswith(":all"):
-            sid = int(rid.split(":")[1])
+        local = local_resource_id(rid)
+        if local.startswith("shuffle:") and not local.endswith(":all"):
+            sid = int(local.split(":")[1])
             attrs["resource_id"] = _all_partitions_resource(
                 rid, stages[sid].num_partitions)
             attrs["num_partitions"] = 1
@@ -483,8 +533,9 @@ def _rewrite_shuffle_readers_all(node: pb.PlanNode,
         return
     if which == "ipc_reader":
         rid = node.ipc_reader.provider_resource_id
-        if rid.startswith("shuffle:") and not rid.endswith(":all"):
-            sid = int(rid.split(":", 1)[1])
+        local = local_resource_id(rid)
+        if local.startswith("shuffle:") and not local.endswith(":all"):
+            sid = int(local.split(":", 1)[1])
             node.ipc_reader.provider_resource_id = \
                 _all_partitions_resource(rid, stages[sid].num_partitions)
         return
@@ -605,7 +656,7 @@ def _run_result_stage(stage: Stage, parts: int, sup: Supervisor,
         return run_task_with_resilience(
             merge, what=f"result_merge[{stage.stage_id}]",
             run_info=run_info, deadline=sup.deadline(),
-            on_error=sup.breaker.note_failure)
+            on_error=sup.breaker.note_failure, session=sup.session)
 
     if not batches:
         return ColumnBatch.empty(op.schema)

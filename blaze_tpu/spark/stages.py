@@ -13,6 +13,13 @@ providers/consumers before running each stage's tasks):
                      readers resolve  "shuffle:<s>"
   broadcast stage s: writer pushes to  "broadcast_sink:<s>";
                      readers resolve  "broadcast:<s>"
+
+Under the multi-tenant QueryService several queries run concurrently in
+one process and each restarts stage numbering at 0, so plan_stages takes
+a ``namespace`` (the query id) that prefixes every resource id as
+"<ns>/shuffle:<s>" — the global resource registry stays collision-free.
+``local_resource_id()`` strips the prefix for sites that parse the
+"<kind>:<sid>" tail (query ids contain no '/' or ':').
 """
 
 from __future__ import annotations
@@ -62,9 +69,20 @@ class Stage:
         return self._op_kinds
 
 
-def plan_stages(root: SparkPlan, default_partitions: int = 1) -> List[Stage]:
+def local_resource_id(rid: str) -> str:
+    """Strip the query-namespace prefix: "q7-1/shuffle:3" -> "shuffle:3".
+
+    Ids planned without a namespace pass through unchanged, so every
+    parse site ("does this reader feed from a shuffle?", "which sid?")
+    works on both forms."""
+    return rid.rsplit("/", 1)[-1]
+
+
+def plan_stages(root: SparkPlan, default_partitions: int = 1,
+                namespace: str = "") -> List[Stage]:
     """Bottom-up stage plans; the result stage is last."""
     stages: List[Stage] = []
+    ns = f"{namespace}/" if namespace else ""
 
     def walk(plan: SparkPlan) -> SparkPlan:
         if plan.kind == "ShuffleExchangeExec":
@@ -93,7 +111,7 @@ def plan_stages(root: SparkPlan, default_partitions: int = 1) -> List[Stage]:
                                 w.partitioning.num_partitions,
                                 _deps_of(child), source=child))
             reader = SparkPlan("__IpcReader", plan.schema, [],
-                               {"resource_id": f"shuffle:{sid}",
+                               {"resource_id": f"{ns}shuffle:{sid}",
                                 "num_partitions":
                                     w.partitioning.num_partitions,
                                 "stage_dep": sid})
@@ -103,11 +121,11 @@ def plan_stages(root: SparkPlan, default_partitions: int = 1) -> List[Stage]:
             sid = len(stages)
             node = pb.PlanNode()
             node.ipc_writer.input.CopyFrom(convert_spark_plan(child))
-            node.ipc_writer.consumer_resource_id = f"broadcast_sink:{sid}"
+            node.ipc_writer.consumer_resource_id = f"{ns}broadcast_sink:{sid}"
             stages.append(Stage(sid, "broadcast", node, 1, _deps_of(child),
                                 source=child))
             return SparkPlan("__IpcReader", plan.schema, [],
-                             {"resource_id": f"broadcast:{sid}",
+                             {"resource_id": f"{ns}broadcast:{sid}",
                               "num_partitions": 1, "stage_dep": sid})
         plan.children = [walk(c) for c in plan.children]
         return plan

@@ -1,0 +1,388 @@
+"""Multi-tenant query service (runtime/service.py): admission control
+(admit / park / reject / deadline-while-parked), per-tenant memory quota
+isolation, weighted fair scheduling across sessions, per-query breaker
+isolation, and ledger/run_info billing for every admission outcome —
+plus N concurrent sessions through the full driver path against the
+pandas oracle."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from blaze_tpu.config import conf
+from blaze_tpu.runtime import faults, memory, trace
+from blaze_tpu.runtime import service as svc_mod
+from blaze_tpu.runtime import supervisor as sup_mod
+from blaze_tpu.runtime.service import QueryService, QuerySession
+from blaze_tpu.runtime.supervisor import FairScheduler, Supervisor
+
+
+@pytest.fixture(autouse=True)
+def _clean_service_conf():
+    saved = {k: getattr(conf, k) for k in (
+        "max_concurrent_queries", "admission_queue_depth",
+        "tenant_quota_spec", "tenant_priority_spec",
+        "query_deadline_ms", "task_deadline_ms", "max_concurrent_tasks",
+        "trace_enabled", "trace_export_dir", "breaker_failure_threshold")}
+    yield
+    for k, v in saved.items():
+        setattr(conf, k, v)
+    faults.install(None)
+    faults.reset_telemetry()
+    memory.get_manager().set_tenant_quotas(None)
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+
+
+def test_admit_when_slots_free():
+    with QueryService(max_concurrent=2, queue_depth=0) as svc:
+        s = svc.admit("acme")
+        assert s.admission_outcome == "admitted"
+        assert s.admission_wait_ms < 1000
+        assert svc.stats()["running"] == 1
+        svc._release(s)
+        assert svc.stats()["running"] == 0
+        assert svc.stats()["admitted"] == 1
+
+
+def test_reject_when_queue_full():
+    with QueryService(max_concurrent=1, queue_depth=0) as svc:
+        hold = svc.admit("acme")
+        with pytest.raises(faults.AdmissionRejected) as ei:
+            svc.admit("globex")
+        assert ei.value.tenant_id == "globex"
+        st = svc.stats()
+        assert st["rejected"] == 1 and st["admitted"] == 1
+        svc._release(hold)
+
+
+def test_park_until_slot_frees():
+    with QueryService(max_concurrent=1, queue_depth=4) as svc:
+        hold = svc.admit("acme")
+        got = {}
+
+        def waiter():
+            s = svc.admit("globex")
+            got["session"] = s
+            svc._release(s)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        deadline = time.monotonic() + 5
+        while svc.stats()["queue_depth"] == 0:
+            assert time.monotonic() < deadline, "waiter never parked"
+            time.sleep(0.005)
+        svc._release(hold)
+        t.join(timeout=5)
+        assert not t.is_alive()
+        assert got["session"].admission_outcome == "parked"
+        assert got["session"].admission_wait_ms > 0
+        assert svc.stats()["parked"] == 1
+
+
+def test_deadline_expires_while_parked():
+    conf.query_deadline_ms = 150
+    with QueryService(max_concurrent=1, queue_depth=4) as svc:
+        hold = svc.admit("acme")
+        t0 = time.monotonic()
+        with pytest.raises(faults.AdmissionRejected) as ei:
+            svc.admit("globex")
+        waited = time.monotonic() - t0
+        # shed at the arrival-stamped deadline, never started
+        assert 0.05 < waited < 5.0
+        assert ei.value.wait_ms > 0
+        assert svc.stats()["rejected"] == 1
+        svc._release(hold)
+
+
+def test_admission_wait_counts_against_query_deadline():
+    """The session deadline is stamped at ARRIVAL: a query parked for
+    most of its budget starts with only the remainder (Supervisor reads
+    session.deadline_at, not a fresh conf.query_deadline_ms window)."""
+    conf.query_deadline_ms = 10_000
+    with QueryService(max_concurrent=1, queue_depth=4) as svc:
+        s = svc.admit("acme")
+        assert s.deadline_at is not None
+        assert s.deadline_at - s.arrived_at == pytest.approx(10.0, abs=0.5)
+        sup = Supervisor(run_info={}, session=s)
+        assert sup.query_deadline == s.deadline_at
+        svc._release(s)
+
+
+def test_shed_query_gets_ledger_line(tmp_path):
+    conf.trace_enabled = True
+    conf.trace_export_dir = str(tmp_path)
+    with QueryService(max_concurrent=1, queue_depth=0) as svc:
+        hold = svc.admit("acme")
+        with pytest.raises(faults.AdmissionRejected):
+            svc.admit("globex")
+        svc._release(hold)
+    path = tmp_path / "ledger.jsonl"
+    recs = [json.loads(line) for line in path.read_text().splitlines()]
+    shed = [r for r in recs if r.get("admission_outcome") == "rejected"]
+    assert len(shed) == 1
+    assert shed[0]["tenant_id"] == "globex"
+    assert shed[0]["query_id"].startswith("q")
+
+
+def test_service_closed_rejects():
+    svc = QueryService(max_concurrent=1, queue_depth=4)
+    svc.start()
+    svc.close()
+    with pytest.raises(RuntimeError):
+        svc.admit("acme")
+
+
+# ---------------------------------------------------------------------------
+# fair scheduling
+# ---------------------------------------------------------------------------
+
+
+def _stub_session(tenant, priority, scheduler):
+    return QuerySession(tenant, priority=priority, scheduler=scheduler)
+
+
+def test_fair_scheduler_weighted_dispatch():
+    """With a weight-3 and a weight-1 session contending for one worker,
+    the dispatch order (observable via dispatch_log, no timing) gives
+    the heavy session ~3x the share."""
+    sched = FairScheduler(width=1)
+    try:
+        gate = threading.Event()
+        gate_sess = _stub_session("gate", 1.0, sched)
+        sched.submit(gate_sess, gate.wait, what="gate")
+        time.sleep(0.05)  # worker picks up the gate and blocks
+        hi = _stub_session("heavy", 3.0, sched)
+        lo = _stub_session("light", 1.0, sched)
+        futs = []
+        for i in range(9):
+            futs.append(sched.submit(hi, lambda: "hi", what=f"hi{i}"))
+        for i in range(3):
+            futs.append(sched.submit(lo, lambda: "lo", what=f"lo{i}"))
+        gate.set()
+        for f in futs:
+            f.result(timeout=10)
+        order = [t for t, _q, w in sched.dispatch_log if w != "gate"]
+        first8 = order[:8]
+        n_hi = first8.count("heavy")
+        n_lo = first8.count("light")
+        assert n_hi >= 2 * n_lo, (
+            f"weight-3 tenant got {n_hi}/8 vs weight-1 {n_lo}/8: {order}")
+        # FIFO within one session
+        his = [w for _t, _q, w in sched.dispatch_log
+               if w.startswith("hi")]
+        assert his == sorted(his, key=lambda w: int(w[2:]))
+    finally:
+        sched.close()
+
+
+def test_fair_scheduler_forget_cancels_queued():
+    sched = FairScheduler(width=1)
+    try:
+        gate = threading.Event()
+        g = _stub_session("gate", 1.0, sched)
+        sched.submit(g, gate.wait, what="gate")
+        time.sleep(0.05)
+        s = _stub_session("acme", 1.0, sched)
+        fut = sched.submit(s, lambda: 1, what="queued")
+        sched.forget(s)
+        assert fut.cancelled()
+        gate.set()
+    finally:
+        sched.close()
+
+
+def test_session_priority_from_spec():
+    conf.tenant_priority_spec = {"gold": 4.0}
+    s = QuerySession("gold")
+    assert s.priority == 4.0
+    assert QuerySession("other").priority == 1.0
+
+
+# ---------------------------------------------------------------------------
+# per-tenant memory quotas
+# ---------------------------------------------------------------------------
+
+
+class _FakeConsumer(memory.MemConsumer):
+    def __init__(self, name, used=0):
+        self.name = name
+        self.used = used
+        self.spills = 0
+
+    def mem_used(self):
+        return self.used
+
+    def spill(self):
+        freed, self.used = self.used, 0
+        self.spills += 1
+        return freed
+
+
+def test_tenant_quota_self_spill_not_cross_tenant():
+    """A tenant growing past its quota sheds its OWN working set; the
+    other tenant's consumers are untouched even though the manager is
+    nowhere near its global budget."""
+    mgr = memory.MemManager(total=1_000_000)
+    mgr.set_tenant_quotas({"a": 10_000, "b": 500_000})
+    with trace.context(tenant_id="a"):
+        a1 = _FakeConsumer("a1", used=8_000)
+        a2 = _FakeConsumer("a2", used=0)
+        mgr.register(a1)
+        mgr.register(a2)
+    with trace.context(tenant_id="b"):
+        b1 = _FakeConsumer("b1", used=400_000)
+        mgr.register(b1)
+    a2.used = 9_000  # tenant a now at 17k > 10k quota
+    mgr.update_mem_used(a2)
+    assert a2.spills >= 1  # the grower shed first
+    assert b1.spills == 0 and b1.used == 400_000  # b untouched
+    assert mgr.tenant_used("a") <= 10_000
+
+
+def test_tenant_quota_fraction_of_budget():
+    mgr = memory.MemManager(total=1_000_000)
+    mgr.set_tenant_quotas({"a": 0.25, "b": 300_000})
+    assert mgr.tenant_quota("a") == 250_000
+    assert mgr.tenant_quota("b") == 300_000
+
+
+def test_global_pressure_prefers_same_tenant():
+    """Over the GLOBAL budget, a tagged grower's spill pressure stays
+    inside its own tenant while same-tenant spillable state exists."""
+    mgr = memory.MemManager(total=100_000)
+    mgr.set_tenant_quotas({"a": 90_000, "b": 90_000})
+    with trace.context(tenant_id="a"):
+        a1 = _FakeConsumer("a1", used=30_000)
+        a2 = _FakeConsumer("a2", used=50_000)
+        mgr.register(a1)
+        mgr.register(a2)
+    with trace.context(tenant_id="b"):
+        b1 = _FakeConsumer("b1", used=40_000)
+        mgr.register(b1)
+    # total 120k > 100k budget; a1 grew last
+    mgr.update_mem_used(a1)
+    assert b1.spills == 0, "b's working set evicted by a's pressure"
+    assert a1.spills + a2.spills >= 1
+
+
+def test_release_scoped_to_tenant():
+    mgr = memory.MemManager(total=1_000_000)
+    mgr.set_tenant_quotas({"a": 500_000, "b": 500_000})
+    with trace.context(tenant_id="a"):
+        a1 = _FakeConsumer("a1", used=100_000)
+        mgr.register(a1)
+    with trace.context(tenant_id="b"):
+        b1 = _FakeConsumer("b1", used=100_000)
+        mgr.register(b1)
+    freed = mgr.release(1 << 62, tenant="a")
+    assert freed == 100_000
+    assert a1.used == 0 and b1.used == 100_000
+
+
+def test_tenant_usage_snapshot():
+    mgr = memory.MemManager(total=1_000_000)
+    mgr.set_tenant_quotas({"a": 500_000})
+    with trace.context(tenant_id="b"):
+        b1 = _FakeConsumer("b1", used=7_000)
+        mgr.register(b1)
+    usage = mgr.tenant_usage()
+    assert usage == {"a": 0, "b": 7_000}
+
+
+# ---------------------------------------------------------------------------
+# per-query isolation
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_isolation_across_sessions():
+    """Query A tripping its breaker must not reroute query B: the
+    breaker lives on the per-query Supervisor, not on shared state."""
+    conf.breaker_failure_threshold = 1
+    sup_a = Supervisor(run_info={})
+    sup_b = Supervisor(run_info={})
+    err = RuntimeError("boom")
+    err.point = "op.SortExec"
+    sup_a.breaker.note_failure(err)
+    assert sup_a.breaker.should_reroute(frozenset({"SortExec"}))
+    assert not sup_b.breaker.should_reroute(frozenset({"SortExec"}))
+
+
+def test_current_session_via_thread_local():
+    s = QuerySession("acme", priority=1.0)
+    assert sup_mod.current_session() is None
+    sup_mod._current.session = s
+    try:
+        assert sup_mod.current_session() is s
+    finally:
+        sup_mod._current.session = None
+
+
+def test_stats_zero_without_service():
+    assert svc_mod.active() is None
+    st = svc_mod.stats()
+    assert st == {"running": 0, "queue_depth": 0, "admitted": 0,
+                  "parked": 0, "rejected": 0}
+
+
+# ---------------------------------------------------------------------------
+# concurrent sessions through the full driver path vs the pandas oracle
+# ---------------------------------------------------------------------------
+
+
+def test_concurrent_sessions_match_oracle(tmp_path):
+    """N queries across 3 tenants through QueryService.submit — full
+    conversion/stage/execution path per session, every result diffed
+    against pandas. max_concurrent < N so some sessions park."""
+    from blaze_tpu.spark import validator
+
+    conf.max_concurrent_queries = 3
+    conf.admission_queue_depth = 16
+    conf.tenant_priority_spec = {"gold": 3.0, "silver": 1.0}
+    paths, frames = validator.generate_tables(str(tmp_path), rows=3000)
+    jobs = [
+        ("gold", "q1_scan_filter_project", "bhj"),
+        ("silver", "q2_q06_core_agg", "bhj"),
+        ("bronze", "q3_join_agg_sort", "smj"),
+        ("gold", "q3_join_agg_sort", "bhj"),
+        ("silver", "q1_scan_filter_project", "bhj"),
+        ("bronze", "q2_q06_core_agg", "bhj"),
+    ]
+    with QueryService() as svc:
+        futs = []
+        for tenant, qname, mode in jobs:
+            plan, oracle = validator.QUERIES[qname](paths, frames, mode)
+            futs.append((qname, oracle,
+                         svc.submit(plan, tenant,
+                                    num_partitions=4,
+                                    mesh_exchange="off")))
+        for qname, oracle, fut in futs:
+            got = validator._to_pandas(fut.result(timeout=300))
+            diff = validator._compare(got, oracle())
+            assert diff is None, f"{qname}: {diff}"
+        st = svc.stats()
+        assert st["admitted"] == len(jobs)
+        assert st["rejected"] == 0
+
+
+def test_run_info_carries_admission_billing(tmp_path):
+    from blaze_tpu.spark import validator
+
+    paths, frames = validator.generate_tables(str(tmp_path), rows=1000)
+    plan, oracle = validator.QUERIES["q1_scan_filter_project"](
+        paths, frames, "bhj")
+    with QueryService(max_concurrent=2) as svc:
+        info = {}
+        got = svc.run(plan, "acme", run_info=info,
+                      num_partitions=2, mesh_exchange="off")
+        assert validator._compare(validator._to_pandas(got),
+                                  oracle()) is None
+        assert info["tenant_id"] == "acme"
+        assert info["admission_outcome"] == "admitted"
+        assert info["admission_wait_ms"] >= 0

@@ -95,6 +95,19 @@ def render(metrics: dict, source: str) -> str:
         f"injected={int(g('blaze_faults_faults_injected'))} "
         f"breaker_trips={trips}"
         + ("  ** BREAKER TRIPPED **" if trips else ""))
+    rejected = int(g("blaze_admission_rejected_total"))
+    lines.append(
+        f"service  queue={int(g('blaze_admission_queue_depth'))} "
+        f"admitted={int(g('blaze_admission_admitted_total'))} "
+        f"parked={int(g('blaze_admission_parked_total'))} "
+        f"rejected={rejected}"
+        + ("  ** LOAD SHEDDING **" if rejected else ""))
+    tenants = [(k, v) for k, v in metrics.items()
+               if k.startswith("blaze_tenant_mem_used_bytes{")]
+    for key, v in sorted(tenants):
+        # blaze_tenant_mem_used_bytes{tenant="a"} -> a
+        label = key.split('tenant="', 1)[-1].rstrip('"}')
+        lines.append(f"tenant   {label:<16} mem={human_bytes(int(v))}")
     leaks = int(g("blaze_resource_leaks_total"))
     if leaks:
         lines.append(f"LEAKS    {leaks} resource leak(s) recorded")

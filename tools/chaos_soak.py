@@ -20,6 +20,17 @@ must additionally finalize all prefetch streams and sinks
 (`pipeline_leaked` = 0; leaked MemManager pipeline reservations are
 already covered by `mem_leaked`, since `mem_used()` includes them).
 
+`--service` (ISSUE 9): concurrent multi-tenant soak through
+runtime/service.QueryService — `--concurrent-queries N` sessions across
+`--tenants K` tenants per round, one clean round plus one round per
+armed (point, kind), emitting `SERVICE_r13.json`. Every session's
+answer must match the pandas oracle, no round may leak consumers,
+pipeline streams, namespaced resources or orphan artifacts, and breaker
+state must stay per-query: a session that saw zero injected faults must
+never record a breaker reroute caused by a faulted neighbor. An
+admission-stress round (1 slot, tiny queue) must shed with typed
+rejections while every admitted query still answers correctly.
+
 Each cell installs one deterministic fault spec (fail the first N calls
 of one KNOWN_POINTS prefix), runs a full driver-path query, and diffs
 the answer against the pandas oracle. A cell is
@@ -102,6 +113,187 @@ def _run_cell(tables, query, mode, spec):
     cell["pipeline_leaked"] = pipeline.live_streams()
     shutil.rmtree(work_dir, ignore_errors=True)
     return cell
+
+
+# representative fault points for the concurrent service rounds (the
+# full KNOWN_POINTS x kind sweep lives in the sequential/supervisor
+# soaks; the service gate is about isolation under concurrency, so it
+# covers the operator, serde, spill, and exchange layers once each)
+SERVICE_POINTS = ("op", "serde.encode", "spill.write", "exchange.stage",
+                  "shuffle.commit")
+
+
+def _leaks(work_dirs):
+    from blaze_tpu.runtime import artifacts, pipeline, resources
+    from blaze_tpu.runtime import memory as M
+
+    return {
+        "orphans": artifacts.find_orphans(list(work_dirs)),
+        "mem_leaked": int(M.get_manager().mem_used()),
+        "pipeline_leaked": pipeline.live_streams(),
+        # query-namespaced registrations ("<qid>/shuffle:3") must all be
+        # popped by each run's cleanup — a leftover means one session's
+        # teardown missed a resource another session could collide with
+        "resource_leaked": [k for k in resources.keys() if "/" in k],
+    }
+
+
+def _run_service_round(tables, name, n_queries, n_tenants, spec,
+                       max_concurrent=None, queue_depth=None):
+    """One round: n_queries client THREADS (round-robined across
+    n_tenants tenants and the mini-catalogue) each pushing a session
+    through QueryService.run — admission parks/sheds on the client
+    thread, exactly the overload shape the service exists for."""
+    import threading
+
+    from blaze_tpu.runtime import faults
+    from blaze_tpu.runtime.service import QueryService
+    from blaze_tpu.spark import validator
+
+    paths, frames = tables
+    faults.install(spec)
+    round_rec = {"round": name}
+    results = [None] * n_queries
+    work_dirs = []
+    t0 = time.time()
+
+    def client(i, svc, query, mode, tenant, plan, oracle, wd):
+        info = {}
+        q = {"query": query, "tenant": tenant}
+        try:
+            out = svc.run(plan, tenant, run_info=info,
+                          num_partitions=4, work_dir=wd,
+                          mesh_exchange="off")
+            diff = validator._compare(
+                validator._to_pandas(out).reset_index(drop=True),
+                oracle().reset_index(drop=True))
+            if diff is not None:
+                q["outcome"] = "wrong_answer"
+                q["diff"] = diff
+            elif info.get("faults_injected", 0):
+                q["outcome"] = "recovered"
+            else:
+                q["outcome"] = "clean_ok"
+        except faults.AdmissionRejected:
+            q["outcome"] = "rejected_at_admission"
+        except Exception as e:  # noqa: BLE001 — the soak records, not raises
+            q["outcome"] = "classified_fail"
+            q["error_category"] = faults.classify(e)
+            q["error"] = f"{type(e).__name__}: {e}"[:300]
+        q["faults_injected"] = info.get("faults_injected", 0)
+        q["breaker_trips"] = info.get("breaker_trips", 0)
+        q["breaker_reroutes"] = info.get("breaker_reroutes", 0)
+        if info.get("admission_outcome"):
+            q["admission_outcome"] = info["admission_outcome"]
+        results[i] = q
+
+    try:
+        with QueryService(max_concurrent=max_concurrent,
+                          queue_depth=queue_depth) as svc:
+            threads = []
+            for i in range(n_queries):
+                query, mode = QUERIES[i % len(QUERIES)]
+                tenant = f"tenant{i % n_tenants}"
+                plan, oracle = validator.QUERIES[query](paths, frames, mode)
+                wd = tempfile.mkdtemp(prefix="svc_cell_")
+                work_dirs.append(wd)
+                threads.append(threading.Thread(
+                    target=client,
+                    args=(i, svc, query, mode, tenant, plan, oracle, wd)))
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=600)
+            round_rec["queries"] = [q for q in results if q is not None]
+            round_rec["stats"] = svc.stats()
+            if svc.scheduler is not None:
+                counts = {}
+                for tenant, _qid, _what in svc.scheduler.dispatch_log:
+                    counts[tenant] = counts.get(tenant, 0) + 1
+                round_rec["dispatches_by_tenant"] = counts
+    finally:
+        faults.install(None)
+    round_rec["seconds"] = round(time.time() - t0, 3)
+    round_rec.update(_leaks(work_dirs))
+    for wd in work_dirs:
+        shutil.rmtree(wd, ignore_errors=True)
+    # breaker isolation: an unfaulted session must never have been
+    # rerouted by a neighbor's breaker — trips/reroutes are per-query
+    round_rec["isolation_violations"] = [
+        q for q in round_rec["queries"]
+        if q.get("faults_injected", 0) == 0
+        and (q.get("breaker_trips", 0) or q.get("breaker_reroutes", 0))]
+    return round_rec
+
+
+def _fairness_probe():
+    """Deterministic stride-scheduling check: one worker held at a gate,
+    a weight-3 and a weight-1 session each enqueue equal work, and the
+    dispatch order must give the heavy session ~3x the early share.
+    Total dispatch counts can't show this (all submitted work runs
+    eventually); ORDER under contention is the fairness observable."""
+    import threading
+
+    from blaze_tpu.runtime.service import QuerySession
+    from blaze_tpu.runtime.supervisor import FairScheduler
+
+    sched = FairScheduler(width=1)
+    try:
+        gate = threading.Event()
+        sched.submit(QuerySession("gate", 1.0, sched), gate.wait,
+                     what="gate")
+        time.sleep(0.05)  # the worker picks up the gate and blocks
+        hi = QuerySession("heavy", 3.0, sched)
+        lo = QuerySession("light", 1.0, sched)
+        futs = [sched.submit(hi, lambda: None, what="hi")
+                for _ in range(12)]
+        futs += [sched.submit(lo, lambda: None, what="lo")
+                 for _ in range(12)]
+        gate.set()
+        for f in futs:
+            f.result(timeout=30)
+        first8 = [t for t, _q, w in sched.dispatch_log
+                  if w != "gate"][:8]
+        n_hi, n_lo = first8.count("heavy"), first8.count("light")
+        return {"round": "fairness_probe", "queries": [],
+                "first8_heavy": n_hi, "first8_light": n_lo,
+                "fairness_ok": n_hi >= 2 * n_lo,
+                "orphans": [], "mem_leaked": 0, "pipeline_leaked": 0,
+                "resource_leaked": [], "isolation_violations": [],
+                "seconds": 0.1}
+    finally:
+        sched.close()
+
+
+def _service_soak(tables, args):
+    """The --service sweep: clean round, fairness probe, per-(point,
+    kind) fault rounds, and an admission-stress round."""
+    rounds = []
+    n, k = args.concurrent_queries, args.tenants
+
+    rounds.append(_run_service_round(tables, "clean", n, k, None))
+    rounds.append(_fairness_probe())
+
+    for point in SERVICE_POINTS:
+        for kind in KINDS:
+            spec = {"seed": args.seed, "concurrent": True,
+                    "points": {point: {"fail_times": args.fail_times,
+                                       "kind": kind}}}
+            r = _run_service_round(tables, f"{point}:{kind}", n, k, spec)
+            rounds.append(r)
+            print(f"[round] {point:15s} {kind:5s} "
+                  + " ".join(sorted({q['outcome'] for q in r['queries']}))
+                  + f" {r['seconds']:.1f}s", flush=True)
+
+    stress = _run_service_round(tables, "admission_stress", n, k, None,
+                                max_concurrent=1, queue_depth=1)
+    shed = [q for q in stress["queries"]
+            if q["outcome"] == "rejected_at_admission"]
+    stress["shed_count"] = len(shed)
+    # 1 slot + 1 parked against n submitters: overload MUST shed
+    stress["shedding_ok"] = (len(shed) > 0) if n > 2 else True
+    rounds.append(stress)
+    return rounds
 
 
 def _overhead(tables):
@@ -191,6 +383,14 @@ def main() -> int:
                     help="keep the async pipeline layer live under every "
                          "armed spec (marks specs concurrent) and fail any "
                          "cell that leaks prefetch streams/sinks")
+    ap.add_argument("--service", action="store_true",
+                    help="concurrent multi-tenant soak through "
+                         "runtime/service.QueryService (admission, quotas, "
+                         "fair scheduling, per-query breaker isolation)")
+    ap.add_argument("--concurrent-queries", type=int, default=8,
+                    help="client sessions per --service round")
+    ap.add_argument("--tenants", type=int, default=3,
+                    help="distinct tenant ids per --service round")
     ap.add_argument("--trace-dir", default=None,
                     help="enable the engine trace (conf.trace_enabled) and "
                          "export per-query Chrome traces + ledger.jsonl "
@@ -199,7 +399,8 @@ def main() -> int:
     ap.add_argument("--json-out", default=None)
     args = ap.parse_args()
     if args.json_out is None:
-        args.json_out = ("SUPERVISOR_r07.json" if args.supervisor
+        args.json_out = ("SERVICE_r13.json" if args.service
+                         else "SUPERVISOR_r07.json" if args.supervisor
                          else "PIPELINE_SOAK_r09.json" if args.pipeline
                          else "FAULTS_r06.json")
     kinds = (tuple(args.kinds.split(",")) if args.kinds
@@ -211,7 +412,9 @@ def main() -> int:
 
     saved_conf = {k: getattr(conf, k) for k in (
         "max_concurrent_tasks", "hang_detect_ms", "speculation_multiplier",
-        "trace_enabled", "trace_export_dir", "enable_pipeline")}
+        "trace_enabled", "trace_export_dir", "enable_pipeline",
+        "max_concurrent_queries", "admission_queue_depth",
+        "tenant_priority_spec", "tenant_quota_spec")}
     if args.pipeline:
         conf.enable_pipeline = True
     if args.supervisor:
@@ -225,6 +428,42 @@ def main() -> int:
 
     tmpdir = tempfile.mkdtemp(prefix="chaos_tables_")
     tables = validator.generate_tables(tmpdir, rows=args.rows)
+
+    if args.service:
+        conf.max_concurrent_queries = max(
+            2, min(4, args.concurrent_queries // 2))
+        conf.admission_queue_depth = args.concurrent_queries
+        rounds = _service_soak(tables, args)
+        shutil.rmtree(tmpdir, ignore_errors=True)
+        for k, v in saved_conf.items():
+            setattr(conf, k, v)
+        outcomes = {}
+        for r in rounds:
+            for q in r["queries"]:
+                outcomes[q["outcome"]] = outcomes.get(q["outcome"], 0) + 1
+        bad = []
+        for r in rounds:
+            bad += [q for q in r["queries"]
+                    if q["outcome"] == "wrong_answer"]
+            bad += r["isolation_violations"]
+            if (r["orphans"] or r["mem_leaked"] or r["pipeline_leaked"]
+                    or r["resource_leaked"]):
+                bad.append({"round": r["round"], "leaks": True})
+            if r.get("fairness_ok") is False or r.get("shedding_ok") is False:
+                bad.append({"round": r["round"], "behavior": False})
+        report = {
+            "rows": args.rows, "fail_times": args.fail_times,
+            "seed": args.seed,
+            "concurrent_queries": args.concurrent_queries,
+            "tenants": args.tenants,
+            "outcomes": outcomes, "ok": not bad, "rounds": rounds,
+        }
+        with open(args.json_out, "w") as f:
+            json.dump(report, f, indent=1)
+        print(f"\noutcomes: {outcomes}")
+        print(f"service soak {'OK' if report['ok'] else 'FAILED'} "
+              f"-> {args.json_out}")
+        return 0 if report["ok"] else 1
 
     cells = []
     for point in faults.KNOWN_POINTS:
