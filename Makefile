@@ -9,10 +9,11 @@ PYENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
 .PHONY: check check-fast check-faults check-supervisor check-trace \
 	check-pipeline check-pipeline-soak check-perf check-perf-update \
-	check-obs check-history check-lint check-service test test-fast \
-	validate validate-fast warm
+	check-obs check-history check-lint check-service check-doctor \
+	test test-fast validate validate-fast warm
 
-check: check-lint test validate check-perf check-history check-service
+check: check-lint test validate check-perf check-history check-service \
+	check-doctor
 	@echo "CHECK OK — safe to commit"
 
 # Static invariant gate (tools/blazelint): lock discipline, knob
@@ -129,6 +130,16 @@ check-history:
 check-service:
 	$(PYENV) python tools/chaos_soak.py --service \
 	  --json-out SERVICE_r13.json
+
+# Doctor gate: the validator catalogue run clean (every critical-path
+# breakdown must sum to wall time within 5%, zero findings on clean
+# queries), then two seeded perturbations the doctor must top-rank — a
+# 400ms serde.encode stall (serde_bound) and a skewed-partition input
+# (skewed_partition) — plus a byte-identical x3 determinism check and a
+# mid-query scrape of the per-tenant blaze_slo_* gauges. Emits
+# DOCTOR_r14.json.
+check-doctor:
+	$(PYENV) python tools/blaze_doctor.py --gate --json-out DOCTOR_r14.json
 
 # Pre-warm the persistent compile caches (runtime/compile_service):
 # replays the shape manifest + the TPC-DS catalogue into the XLA cache.

@@ -27,6 +27,7 @@ from __future__ import annotations
 import dataclasses
 import io
 import struct
+import time
 from typing import BinaryIO, Iterator, List, Optional
 
 import numpy as np
@@ -86,6 +87,9 @@ class HostBatch:
 
     def serialize(self, lo: int = 0, hi: Optional[int] = None,
                   level: Optional[int] = None) -> bytes:
+        # timing window opens before fault injection: an injected encode
+        # stall is real wall time and must land in serde_encode_ms
+        t0 = time.perf_counter_ns()
         if conf.fault_injection_spec:
             faults.inject("serde.encode")
         hi = self.num_rows if hi is None else hi
@@ -103,6 +107,7 @@ class HostBatch:
             # copied: the raw payload rebuilt row-by-row into the frame;
             # moved: the compressed frame that actually crosses
             monitor.count_copy("serde", len(raw), moved=len(frame))
+            monitor.count_time("serde_encode", time.perf_counter_ns() - t0)
         return frame
 
 
@@ -201,12 +206,14 @@ def serialize_slice(hb: HostBatch, lo: int, hi: int) -> bytes:
 
     if native.available() and all(c.kind in ("num", "str", "null")
                                   for c in hb.cols):
+        t0 = time.perf_counter_ns()
         if conf.fault_injection_spec:
             faults.inject("serde.encode")
         frame = native.serialize_host_batch(hb, lo, hi, conf.zstd_level)
         if conf.monitor_enabled:
             (raw_len,) = struct.unpack_from("<I", frame, 4)
             monitor.count_copy("serde", raw_len, moved=len(frame))
+            monitor.count_time("serde_encode", time.perf_counter_ns() - t0)
         return frame
     return hb.serialize(lo, hi)
 
@@ -227,6 +234,7 @@ def _read_exact(fp: BinaryIO, n: int) -> bytes:
 def deserialize_batch(buf: bytes, schema: Schema,
                       capacity: Optional[int] = None,
                       dctx=None) -> ColumnBatch:
+    t0 = time.perf_counter_ns()
     if conf.fault_injection_spec:
         faults.inject("serde.decode")
     if buf[:4] != MAGIC:
@@ -236,7 +244,10 @@ def deserialize_batch(buf: bytes, schema: Schema,
         buf[12:12 + comp_len], max_output_size=raw_len)
     if conf.monitor_enabled:
         monitor.count_copy("serde", raw_len, moved=12 + comp_len)
-    return _decode(io.BytesIO(raw), schema, capacity)
+    b = _decode(io.BytesIO(raw), schema, capacity)
+    if conf.monitor_enabled:
+        monitor.count_time("serde_decode", time.perf_counter_ns() - t0)
+    return b
 
 
 def read_batch(fp: BinaryIO, schema: Schema,
@@ -245,6 +256,7 @@ def read_batch(fp: BinaryIO, schema: Schema,
     """Read one frame; None at clean EOF. `dctx` lets stream readers
     reuse one decompressor across frames (context setup dominates small
     frames); per-frame construction remains the one-shot default."""
+    t0 = time.perf_counter_ns()
     if conf.fault_injection_spec:
         faults.inject("serde.decode")
     head = fp.read(12)
@@ -258,7 +270,12 @@ def read_batch(fp: BinaryIO, schema: Schema,
         comp, max_output_size=raw_len)
     if conf.monitor_enabled:
         monitor.count_copy("serde", raw_len, moved=12 + comp_len)
-    return _decode(io.BytesIO(raw), schema, capacity)
+    b = _decode(io.BytesIO(raw), schema, capacity)
+    if conf.monitor_enabled:
+        # window covers the file read + decompress + decode: read-side
+        # shuffle/spill file I/O is deliberately billed to serde_decode
+        monitor.count_time("serde_decode", time.perf_counter_ns() - t0)
+    return b
 
 
 def read_batches(fp: BinaryIO, schema: Schema) -> Iterator[ColumnBatch]:
@@ -275,6 +292,7 @@ def read_batch_host(fp: BinaryIO, schema: Schema,
     """Decode one frame to host numpy columns (no device upload) — the
     spill-merge and host-coalescing paths (ops/host_sort.py) stay entirely
     on the host until one bulk upload."""
+    t0 = time.perf_counter_ns()
     if conf.fault_injection_spec:
         faults.inject("serde.decode")
     head = fp.read(12)
@@ -291,8 +309,11 @@ def read_batch_host(fp: BinaryIO, schema: Schema,
     bio = io.BytesIO(raw)
     n, ncols = struct.unpack("<IH", _read_exact(bio, 6))
     assert ncols == len(schema.fields), (ncols, len(schema.fields))
-    cols = [_decode_col_host(bio, f.dtype, n) for f in schema]
-    return HostBatch(schema, cols, n)
+    hb = HostBatch(schema, [_decode_col_host(bio, f.dtype, n)
+                            for f in schema], n)
+    if conf.monitor_enabled:
+        monitor.count_time("serde_decode", time.perf_counter_ns() - t0)
+    return hb
 
 
 def deserialize_batch_host(buf: bytes, schema: Schema) -> HostBatch:

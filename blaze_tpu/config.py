@@ -219,6 +219,34 @@ _DECLARATIONS: Tuple[Knob, ...] = (
          doc="Per-tenant scheduling weight ({'tenant': weight}, default "
              "1.0): the service pool dispatches TaskSpecs deficit-"
              "weighted round robin across live sessions, not FIFO."),
+    Knob("tenant_slo_spec", default_factory=dict,
+         doc="Per-tenant latency objective ({'tenant': {'latency_ms': "
+             "500, 'target': 0.99}}; {} disables): the service tracks "
+             "rolling attainment + burn rate over the last "
+             "slo_window_queries arrivals (shed queries count as "
+             "misses), exports blaze_slo_* gauges and emits a "
+             "'slo_burn' trace event when the error budget burns past "
+             "slo_burn_alert_rate."),
+    Knob("slo_window_queries", 128,
+         doc="Rolling window (per tenant, in completed arrivals) over "
+             "which SLO attainment and burn rate are computed."),
+    Knob("slo_burn_alert_rate", 2.0,
+         doc="Burn-rate alert threshold: miss_rate / error_budget above "
+             "this emits the 'slo_burn' trace event (1.0 = burning "
+             "exactly at budget; 2.0 = budget gone in half the window)."),
+
+    # -- query doctor (runtime/doctor.py, tools/blaze_doctor.py) --
+    Knob("doctor_enabled", True,
+         doc="Stamp the additive critical-path breakdown into run-ledger "
+             "lines / history records and render the doctor section "
+             "(breakdown + ranked findings) in explain_analyze. The "
+             "stamp is computed from already-recorded spans at export "
+             "time — no hot-path cost."),
+    Knob("doctor_skew_ratio", 4.0,
+         doc="Skew/straggler rule threshold: a stage's worst clean task "
+             "must exceed the stage's median task duration by this "
+             "factor (and the stage must be a significant share of the "
+             "query) before the doctor flags it."),
 
     # -- pipelined async execution (runtime/pipeline.py) --
     Knob("enable_pipeline", True,

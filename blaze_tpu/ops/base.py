@@ -112,6 +112,19 @@ class MapLikeOp(Operator):
         return execute_fused(self, ctx)
 
 
+def add_compute_split(op: Operator, ns: int, device: bool) -> None:
+    """Attribute one compute window to the op's device-vs-host split.
+
+    `elapsed_compute_ns` (MetricsSet.timer's default) stays the combined
+    number every existing report reads; these two siblings decompose it
+    so metric_report and the query doctor can tell a jit-dispatched
+    chain from a host-kernel chain (digests/JSON/UDF) without parsing
+    plan shapes. The executor calls this once per fused batch — ops that
+    never fuse simply have a zero split."""
+    op.metrics.add("elapsed_device_ns" if device else "elapsed_host_ns",
+                   ns)
+
+
 def count_stream(op: Operator, stream: BatchStream) -> BatchStream:
     """Wrap a stream updating the operator's baseline metrics.
 

@@ -21,6 +21,7 @@ from __future__ import annotations
 import dataclasses
 import inspect
 import os
+import time
 from typing import Callable, Iterator, List
 
 import jax
@@ -216,12 +217,19 @@ class ShuffleWriterExec(Operator):
             # drain every pending frame (re-raising any pool-side error)
             # BEFORE the crash-atomic commit sees the buffers
             sink.close()
+            t0 = time.perf_counter_ns()
             with self.metrics.timer():
                 os.makedirs(os.path.dirname(self.data_path) or ".",
                             exist_ok=True)
                 # crash-atomic: stage temps, fsync, rename data-then-index
                 lengths = artifacts.commit_shuffle_pair(
                     state.commit, self.data_path, self.index_path)
+            if conf.monitor_enabled:
+                # map-output commit (fsync + rename) is the write half of
+                # the critical path's shuffle_io term; the read half lands
+                # in serde_decode (read_batch windows cover file reads)
+                monitor.count_time("shuffle_io",
+                                   time.perf_counter_ns() - t0)
             self.metrics.add("shuffle_bytes_written", int(sum(lengths)))
             self.metrics.add("spill_count", state.spill_chunks)
             committed = True

@@ -10,11 +10,17 @@ the chain's root source.
 
 from __future__ import annotations
 
+import time
+
 from typing import Callable, Optional
 
 from blaze_tpu.columnar.batch import ColumnBatch
-from blaze_tpu.ops.base import BatchStream, ExecContext, MapLikeOp, Operator, count_stream
-from blaze_tpu.runtime import faults, jit_cache, trace
+from blaze_tpu.config import conf
+from blaze_tpu.ops.base import (
+    BatchStream, ExecContext, MapLikeOp, Operator, add_compute_split,
+    count_stream,
+)
+from blaze_tpu.runtime import faults, jit_cache, monitor, trace
 from blaze_tpu.runtime.metrics import MetricNode
 
 
@@ -163,7 +169,11 @@ def run_task_with_resilience(attempt: Callable[[], object], *,
                     trace.event("retry", what=what, n=retries,
                                 category=cat,
                                 backoff_ms=round(sleep_s * 1000, 2))
+                    t0 = _time.perf_counter_ns()
                     faults._sleep(sleep_s)
+                    if conf.monitor_enabled:
+                        monitor.count_time("retry_backoff",
+                                           _time.perf_counter_ns() - t0)
                     continue
                 raise faults.ensure_classified(e) from e
     finally:
@@ -227,8 +237,16 @@ def execute_fused(op: MapLikeOp, ctx: ExecContext) -> BatchStream:
             ctx.check_running()
             fused = jit_cache.get_or_compile(key + batch.shape_key(), make,
                                              jit=jit)
+            t0 = time.perf_counter_ns()
             with op.metrics.timer():
                 out = fused(batch)
+            batch_ns = time.perf_counter_ns() - t0
+            add_compute_split(op, batch_ns, device=jit)
+            if conf.monitor_enabled:
+                # unjitted chains (host kernels: digests/JSON/UDF) bill
+                # host_compute; fused jit dispatch bills device_compute
+                monitor.count_time(
+                    "device_compute" if jit else "host_compute", batch_ns)
             yield out
 
     return count_stream(op, gen())

@@ -311,14 +311,19 @@ def record_run(qid: str, run_info: Optional[dict] = None,
         return None
     stages: List[Dict[str, Any]] = []
     duration_ms: Optional[float] = None
+    critical_path: Optional[Dict[str, Any]] = None
     if conf.trace_enabled:
         base = trace.build_run_record(qid, run_info)
         stages = base.get("stages") or []
         duration_ms = base.get("duration_ms")
+        critical_path = base.get("critical_path")
     if duration_ms is None and acc is not None:
         duration_ms = round((time.time() - acc.t0) * 1e3, 3)
     stage_fps = [s.get("fingerprint") or "" for s in stages]
     record: Dict[str, Any] = {
+        # readers treat a MISSING schema_version as version 1 (records
+        # written before the critical-path change)
+        "schema_version": trace.SCHEMA_VERSION,
         "query_id": qid,
         "tenant_id": (run_info or {}).get("tenant_id", ""),
         "ts": round(time.time(), 3),
@@ -333,6 +338,8 @@ def record_run(qid: str, run_info: Optional[dict] = None,
                      if isinstance(v, (int, float))
                      and not isinstance(v, bool)},
     }
+    if critical_path is not None:
+        record["critical_path"] = critical_path
     if acc is not None and acc.overflow:
         record["tap_overflow"] = acc.overflow
     st.append(record)

@@ -21,6 +21,7 @@ from __future__ import annotations
 import os
 import tempfile
 import threading
+import time
 import weakref
 from typing import BinaryIO, Iterator, List, Optional
 
@@ -397,9 +398,16 @@ class SpillFile:
     def write(self, batch: ColumnBatch) -> int:
         from blaze_tpu.runtime import faults
 
+        t0 = time.perf_counter_ns()
         if conf.fault_injection_spec:
             faults.inject("spill.write")
-        n = serde.write_batch(self._fp, batch)
+        t1 = time.perf_counter_ns()
+        # serialize outside the spill window (it bills serde_encode);
+        # the spill term is the injected stall + the file write itself
+        buf = serde.serialize_batch(batch)
+        t2 = time.perf_counter_ns()
+        self._fp.write(buf)
+        n = len(buf)
         self.bytes_written += n
         self.num_batches += 1
         self.pending_bytes += n
@@ -407,6 +415,8 @@ class SpillFile:
             self._manager.host_spill_bytes += n
         if conf.monitor_enabled:
             monitor.count_copy("spill", n)
+            monitor.count_time("spill", (t1 - t0) +
+                               (time.perf_counter_ns() - t2))
         return n
 
     def flush_pages(self) -> int:
@@ -421,14 +431,17 @@ class SpillFile:
     def read(self) -> Iterator[ColumnBatch]:
         from blaze_tpu.runtime import faults, pipeline
 
+        t0 = time.perf_counter_ns()
         if conf.fault_injection_spec:
             faults.inject("spill.read")
         self.flush_pages()
         self._fp.seek(0)
         if conf.monitor_enabled:
             # the whole file is about to be re-read; counted up front
-            # (the lazy prefetch below consumes every frame)
+            # (the lazy prefetch below consumes every frame). The frame
+            # reads themselves bill serde_decode; spill gets the fsync.
             monitor.count_copy("spill", self.bytes_written)
+            monitor.count_time("spill", time.perf_counter_ns() - t0)
         # read+decompress frames ahead on the I/O pool; the k-way merge
         # consumer interleaves many runs, and each run's readahead is
         # charged against the budget so merges can't silently re-inflate
@@ -441,12 +454,14 @@ class SpillFile:
         merge consumes runs host-side (ops/host_sort.py)."""
         from blaze_tpu.runtime import faults, pipeline
 
+        t0 = time.perf_counter_ns()
         if conf.fault_injection_spec:
             faults.inject("spill.read")
         self.flush_pages()
         self._fp.seek(0)
         if conf.monitor_enabled:
             monitor.count_copy("spill", self.bytes_written)
+            monitor.count_time("spill", time.perf_counter_ns() - t0)
         return pipeline.prefetch(
             serde.read_batches_host(self._fp, self.schema),
             manager=self._manager, name="spill_read")

@@ -76,7 +76,8 @@ class _QueryAcct:
     """Per-query accumulator (popped at query_end into the roll-up)."""
 
     __slots__ = ("qid", "copied", "moved", "stage_copied", "stage_moved",
-                 "t0", "spilled0", "spill_count0", "compile0")
+                 "t0", "spilled0", "spill_count0", "compile0",
+                 "time_ns", "stage_time_ns")
 
     def __init__(self, qid: str) -> None:
         self.qid = qid
@@ -88,6 +89,10 @@ class _QueryAcct:
         self.spilled0 = 0
         self.spill_count0 = 0
         self.compile0: Dict[str, int] = {}
+        # boundary-time accounting (count_time): wall ns per critical-
+        # path category, query-level and per stage
+        self.time_ns: Dict[str, int] = {}
+        self.stage_time_ns: Dict[Any, Dict[str, int]] = {}
 
 
 # -- copy/byte accounting ----------------------------------------------------
@@ -118,6 +123,44 @@ def count_copy(boundary: str, nbytes: int, moved: Optional[int] = None
             if sid is not None:
                 q.stage_copied[sid] = q.stage_copied.get(sid, 0) + n
                 q.stage_moved[sid] = q.stage_moved.get(sid, 0) + m
+
+
+# boundary-time categories (runtime/doctor.py critical-path terms):
+# each lands in the run ledger as "<category>_ms" and on stage spans.
+TIME_CATEGORIES = ("sched_queue", "serde_encode", "serde_decode",
+                   "shuffle_io", "spill", "device_compute",
+                   "host_compute", "retry_backoff")
+
+
+def count_time(category: str, ns: int, qid: Optional[str] = None,
+               sid: Optional[Any] = None) -> None:
+    """Account `ns` wall nanoseconds of `category` work (serde encode,
+    spill I/O, device compute, ...) against the attributed query/stage —
+    the time-domain twin of count_copy, feeding the doctor's additive
+    critical-path breakdown. Attribution follows count_copy (trace
+    context, then the runner-registered active query) unless qid/sid are
+    passed explicitly (the fair scheduler's workers have no trace
+    context). Call sites gate on conf.monitor_enabled."""
+    if not conf.monitor_enabled:
+        return
+    n = int(ns)
+    if n <= 0:
+        return
+    if qid is None or sid is None:
+        ctx = trace.current_context()
+        if qid is None:
+            qid = ctx.get("query_id")
+        if sid is None:
+            sid = ctx.get("stage_id")
+    with _lock:
+        qid = qid or _active_qid
+        q = _queries.get(qid) if qid else None
+        if q is None:
+            return
+        q.time_ns[category] = q.time_ns.get(category, 0) + n
+        if sid is not None:
+            st = q.stage_time_ns.setdefault(sid, {})
+            st[category] = st.get(category, 0) + n
 
 
 def count_move(boundary: str, nbytes: int) -> None:
@@ -206,22 +249,31 @@ def query_end(qid: str, manager=None) -> Dict[str, int]:
          - acct.compile0.get("compile_ns", 0)) / 1e6)
     for k in ("cache_hits", "cache_misses", "compile_count"):
         roll[f"compile_{k}"] = comp.get(k, 0) - acct.compile0.get(k, 0)
+    # boundary-time roll-up (count_time): one <category>_ms counter per
+    # observed category — the doctor's critical-path inputs
+    for cat, ns in acct.time_ns.items():
+        roll[f"{cat}_ms"] = round(ns / 1e6, 3)
     return roll
 
 
-def stage_span_attrs(qid: str, stage_id) -> Dict[str, int]:
-    """{moved_bytes, copied_bytes} accumulated for one stage so far —
-    the local runner stamps them onto the stage span before it closes
-    (explain_analyze renders them per stage). {} when unattributed."""
+def stage_span_attrs(qid: str, stage_id) -> Dict[str, Any]:
+    """{moved_bytes, copied_bytes} plus any per-stage boundary-time
+    `<category>_ms` accumulated for one stage so far — the local runner
+    stamps them onto the stage span before it closes (explain_analyze
+    and the ledger render them per stage). {} when unattributed."""
     with _lock:
         q = _queries.get(qid)
         if q is None:
             return {}
         m = q.stage_moved.get(stage_id, 0)
         c = q.stage_copied.get(stage_id, 0)
-    if not (m or c):
-        return {}
-    return {"moved_bytes": m, "copied_bytes": c}
+        times = dict(q.stage_time_ns.get(stage_id, ()))
+    out: Dict[str, Any] = {}
+    if m or c:
+        out = {"moved_bytes": m, "copied_bytes": c}
+    for cat in sorted(times):
+        out[f"{cat}_ms"] = round(times[cat] / 1e6, 3)
+    return out
 
 
 def finish_query(qid: str, run_info: Dict[str, Any], manager=None) -> None:
@@ -395,6 +447,10 @@ GAUGE_NAMES = (
     "blaze_admission_parked_total",
     "blaze_admission_rejected_total",
     "blaze_tenant_mem_used_bytes",
+    "blaze_slo_objective_ms",
+    "blaze_slo_attainment",
+    "blaze_slo_burn_rate",
+    "blaze_slo_breaches_total",
 )
 GAUGE_PREFIXES = (
     "blaze_pipeline_",  # pipeline.TELEMETRY counters
@@ -523,6 +579,28 @@ def prometheus_text() -> str:
          [({"tenant": t}, v)
           for t, v in sorted(mgr.tenant_usage().items())])
 
+    # per-tenant SLO tracking (runtime/service.SloTracker over
+    # conf.tenant_slo_spec): objective, rolling attainment, burn rate.
+    # Present whenever a spec is configured — including mid-query.
+    slo = service.slo_stats()
+    emit("blaze_slo_objective_ms", "gauge",
+         "Configured per-tenant latency objective (tenant_slo_spec)",
+         [({"tenant": t}, s["latency_ms"])
+          for t, s in sorted(slo.items())])
+    emit("blaze_slo_attainment", "gauge",
+         "Rolling share of arrivals meeting the tenant's objective",
+         [({"tenant": t}, s["attainment"])
+          for t, s in sorted(slo.items())])
+    emit("blaze_slo_burn_rate", "gauge",
+         "Error-budget burn rate (miss rate / allowed miss rate; "
+         ">1 = budget burning hot)",
+         [({"tenant": t}, s["burn_rate"])
+          for t, s in sorted(slo.items())])
+    emit("blaze_slo_breaches_total", "counter",
+         "Arrivals that missed the tenant's latency objective",
+         [({"tenant": t}, s["breaches"])
+          for t, s in sorted(slo.items())])
+
     for prefix, help_text, ms in (
             ("blaze_pipeline", "pipeline telemetry", pipeline.TELEMETRY),
             ("blaze_faults", "resilience telemetry", faults.TELEMETRY),
@@ -534,14 +612,26 @@ def prometheus_text() -> str:
             emit(_prom_name(f"{prefix}_{k}"), "gauge",
                  f"{help_text}: {k}", [({}, v)])
 
+    # engine histograms (task_latency_us, pipeline_*, shuffle_write_
+    # bytes, ...): proper Prometheus histogram exposition — cumulative
+    # _bucket{le=...} series straight from the log2 bucket counts
+    # (metrics.Histogram.bucket_upper_bound), plus _sum/_count. Replaces
+    # the earlier quantile-summary rendering: quantiles cannot be
+    # aggregated across processes, buckets can.
+    from blaze_tpu.runtime.metrics import Histogram
+
     for name, snap in sorted(trace.histograms_snapshot().items()):
         base = _prom_name(f"blaze_hist_{name}")
-        h = trace.histogram(name)
+        counts = snap.get("counts") or []
+        last = max((i for i, c in enumerate(counts) if c), default=-1)
         lines.append(f"# HELP {base} engine histogram {name}")
-        lines.append(f"# TYPE {base} summary")
-        for q, p in ((0.5, 50), (0.95, 95), (0.99, 99)):
-            lines.append(f'{base}{{quantile="{q}"}} '
-                         f"{h.percentile(p) or 0}")
+        lines.append(f"# TYPE {base} histogram")
+        cum = 0
+        for i in range(last + 1):
+            cum += counts[i]
+            le = Histogram.bucket_upper_bound(i)
+            lines.append(f'{base}_bucket{{le="{le}"}} {cum}')
+        lines.append(f'{base}_bucket{{le="+Inf"}} {snap["count"]}')
         lines.append(f"{base}_sum {snap['total']}")
         lines.append(f"{base}_count {snap['count']}")
 

@@ -52,13 +52,15 @@ from __future__ import annotations
 import os
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
 from typing import Any, Dict, List, Optional
 
 from blaze_tpu.config import conf
 from blaze_tpu.runtime import faults, memory, supervisor, trace
 
-__all__ = ["QuerySession", "QueryService", "stats"]
+__all__ = ["QuerySession", "QueryService", "SloTracker", "stats",
+           "slo_stats"]
 
 
 class QuerySession:
@@ -92,6 +94,98 @@ class QuerySession:
         self.batch_target = 0
         self.admission_outcome = ""
         self.admission_wait_ms = 0.0
+
+
+class SloTracker:
+    """Rolling per-tenant latency-SLO attainment + burn rate.
+
+    `conf.tenant_slo_spec` declares the objectives ({'tenant':
+    {'latency_ms': 500, 'target': 0.99}}). Every arrival's TOTAL latency
+    (admission wait + execution — the number the run ledger records as
+    admission_wait_ms + duration_ms, so offline recomputation from
+    ledger lines matches) is scored against the tenant's objective over
+    a rolling window of conf.slo_window_queries arrivals; queries SHED
+    at admission count as misses. Burn rate is miss_rate /
+    error_budget: 1.0 burns the budget exactly at window turnover, 2.0
+    burns it in half a window — past conf.slo_burn_alert_rate each
+    observation emits a `slo_burn` trace event. monitor.prometheus_text
+    exports the numbers as blaze_slo_* gauges via `slo_stats()`."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._met: Dict[str, deque] = {}
+        self._breaches: Dict[str, int] = {}
+
+    @staticmethod
+    def _spec(tenant_id: str) -> Optional[Dict[str, float]]:
+        sp = (conf.tenant_slo_spec or {}).get(tenant_id)
+        if not isinstance(sp, dict):
+            return None
+        obj = float(sp.get("latency_ms", 0) or 0)
+        if obj <= 0:
+            return None
+        target = min(max(float(sp.get("target", 0.99)), 0.0), 1.0)
+        return {"latency_ms": obj, "target": target}
+
+    def observe(self, tenant_id: str, latency_ms: float,
+                rejected: bool = False) -> None:
+        """Score one arrival; emits `slo_burn` when the budget runs hot."""
+        sp = self._spec(tenant_id)
+        if sp is None:
+            return
+        met = (not rejected) and latency_ms <= sp["latency_ms"]
+        with self._lock:
+            win = self._met.get(tenant_id)
+            if win is None or win.maxlen != max(
+                    int(conf.slo_window_queries), 1):
+                win = deque(win or (),
+                            maxlen=max(int(conf.slo_window_queries), 1))
+                self._met[tenant_id] = win
+            win.append(met)
+            if not met:
+                self._breaches[tenant_id] = \
+                    self._breaches.get(tenant_id, 0) + 1
+            stats = self._stats_locked(tenant_id, sp)
+        if stats["burn_rate"] > max(float(conf.slo_burn_alert_rate), 0.0):
+            trace.event("slo_burn", tenant_id=tenant_id,
+                        latency_ms=round(latency_ms, 1),
+                        objective_ms=sp["latency_ms"],
+                        attainment=stats["attainment"],
+                        burn_rate=stats["burn_rate"])
+
+    def _stats_locked(self, tenant_id: str,
+                      sp: Dict[str, float]) -> Dict[str, Any]:
+        win = self._met.get(tenant_id) or ()
+        n = len(win)
+        attainment = (sum(1 for m in win if m) / n) if n else 1.0
+        budget = 1.0 - sp["target"]
+        miss = 1.0 - attainment
+        if budget > 0:
+            burn = miss / budget
+        else:
+            burn = 0.0 if miss <= 0 else float(n)  # target=1.0: any miss
+        return {"latency_ms": sp["latency_ms"], "target": sp["target"],
+                "window": n, "attainment": round(attainment, 4),
+                "burn_rate": round(burn, 4),
+                "breaches": self._breaches.get(tenant_id, 0)}
+
+    def stats(self) -> Dict[str, Dict[str, Any]]:
+        """Per-tenant SLO readout for every tenant in the spec (tenants
+        with no observations yet report attainment 1.0 / burn 0.0 — the
+        gauges exist from the first scrape, mid-query included)."""
+        out: Dict[str, Dict[str, Any]] = {}
+        with self._lock:
+            tenants = set(self._met) | set(conf.tenant_slo_spec or {})
+            for t in sorted(tenants):
+                sp = self._spec(t)
+                if sp is not None:
+                    out[t] = self._stats_locked(t, sp)
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._met.clear()
+            self._breaches.clear()
 
 
 class QueryService:
@@ -168,6 +262,7 @@ class QueryService:
                     tenant_id=session.tenant_id, reason=reason,
                     wait_ms=round(wait_ms, 1))
         self._export_shed_ledger(session, reason)
+        _slo.observe(session.tenant_id, wait_ms, rejected=True)
         raise faults.AdmissionRejected(
             f"query {session.query_id} (tenant {session.tenant_id!r}) "
             f"shed at admission: {reason} "
@@ -240,6 +335,10 @@ class QueryService:
     def _release(self, session: QuerySession) -> None:
         if self.scheduler is not None:
             self.scheduler.forget(session)
+        # total latency since ARRIVAL: admission wait + execution — the
+        # same number the ledger line decomposes, scored once per admit
+        _slo.observe(session.tenant_id,
+                     (time.monotonic() - session.arrived_at) * 1000.0)
         with self._slot_free:
             self._running -= 1
             self._slot_free.notify_all()
@@ -336,3 +435,19 @@ def stats() -> Dict[str, int]:
         return {"running": 0, "queue_depth": 0, "admitted": 0,
                 "parked": 0, "rejected": 0}
     return svc.stats()
+
+
+# SLO state is process-wide, not per-QueryService: objectives describe
+# tenants, and tenants outlive service restarts within one process.
+_slo = SloTracker()
+
+
+def slo_stats() -> Dict[str, Dict[str, Any]]:
+    """Per-tenant SLO attainment/burn for monitor.prometheus_text and
+    blaze_top; one entry per tenant in conf.tenant_slo_spec."""
+    return _slo.stats()
+
+
+def reset_slo() -> None:
+    """Drop all SLO windows/breach totals (tests)."""
+    _slo.reset()

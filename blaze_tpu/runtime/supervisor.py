@@ -285,7 +285,9 @@ class FairScheduler:
                 q = _SessionQueue(session.tenant_id, session.query_id,
                                   session.priority, self._vclock)
                 self._queues[session.query_id] = q
-            q.items.append((fut, fn, what))
+            # 4th element: enqueue timestamp — dispatch wait (submitted
+            # -> picked) is the "sched_queue" critical-path term
+            q.items.append((fut, fn, what, time.monotonic()))
             self._cond.notify()
         return fut
 
@@ -294,10 +296,10 @@ class FairScheduler:
         with self._cond:
             q = self._queues.pop(session.query_id, None)
         if q is not None:
-            for fut, _fn, _what in q.items:
+            for fut, _fn, _what, _t0 in q.items:
                 fut.cancel()
 
-    def _pick_locked(self) -> Optional[Tuple[Future, Callable, str]]:
+    def _pick_locked(self) -> Optional[tuple]:
         ready = [q for q in self._queues.values() if q.items]
         if not ready:
             return None
@@ -307,6 +309,13 @@ class FairScheduler:
         if q.vt > self._vclock:
             self._vclock = q.vt
         self.dispatch_log.append((q.tenant_id, q.query_id, item[2]))
+        # per-query dispatch-wait attribution (runtime/doctor.py term
+        # "sched_queue"); explicit qid — workers have no trace context
+        wait_ns = int((time.monotonic() - item[3]) * 1e9)
+        if wait_ns > 0 and conf.monitor_enabled:
+            from blaze_tpu.runtime import monitor
+
+            monitor.count_time("sched_queue", wait_ns, qid=q.query_id)
         return item
 
     def _worker(self) -> None:
@@ -318,7 +327,7 @@ class FairScheduler:
                     item = self._pick_locked()
                 if item is None:
                     return  # closed and drained
-            fut, fn, _what = item
+            fut, fn, _what, _t0 = item
             if not fut.set_running_or_notify_cancel():
                 continue
             try:
@@ -330,7 +339,7 @@ class FairScheduler:
         with self._cond:
             self._closed = True
             for q in self._queues.values():
-                for fut, _fn, _what in q.items:
+                for fut, _fn, _what, _t0 in q.items:
                     fut.cancel()
                 q.items.clear()
             self._queues.clear()

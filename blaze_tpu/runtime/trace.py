@@ -183,6 +183,7 @@ EVENT_KINDS = (
     "queue_depth",          # pipeline: sampler queue-depth reading
     "resource_leak",        # monitor: leaked reservation/stream detected
     "retry",                # executor: retryable failure retried
+    "slo_burn",             # service: tenant SLO budget burning hot
     "speculation_launch",   # supervisor: straggler twin launched
     "speculation_loss",     # supervisor: attempt lost the commit race
     "speculation_win",      # supervisor: speculative twin won
@@ -197,10 +198,16 @@ EVENT_KINDS = (
 )
 
 SPAN_KINDS = (
+    "profile",       # trace.profiled_span: device profiler capture
     "query",         # local_runner: one per query
     "stage",         # executor: shuffle-map/broadcast/result stage
     "task_attempt",  # supervisor: one per (task, attempt)
 )
+
+# run-record wire format (ledger lines + history records). Bump on
+# shape changes; readers treat a MISSING field as version 1 (PR-9-era
+# lines predate the stamp) and must keep loading old lines.
+SCHEMA_VERSION = 2
 
 # -- named histogram registry ------------------------------------------------
 
@@ -359,6 +366,27 @@ def span(kind: str, **attrs):
         return _NULL_SPAN
     ids = {k: attrs.pop(k) for k in ID_KEYS if k in attrs}
     return _SpanCM(_Span(kind, ids, attrs))
+
+
+@contextlib.contextmanager
+def profiled_span(name: str = "query"):
+    """Device-profiler capture as a trace span — the ONE instrumentation
+    pathway for `conf.profiler_dir` (folds the legacy
+    runtime/tracing.profiled_scope in): records a "profile" span in the
+    ring, and when profiler_dir is set additionally wraps the block in a
+    jax.profiler trace + TraceAnnotation so the XLA device timeline
+    lands next to the engine spans. The capture honors profiler_dir even
+    with tracing disabled (span() degrades to the shared no-op)."""
+    with span("profile", scope=name) as sp:
+        if not conf.profiler_dir:
+            yield sp
+            return
+        import jax
+
+        sp.set(profiler_dir=conf.profiler_dir)
+        with jax.profiler.trace(conf.profiler_dir):
+            with jax.profiler.TraceAnnotation(name):
+                yield sp
 
 
 def on_batch(op, rows: int) -> None:
@@ -597,6 +625,24 @@ def explain_analyze(root, run_info: Optional[dict] = None,
         lines.append(f"query {q.get('query_id')}: "
                      f"{q.get('dur', 0) / 1e6:.1f}ms")
 
+    # doctor section: additive wall-time breakdown + ranked findings for
+    # the (last) query span in scope (runtime/doctor.py — pure function
+    # of the records, so the rendering is deterministic per run record)
+    if conf.doctor_enabled and qspans:
+        from blaze_tpu.runtime import doctor
+
+        qid = qspans[-1].get("query_id")
+        drec = build_run_record(qid, run_info, recs)
+        cp = drec.get("critical_path") or {}
+        if cp.get("total_ms"):
+            lines.append("-- critical path --")
+            lines.extend(doctor.render_critical_path(cp))
+        findings = doctor.diagnose(drec, records=query_records(qid, recs),
+                                   feed=feed)
+        if findings:
+            lines.append("-- findings --")
+            lines.extend(doctor.render_findings(findings))
+
     hists = histograms_snapshot()
     if hists:
         lines.append("-- distributions --")
@@ -645,7 +691,8 @@ def build_run_record(query_id: str, run_info: Optional[dict] = None,
         if r["type"] == "event" and r["kind"] in _RESILIENCE_EVENT_KINDS:
             event_counts[r["kind"]] = event_counts.get(r["kind"], 0) + 1
     info = run_info or {}
-    return {
+    rec = {
+        "schema_version": SCHEMA_VERSION,
         "query_id": query_id,
         # billing/SLO attribution: every ledger line names its tenant and
         # how admission handled the query (admitted/parked/rejected +
@@ -671,6 +718,11 @@ def build_run_record(query_id: str, run_info: Optional[dict] = None,
             for name, s in histograms_snapshot().items()},
         "dropped_events": TRACE.dropped,
     }
+    if conf.doctor_enabled:
+        from blaze_tpu.runtime import doctor
+
+        rec["critical_path"] = doctor.compute_critical_path(rec, recs)
+    return rec
 
 
 def export_run_ledger(path: str, record: dict) -> None:

@@ -1,52 +1,40 @@
-"""LEGACY low-level profiler hooks (SURVEY §5.4) — NOT the engine tracer.
+"""LEGACY report helpers — the instrumentation itself lives in trace.py.
 
-Role split (also recorded on the `profiler_dir` knob in config.py): this
-module owns the *device-side* XLA profiler capture and the textual
-metric-tree report; the *engine-side* structured span/event log, its
-exporters and EXPLAIN ANALYZE live in runtime/trace.py. New
-instrumentation belongs in trace.py; this module only changes when the
-JAX profiler integration does.
+Role split (also recorded on the `profiler_dir` knob in config.py): the
+engine has ONE instrumentation pathway, runtime/trace.py — structured
+spans/events, exporters, EXPLAIN ANALYZE, and (since the query-doctor
+change) the device-side XLA profiler capture as a "profile" span kind
+(`trace.profiled_span`). This module keeps two things alive:
 
-The reference's profiling story is per-operator timing metrics surfaced in
-the Spark UI plus DebugExecNode batch logging (debug_exec.rs); it has no
-dedicated tracer. This engine additionally hooks the JAX profiler: set
-`conf.profiler_dir` and every `profiled_scope` (the local runner wraps each
-query; the executor can wrap stages) captures an XLA/TPU trace viewable in
-TensorBoard/Perfetto — device kernel timelines, the thing a CPU engine
-cannot give you.
+  profiled_scope   a thin alias of trace.profiled_span, preserved so
+                   embedder code written against the old import path
+                   (`from blaze_tpu.runtime.tracing import
+                   profiled_scope`) keeps working — including the
+                   `profiler_dir` knob semantics (no capture when unset,
+                   the scope is then just an engine-trace span).
 
-`metric_report` renders the per-operator metric tree (MetricNode) after a
-run — the textual analog of the reference's metric push into the Spark UI
-(blaze/src/metrics.rs:21-50).
+  metric_report    the textual per-operator metric tree (the analog of
+                   the reference's metric push into the Spark UI,
+                   blaze/src/metrics.rs:21-50).
 
 For the ENGINE-side timeline — spans/events with query/stage/task/attempt
 correlation ids, Chrome/Perfetto export, the EXPLAIN ANALYZE tree
 (`trace.explain_analyze`, a superset of `metric_report`) and the per-query
-run ledger — see runtime/trace.py. The two traces are complementary: the
-XLA profiler shows where the DEVICE spent time, trace.py shows why the
-RUNTIME scheduled, retried or rerouted the work around it; load both in
-Perfetto side by side (README "Observability").
+run ledger — see runtime/trace.py. With conf.profiler_dir set the
+"profile" span ALSO captures an XLA/TPU trace viewable in TensorBoard/
+Perfetto — device kernel timelines next to the runtime's own spans; load
+both in Perfetto side by side (README "Observability").
 """
 
 from __future__ import annotations
 
-import contextlib
 from typing import List
 
-from blaze_tpu.config import conf
+# Alias, not a wrapper: the single span-kind pathway in trace.py is the
+# implementation; this name survives for the legacy import path only.
+from blaze_tpu.runtime.trace import profiled_span as profiled_scope  # noqa: F401
 
-
-@contextlib.contextmanager
-def profiled_scope(name: str = "query"):
-    """JAX profiler trace when conf.profiler_dir is set; no-op otherwise."""
-    if not conf.profiler_dir:
-        yield
-        return
-    import jax
-
-    with jax.profiler.trace(conf.profiler_dir):
-        with jax.profiler.TraceAnnotation(name):
-            yield
+__all__ = ["profiled_scope", "metric_report"]
 
 
 def metric_report(root) -> str:

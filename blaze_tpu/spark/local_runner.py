@@ -76,7 +76,6 @@ def run_plan(root: SparkPlan, num_partitions: int = 4,
     batch-target override. None = standalone single-query driver.
     """
     from blaze_tpu.config import conf
-    from blaze_tpu.runtime.tracing import profiled_scope
 
     if run_info is None:
         run_info = {}
@@ -110,7 +109,7 @@ def run_plan(root: SparkPlan, num_partitions: int = 4,
         # the per-thread context — the single-slot _active_qid fallback
         # can't name this thread's query
         with trace.context(query_id=qid, tenant_id=tenant or None):
-            with profiled_scope("run_plan"):
+            with trace.profiled_span("run_plan"):
                 with trace.span("query", query_id=qid,
                                 num_partitions=num_partitions,
                                 mesh_exchange=mesh_exchange):

@@ -197,7 +197,7 @@ def test_prometheus_text_format_conformance():
     batch, schema = _batch()
     serde.deserialize_batch(serde.to_host(batch).serialize(), schema)
     conf.trace_enabled = True
-    trace.record_value("batch_rows", 64)  # exercise the summary path
+    trace.record_value("batch_rows", 64)  # exercise the histogram path
 
     text = monitor.prometheus_text()
     assert text.endswith("\n")
@@ -209,11 +209,17 @@ def test_prometheus_text_format_conformance():
             continue
         if line.startswith("# TYPE "):
             _, _, name, mtype = line.split(" ", 3)
-            assert mtype in ("counter", "gauge", "summary"), line
+            assert mtype in ("counter", "gauge", "histogram"), line
             assert name not in typed, f"duplicate TYPE for {name}"
             typed.add(name)
             continue
         assert _SAMPLE.match(line), f"malformed sample line: {line!r}"
+    # engine histograms export as real histogram series: cumulative
+    # le-labelled buckets closed by +Inf, plus _sum/_count
+    assert re.search(
+        r'^blaze_hist_batch_rows_bucket\{le="\+Inf"\} 1$', text, re.M)
+    assert "blaze_hist_batch_rows_sum 64" in text
+    assert "blaze_hist_batch_rows_count 1" in text
     # the metrics the ISSUE names must be present with real values
     assert re.search(
         r'^blaze_bytes_copied_total\{boundary="serde"\} [1-9]', text,

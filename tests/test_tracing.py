@@ -46,6 +46,27 @@ def test_profiled_scope_noop_without_profiler_dir():
         conf.profiler_dir = old
 
 
+def test_profiled_scope_is_the_trace_span_pathway():
+    """The legacy name is an alias of trace.profiled_span — one
+    instrumentation pathway; with tracing on, the block lands in the
+    ring as a "profile" span carrying the scope name."""
+    from blaze_tpu.runtime import trace
+
+    assert profiled_scope is trace.profiled_span
+    saved = conf.trace_enabled
+    conf.trace_enabled = True
+    trace.reset()
+    try:
+        with profiled_scope("legacy-alias"):
+            pass
+        (rec,) = trace.TRACE.snapshot()
+        assert rec["kind"] == "profile"
+        assert rec["attrs"]["scope"] == "legacy-alias"
+    finally:
+        conf.trace_enabled = saved
+        trace.reset()
+
+
 def test_metric_report(rng):
     schema = T.Schema([T.Field("x", T.INT64)])
     b = ColumnBatch.from_numpy({"x": np.arange(50, dtype=np.int64)}, schema)

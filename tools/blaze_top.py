@@ -108,6 +108,19 @@ def render(metrics: dict, source: str) -> str:
         # blaze_tenant_mem_used_bytes{tenant="a"} -> a
         label = key.split('tenant="', 1)[-1].rstrip('"}')
         lines.append(f"tenant   {label:<16} mem={human_bytes(int(v))}")
+    slo_rows = [(k, v) for k, v in metrics.items()
+                if k.startswith("blaze_slo_attainment{")]
+    for key, v in sorted(slo_rows):
+        label = key.split('tenant="', 1)[-1].rstrip('"}')
+        sel = 'blaze_slo_%s{tenant="' + label + '"}'
+        burn = metrics.get(sel % "burn_rate", 0.0)
+        lines.append(
+            f"slo      {label:<16} "
+            f"objective={int(metrics.get(sel % 'objective_ms', 0))}ms "
+            f"attainment={v * 100:5.1f}% "
+            f"burn={burn:4.1f}x "
+            f"breaches={int(metrics.get(sel % 'breaches_total', 0))}"
+            + ("  ** SLO BURNING **" if burn > 1.0 else ""))
     leaks = int(g("blaze_resource_leaks_total"))
     if leaks:
         lines.append(f"LEAKS    {leaks} resource leak(s) recorded")
