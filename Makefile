@@ -10,10 +10,10 @@ PYENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 .PHONY: check check-fast check-faults check-supervisor check-trace \
 	check-pipeline check-pipeline-soak check-perf check-perf-update \
 	check-obs check-history check-lint check-service check-doctor \
-	test test-fast validate validate-fast warm
+	check-flight test test-fast validate validate-fast warm
 
 check: check-lint test validate check-perf check-history check-service \
-	check-doctor
+	check-doctor check-flight
 	@echo "CHECK OK — safe to commit"
 
 # Static invariant gate (tools/blazelint): lock discipline, knob
@@ -140,6 +140,15 @@ check-service:
 # DOCTOR_r14.json.
 check-doctor:
 	$(PYENV) python tools/blaze_doctor.py --gate --json-out DOCTOR_r14.json
+
+# Flight-recorder gate: the catalogue run clean with the recorder armed
+# and live progress on (zero spurious dossiers, tap overhead under 1%
+# min-of-repeats), a seeded 400ms serde.encode stall paired with an
+# unmeetable 5ms tenant SLO through the service (exactly one slo_breach
+# dossier, top finding serde_bound), and a mid-query /queries scrape
+# (valid summary schema, monotone progress). Emits FLIGHT_r15.json.
+check-flight:
+	$(PYENV) python tools/blaze_inspect.py --gate --json-out FLIGHT_r15.json
 
 # Pre-warm the persistent compile caches (runtime/compile_service):
 # replays the shape manifest + the TPC-DS catalogue into the XLA cache.

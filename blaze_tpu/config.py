@@ -137,9 +137,8 @@ _DECLARATIONS: Tuple[Knob, ...] = (
              "power-of-four rungs."),
     Knob("profiler_dir", "", env="BLAZE_TPU_PROFILE_DIR",
          doc="JAX profiler trace output dir ('' disables) — consumed by "
-             "the LEGACY low-level profiler module runtime/tracing.py "
-             "(jax.profiler TensorBoard traces), not by the structured "
-             "engine trace in runtime/trace.py."),
+             "trace.profiled_span (jax.profiler TensorBoard captures "
+             "recorded as 'profile' spans in the engine trace)."),
 
     # -- structured query tracing (runtime/trace.py) --
     Knob("trace_enabled", False,
@@ -271,8 +270,13 @@ _DECLARATIONS: Tuple[Knob, ...] = (
              "truthiness check and all counters read 0; the always-on "
              "leak telemetry is independent of this flag."),
     Knob("metrics_port", 0,
-         doc="Prometheus text-format scrape endpoint (stdlib http.server "
-             "daemon thread) serving GET /metrics; 0 disables."),
+         doc="Metrics + debug-endpoint HTTP server (stdlib http.server "
+             "daemon thread) serving GET /metrics, /healthz, /queries "
+             "and /queries/<qid>; 0 disables."),
+    Knob("metrics_host", "127.0.0.1", env="BLAZE_TPU_METRICS_HOST",
+         doc="Bind address for the metrics/debug HTTP server. Loopback "
+             "by default — set 0.0.0.0 only when the endpoints should "
+             "be reachable off-host (they expose query metadata)."),
     Knob("monitor_sample_ms", 200,
          doc="Background ResourceMonitor sampling period (MemManager "
              "usage, spill pages, pool occupancy, queue depths, "
@@ -297,6 +301,28 @@ _DECLARATIONS: Tuple[Knob, ...] = (
              "/ copy traffic flagged when it exceeds the fingerprint's "
              "historical median by more than this percentage (plus an "
              "absolute noise grace — history.detect_regressions)."),
+
+    # -- flight recorder & live introspection (runtime/flight_recorder,
+    # -- runtime/progress.py) --
+    Knob("flight_dir", "", env="BLAZE_TPU_FLIGHT_DIR",
+         doc="Incident dossier directory ('' disables): when a query "
+             "fails / is shed / exceeds its deadline / hangs / breaches "
+             "its tenant SLO / trips a breaker / leaks resources, a "
+             "self-contained JSON dossier (trace slice, monitor samples, "
+             "doctor breakdown + findings, resolved knobs, ledger line) "
+             "is committed crash-atomically under this directory."),
+    Knob("flight_retention", 64,
+         doc="Bounded dossier retention: the newest N dossiers are kept, "
+             "older ones pruned after each capture."),
+    Knob("flight_triggers", "all",
+         doc="Comma list selecting which incident classes capture "
+             "(failure, shed, deadline, hang, slo_breach, breaker_trip, "
+             "resource_leak); 'all' arms every class."),
+    Knob("progress_enabled", False,
+         doc="Live per-query progress tracking (runtime/progress.py): "
+             "per-stage rows/attempts/ETA served at /queries and "
+             "/queries/<qid>. Off (default) every hook site is one "
+             "truthiness check — same posture as trace/monitor."),
 
     # -- per-operator enable flags (tier b, spark.blaze.enable.<op>) --
     Knob("enable_ops", default_factory=dict,

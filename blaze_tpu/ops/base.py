@@ -152,6 +152,13 @@ def count_stream(op: Operator, stream: BatchStream) -> BatchStream:
         from blaze_tpu.runtime import history
     else:
         history = None
+    # live progress tap (runtime/progress.py): per-stage rows/batches for
+    # the /queries debug endpoint, fed from this same batch boundary.
+    # Same posture again — off, the cost is one truthiness check here.
+    if conf.progress_enabled:
+        from blaze_tpu.runtime import progress
+    else:
+        progress = None
     fault_point = "op." + op.name()  # chaos injection at the op boundary
     try:
         for batch in stream:
@@ -162,6 +169,8 @@ def count_stream(op: Operator, stream: BatchStream) -> BatchStream:
                 trace.on_batch(op, rows)
             if history is not None:
                 history.observe_rows(op, rows)
+            if progress is not None:
+                progress.on_batch(op, rows)
             op.metrics.add("output_batches", 1)
             op.metrics.add("output_rows", rows)
             if stats:

@@ -113,6 +113,7 @@ def run_task_with_resilience(attempt: Callable[[], object], *,
                         trace.event("ladder_rung", what=what, rung=1,
                                     action="halve_batch")
                         _note_rung(run_info, rung)
+                        _note_progress("ladder_rung", "halve_batch")
                         continue
                     if rung == 1:
                         rung = 2
@@ -124,6 +125,7 @@ def run_task_with_resilience(attempt: Callable[[], object], *,
                         trace.event("ladder_rung", what=what, rung=2,
                                     action="force_spill")
                         _note_rung(run_info, rung)
+                        _note_progress("ladder_rung", "force_spill")
                         continue
                     if rung == 2 and fallback is not None:
                         rung = 3
@@ -131,6 +133,7 @@ def run_task_with_resilience(attempt: Callable[[], object], *,
                         trace.event("ladder_rung", what=what, rung=3,
                                     action="fallback")
                         _note_rung(run_info, rung)
+                        _note_progress("ladder_rung", "fallback")
                         return fallback()
                 elif isinstance(e, faults.HungError) and \
                         hang_relaunches < conf.max_task_retries:
@@ -169,6 +172,7 @@ def run_task_with_resilience(attempt: Callable[[], object], *,
                     trace.event("retry", what=what, n=retries,
                                 category=cat,
                                 backoff_ms=round(sleep_s * 1000, 2))
+                    _note_progress("retry", cat)
                     t0 = _time.perf_counter_ns()
                     faults._sleep(sleep_s)
                     if conf.monitor_enabled:
@@ -193,6 +197,19 @@ def run_task_with_resilience(attempt: Callable[[], object], *,
 def _note_rung(run_info: Optional[dict], rung: int) -> None:
     if run_info is not None:
         run_info["ladder_rung"] = max(run_info.get("ladder_rung", 0), rung)
+
+
+def _note_progress(kind: str, detail: str) -> None:
+    """Mirror a resilience event into the live progress registry (the
+    /queries waterfall's retry/rung annotations). One truthiness check
+    when live introspection is off; events are rare, so the lazy import
+    on the enabled path is fine."""
+    from blaze_tpu.config import conf
+
+    if conf.progress_enabled:
+        from blaze_tpu.runtime import progress
+
+        progress.note_event(kind, detail)
 
 
 def _fused_chain(op: MapLikeOp) -> tuple:

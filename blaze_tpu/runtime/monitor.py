@@ -52,6 +52,7 @@ sampler, and the exporters that make the numbers visible.
 from __future__ import annotations
 
 import http.server
+import json
 import threading
 import time
 from collections import deque
@@ -191,6 +192,7 @@ def reset() -> None:
         _queries.clear()
         _active_qid = None
         _leaks_total = 0
+        _endpoint_requests.clear()
 
 
 # -- per-query lifecycle -----------------------------------------------------
@@ -323,6 +325,27 @@ def running_queries() -> List[Dict[str, Any]]:
                 for q in _queries.values()]
 
 
+def query_t0(qid: str) -> Optional[float]:
+    """Wall-clock start of a STILL-REGISTERED query (None after
+    query_end pops it) — the flight recorder's ring-slice window start,
+    read before the roll-up."""
+    with _lock:
+        q = _queries.get(qid)
+        return q.t0 if q is not None else None
+
+
+def query_time_breakdown(qid: str) -> Dict[str, float]:
+    """Live boundary-time accounting for one running query: wall ms per
+    critical-path category accumulated SO FAR (the doctor's term inputs,
+    readable mid-query) — {} when unregistered or monitor disabled."""
+    with _lock:
+        q = _queries.get(qid)
+        if q is None:
+            return {}
+        return {cat: round(ns / 1e6, 3)
+                for cat, ns in sorted(q.time_ns.items())}
+
+
 # -- background sampler ------------------------------------------------------
 
 
@@ -384,6 +407,15 @@ class ResourceMonitor:
 
     def ring(self) -> List[Dict[str, Any]]:
         return list(self._ring)
+
+    def ring_since(self, since_ts: Optional[float] = None
+                   ) -> List[Dict[str, Any]]:
+        """Samples with ts >= since_ts (whole ring when None) — the
+        "gauges over the query's lifetime" slice dossiers embed."""
+        ring = list(self._ring)
+        if since_ts is None:
+            return ring
+        return [s for s in ring if s.get("ts", 0) >= since_ts]
 
     def start(self) -> "ResourceMonitor":
         if self._thread is not None and self._thread.is_alive():
@@ -451,6 +483,9 @@ GAUGE_NAMES = (
     "blaze_slo_attainment",
     "blaze_slo_burn_rate",
     "blaze_slo_breaches_total",
+    "blaze_flight_dossiers_total",
+    "blaze_query_progress_ratio",
+    "blaze_endpoint_requests_total",
 )
 GAUGE_PREFIXES = (
     "blaze_pipeline_",  # pipeline.TELEMETRY counters
@@ -601,6 +636,24 @@ def prometheus_text() -> str:
          [({"tenant": t}, s["breaches"])
           for t, s in sorted(slo.items())])
 
+    # incident capture + live introspection (flight_recorder/progress):
+    # lazy imports — both modules import monitor at module level
+    from blaze_tpu.runtime import flight_recorder, progress
+
+    emit("blaze_flight_dossiers_total", "counter",
+         "Incident dossiers written by the flight recorder, by trigger",
+         [({"trigger": t}, n)
+          for t, n in sorted(flight_recorder.counts().items())])
+    emit("blaze_query_progress_ratio", "gauge",
+         "Live per-query progress ratio (0-1, monotone per query)",
+         [({"qid": s["query_id"]}, s["progress_ratio"])
+          for s in progress.snapshot_queries()])
+    with _lock:
+        reqs = dict(_endpoint_requests)
+    emit("blaze_endpoint_requests_total", "counter",
+         "Debug-endpoint requests served, by route",
+         [({"route": r}, n) for r, n in sorted(reqs.items())])
+
     for prefix, help_text, ms in (
             ("blaze_pipeline", "pipeline telemetry", pipeline.TELEMETRY),
             ("blaze_faults", "resilience telemetry", faults.TELEMETRY),
@@ -638,25 +691,96 @@ def prometheus_text() -> str:
     return "\n".join(lines) + "\n"
 
 
-class MetricsServer:
-    """Prometheus scrape endpoint on a stdlib http.server daemon thread.
-    GET /metrics returns prometheus_text(); port 0 binds an ephemeral
-    port (tests). close() shuts the socket down and joins the thread."""
+# per-route request counters for the debug endpoints (exported as
+# blaze_endpoint_requests_total{route=})
+_endpoint_requests: Dict[str, int] = {}
 
-    def __init__(self, port: int, host: str = "0.0.0.0") -> None:
+
+def _note_request(route: str) -> None:
+    with _lock:
+        _endpoint_requests[route] = _endpoint_requests.get(route, 0) + 1
+
+
+def health_snapshot() -> Dict[str, Any]:
+    """Cheap liveness payload (GET /healthz): ring occupancy + sampler
+    staleness for container probes, without the full exposition."""
+    s = sampler()
+    ring = s.ring() if s is not None else []
+    last_ts = ring[-1].get("ts") if ring else None
+    return {
+        "ok": True,
+        "ring_samples": len(ring),
+        "ring_capacity": int(conf.monitor_ring_samples),
+        "sampler_alive": bool(s is not None and s._thread is not None
+                              and s._thread.is_alive()),
+        "sampler_staleness_s": (round(time.time() - last_ts, 3)
+                                if last_ts is not None else None),
+        "trace_events": len(trace.TRACE),
+        "trace_dropped": trace.TRACE.dropped,
+        "queries_running": len(running_queries()),
+    }
+
+
+def serve_path(path: str) -> Tuple[int, str, bytes]:
+    """Route one debug-endpoint GET -> (status, content-type, body).
+    Factored out of the socket handler so tests and blaze_inspect can
+    hit the routes without a live server."""
+    if path in ("/metrics", "/"):
+        _note_request("metrics")
+        return (200, "text/plain; version=0.0.4",
+                prometheus_text().encode())
+    if path == "/healthz":
+        _note_request("healthz")
+        return (200, "application/json",
+                json.dumps(health_snapshot()).encode())
+    # live introspection (runtime/progress.py): lazy import — progress
+    # imports monitor at module level
+    if path == "/queries":
+        _note_request("queries")
+        from blaze_tpu.runtime import progress
+
+        return (200, "application/json",
+                json.dumps(progress.render_queries(),
+                           default=str).encode())
+    if path.startswith("/queries/"):
+        _note_request("query_detail")
+        from blaze_tpu.runtime import progress
+
+        snap = progress.render_query(path[len("/queries/"):])
+        if snap is None:
+            return (404, "application/json",
+                    b'{"error": "unknown or finished query"}')
+        return (200, "application/json",
+                json.dumps(snap, default=str).encode())
+    _note_request("other")
+    return 404, "text/plain", b"not found"
+
+
+class MetricsServer:
+    """Metrics + debug-endpoint server on a stdlib http.server daemon
+    thread: GET /metrics (Prometheus exposition), /healthz (liveness),
+    /queries and /queries/<qid> (live progress). Port 0 binds an
+    ephemeral port (tests); `host` defaults to conf.metrics_host —
+    loopback unless an operator deliberately exposes it.
+    close() shuts the socket down and joins the thread."""
+
+    def __init__(self, port: int, host: Optional[str] = None) -> None:
+        if host is None:
+            host = str(conf.metrics_host or "127.0.0.1")
+
         class _Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 — http.server contract
-                if self.path.split("?")[0] not in ("/metrics", "/"):
-                    self.send_error(404)
-                    return
                 try:
-                    body = prometheus_text().encode()
+                    status, ctype, body = serve_path(
+                        self.path.split("?")[0])
                 except Exception as e:  # noqa: BLE001 — scrape, not crash
                     self.send_error(500, str(e)[:100])
                     return
+                if status != 200:
+                    self.send_error(status)
+                    return
                 self.send_response(200)
-                self.send_header(
-                    "Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -666,6 +790,7 @@ class MetricsServer:
 
         self._httpd = http.server.ThreadingHTTPServer((host, port),
                                                       _Handler)
+        self.host = host
         self.port = int(self._httpd.server_address[1])
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="blz-metrics",
@@ -707,6 +832,15 @@ def ensure_started() -> Optional[MetricsServer]:
 def sampler() -> Optional[ResourceMonitor]:
     with _global_lock:
         return _sampler
+
+
+def ring_slice(since_ts: Optional[float] = None) -> List[Dict[str, Any]]:
+    """Global-sampler ring samples with ts >= since_ts ([] when the
+    sampler never started) — the flight recorder's monitor slice."""
+    s = sampler()
+    if s is None:
+        return []
+    return s.ring_since(since_ts)
 
 
 def shutdown() -> None:

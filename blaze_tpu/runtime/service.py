@@ -128,7 +128,8 @@ class SloTracker:
         return {"latency_ms": obj, "target": target}
 
     def observe(self, tenant_id: str, latency_ms: float,
-                rejected: bool = False) -> None:
+                rejected: bool = False,
+                query_id: Optional[str] = None) -> None:
         """Score one arrival; emits `slo_burn` when the budget runs hot."""
         sp = self._spec(tenant_id)
         if sp is None:
@@ -152,6 +153,18 @@ class SloTracker:
                         objective_ms=sp["latency_ms"],
                         attainment=stats["attainment"],
                         burn_rate=stats["burn_rate"])
+        # SLO-breach dossier (shed arrivals get their own "shed" dossier
+        # in admit()). No locks held here: _release scores after leaving
+        # the admission section, and capture does file I/O.
+        if not met and not rejected and query_id and conf.flight_dir:
+            from blaze_tpu.runtime import flight_recorder
+
+            flight_recorder.capture(
+                "slo_breach", query_id, tenant_id=tenant_id,
+                detail={"latency_ms": round(latency_ms, 3),
+                        "objective_ms": sp["latency_ms"],
+                        "attainment": stats["attainment"],
+                        "burn_rate": stats["burn_rate"]})
 
     def _stats_locked(self, tenant_id: str,
                       sp: Dict[str, float]) -> Dict[str, Any]:
@@ -262,7 +275,8 @@ class QueryService:
                     tenant_id=session.tenant_id, reason=reason,
                     wait_ms=round(wait_ms, 1))
         self._export_shed_ledger(session, reason)
-        _slo.observe(session.tenant_id, wait_ms, rejected=True)
+        _slo.observe(session.tenant_id, wait_ms, rejected=True,
+                     query_id=session.query_id)
         raise faults.AdmissionRejected(
             f"query {session.query_id} (tenant {session.tenant_id!r}) "
             f"shed at admission: {reason} "
@@ -291,6 +305,25 @@ class QueryService:
         session owns a slot — `_release` it exactly once (run/submit do
         this internally)."""
         session = QuerySession(tenant_id, priority, self.scheduler)
+        try:
+            return self._admit_inner(session)
+        except faults.AdmissionRejected as e:
+            # shed dossier AFTER the admission lock is released (capture
+            # does file I/O; _shed_locked runs holding self._lock)
+            if conf.flight_dir:
+                from blaze_tpu.runtime import flight_recorder
+
+                flight_recorder.capture(
+                    "shed", session.query_id, error=e,
+                    tenant_id=session.tenant_id,
+                    run_info={
+                        "tenant_id": session.tenant_id,
+                        "admission_outcome": "rejected",
+                        "admission_wait_ms":
+                            round(session.admission_wait_ms, 1)})
+            raise
+
+    def _admit_inner(self, session: QuerySession) -> QuerySession:
         parked = False
         with self._slot_free:
             if not self._open:
@@ -338,7 +371,8 @@ class QueryService:
         # total latency since ARRIVAL: admission wait + execution — the
         # same number the ledger line decomposes, scored once per admit
         _slo.observe(session.tenant_id,
-                     (time.monotonic() - session.arrived_at) * 1000.0)
+                     (time.monotonic() - session.arrived_at) * 1000.0,
+                     query_id=session.query_id)
         with self._slot_free:
             self._running -= 1
             self._slot_free.notify_all()

@@ -174,12 +174,14 @@ EVENT_KINDS = (
     "deadline_kill",        # supervisor: budget exhausted mid-attempt
     "degrade",              # executor: resilience-ladder rung taken
     "fault_injected",       # faults.inject: armed point fired
+    "flight_capture",       # flight_recorder: incident dossier written
     "hang_detected",        # supervisor watchdog: heartbeat stale
     "hang_relaunch",        # supervisor: killed attempt relaunched
     "ladder_rung",          # executor: degradation ladder transition
     "mem_release",          # memory: reservation released by sweep
     "orphan_sweep",         # artifacts: stale attempt files removed
     "pipeline_stats",       # pipeline: per-stream close statistics
+    "progress_snapshot",    # monitor endpoints: live progress scraped
     "queue_depth",          # pipeline: sampler queue-depth reading
     "resource_leak",        # monitor: leaked reservation/stream detected
     "retry",                # executor: retryable failure retried
@@ -492,6 +494,41 @@ def fmt_metric(k: str, v) -> str:
     if k.endswith("_bytes"):
         return f"{k}={human_bytes(v)}"
     return f"{k}={v}"
+
+
+def metric_report(root) -> str:
+    """Operator tree with its metrics, one line per op (post-run) — the
+    analog of the reference's metric push into the Spark UI
+    (blaze/src/metrics.rs:21-50), absorbed from the retired
+    runtime/tracing.py shim.
+
+    Counters are read via MetricsSet.snapshot() — supervisor pool
+    threads mutate the raw dicts while a report renders, and iterating
+    them unlocked raises RuntimeError("dict changed size during
+    iteration"). `*_ns` values render as ms, `*_bytes` as KiB/MiB
+    (fmt_metric). For the span-correlated superset (stage wall-times,
+    throughput, resilience annotations) use explain_analyze(root,
+    run_info)."""
+    lines: List[str] = []
+
+    def walk(op, depth: int) -> None:
+        vals = {k: v for k, v in op.metrics.snapshot().items() if v}
+        shown = ", ".join(fmt_metric(k, v)
+                          for k, v in sorted(vals.items()))
+        lines.append("  " * depth + f"{op.name()}: {shown}")
+        for c in op.children:
+            walk(c, depth + 1)
+
+    walk(root, 0)
+    from blaze_tpu.runtime import compile_service, faults
+
+    # both summaries include their per-category breakdowns (the faults
+    # one appends [plan=1 retryable=2 ...] error counts, not only totals)
+    for summary in (compile_service.telemetry_summary(),
+                    faults.telemetry_summary()):
+        if summary:
+            lines.append(summary)
+    return "\n".join(lines)
 
 
 _RESILIENCE_EVENT_KINDS = (

@@ -1,70 +1,17 @@
-"""LEGACY report helpers — the instrumentation itself lives in trace.py.
+"""DEPRECATED import shim — everything lives in runtime/trace.py.
 
-Role split (also recorded on the `profiler_dir` knob in config.py): the
-engine has ONE instrumentation pathway, runtime/trace.py — structured
-spans/events, exporters, EXPLAIN ANALYZE, and (since the query-doctor
-change) the device-side XLA profiler capture as a "profile" span kind
-(`trace.profiled_span`). This module keeps two things alive:
-
-  profiled_scope   a thin alias of trace.profiled_span, preserved so
-                   embedder code written against the old import path
-                   (`from blaze_tpu.runtime.tracing import
-                   profiled_scope`) keeps working — including the
-                   `profiler_dir` knob semantics (no capture when unset,
-                   the scope is then just an engine-trace span).
-
-  metric_report    the textual per-operator metric tree (the analog of
-                   the reference's metric push into the Spark UI,
-                   blaze/src/metrics.rs:21-50).
-
-For the ENGINE-side timeline — spans/events with query/stage/task/attempt
-correlation ids, Chrome/Perfetto export, the EXPLAIN ANALYZE tree
-(`trace.explain_analyze`, a superset of `metric_report`) and the per-query
-run ledger — see runtime/trace.py. With conf.profiler_dir set the
-"profile" span ALSO captures an XLA/TPU trace viewable in TensorBoard/
-Perfetto — device kernel timelines next to the runtime's own spans; load
-both in Perfetto side by side (README "Observability").
+The legacy device-profiler module was folded into the structured engine
+trace: `profiled_scope` became `trace.profiled_span` (a "profile" span
+that also captures a jax.profiler/TensorBoard trace when
+conf.profiler_dir is set) and `metric_report` moved to
+`trace.metric_report` verbatim. These aliases keep old embedder import
+paths working; new code should import from blaze_tpu.runtime.trace.
 """
 
 from __future__ import annotations
 
-from typing import List
-
-# Alias, not a wrapper: the single span-kind pathway in trace.py is the
-# implementation; this name survives for the legacy import path only.
+# Aliases, not wrappers: trace.py is the implementation.
+from blaze_tpu.runtime.trace import metric_report  # noqa: F401
 from blaze_tpu.runtime.trace import profiled_span as profiled_scope  # noqa: F401
 
 __all__ = ["profiled_scope", "metric_report"]
-
-
-def metric_report(root) -> str:
-    """Operator tree with its metrics, one line per op (post-run).
-
-    Counters are read via MetricsSet.snapshot() — supervisor pool
-    threads mutate the raw dicts while a report renders, and iterating
-    them unlocked raises RuntimeError("dict changed size during
-    iteration"). `*_ns` values render as ms, `*_bytes` as KiB/MiB
-    (trace.fmt_metric). For the span-correlated superset (stage
-    wall-times, throughput, resilience annotations) use
-    trace.explain_analyze(root, run_info)."""
-    from blaze_tpu.runtime.trace import fmt_metric
-
-    lines: List[str] = []
-
-    def walk(op, depth: int) -> None:
-        vals = {k: v for k, v in op.metrics.snapshot().items() if v}
-        shown = ", ".join(fmt_metric(k, v) for k, v in sorted(vals.items()))
-        lines.append("  " * depth + f"{op.name()}: {shown}")
-        for c in op.children:
-            walk(c, depth + 1)
-
-    walk(root, 0)
-    from blaze_tpu.runtime import compile_service, faults
-
-    # both summaries include their per-category breakdowns (the faults
-    # one appends [plan=1 retryable=2 ...] error counts, not only totals)
-    for summary in (compile_service.telemetry_summary(),
-                    faults.telemetry_summary()):
-        if summary:
-            lines.append(summary)
-    return "\n".join(lines)

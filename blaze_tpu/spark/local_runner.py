@@ -97,6 +97,10 @@ def run_plan(root: SparkPlan, num_partitions: int = 4,
     # whole-stage group cardinality accumulate under this qid until
     # record_run pops them at close (no-op with conf.history_dir unset)
     history.begin_query(qid)
+    if conf.progress_enabled:
+        from blaze_tpu.runtime import progress
+
+        progress.begin_query(qid, tenant_id=tenant or None)
     # the query's driver thread advertises its session for ladder/batch
     # scoping (supervisor.current_session) — pool workers inherit it
     # through their _Task instead
@@ -118,6 +122,9 @@ def run_plan(root: SparkPlan, num_partitions: int = 4,
                                            run_info, session)
     finally:
         supervisor_mod._current.session = prev_session
+        # the flight recorder needs the query's wall-clock start for its
+        # monitor-ring slice; finish_query pops the acct holding it
+        t0 = monitor.query_t0(qid) if conf.flight_dir else None
         # roll-ups (bytes by boundary, peak memory, spill, compile ms)
         # merged into run_info BEFORE the ledger export, plus the
         # always-on leak check (resource_leak event + counter)
@@ -130,6 +137,17 @@ def run_plan(root: SparkPlan, num_partitions: int = 4,
         # roll-up so the record carries the byte/spill/compile counters)
         if conf.history_dir:
             history.record_run(qid, run_info)
+        if conf.flight_dir:
+            # black-box dossier on failure / deadline / hang / leak —
+            # classifies the in-flight exception via sys.exc_info (this
+            # finally runs while it propagates; run_plan has no except)
+            from blaze_tpu.runtime import flight_recorder
+
+            flight_recorder.on_query_end(qid, run_info, started_at=t0)
+        if conf.progress_enabled:
+            from blaze_tpu.runtime import progress
+
+            progress.finish_query(qid)
 
 
 def _run_plan_inner(root: SparkPlan, num_partitions: int,
@@ -199,6 +217,13 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
     # admission-stamped query deadline; breaker state stays per-query
     # (one CircuitBreaker per Supervisor, one Supervisor per run_plan).
     sup = Supervisor(run_info, session=session)
+    # live-introspection taps (runtime/progress.py): conditional import
+    # once per run, one is-None check per stage — zero work when off
+    if conf.progress_enabled:
+        from blaze_tpu.runtime import progress
+    else:
+        progress = None
+    qid = run_info.get("query_id", "")
     try:
         for stage in stages:
             # re-optimize THIS stage with the statistics of completed
@@ -218,6 +243,12 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
             # records it (neither tracing nor the history store is on).
             fp = (fingerprint_plan(stage.plan)
                   if conf.trace_enabled or conf.history_dir else None)
+            if progress is not None:
+                progress.stage_begin(
+                    qid, stage.stage_id, stage.kind, fingerprint=fp,
+                    tasks=(1 if stage.kind == "broadcast"
+                           else _input_tasks(stage, stages,
+                                             fallback=num_partitions)))
             if stage.kind == "shuffle_map":
                 shuffle_parts[stage.stage_id] = stage.num_partitions
                 with trace.span("stage", stage_id=stage.stage_id,
@@ -259,6 +290,8 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
                                    **monitor.stage_span_attrs(
                                        run_info["query_id"],
                                        stage.stage_id))
+                            if progress is not None:
+                                progress.stage_end(qid, stage.stage_id)
                             continue
                     logical = _run_shuffle_stage(stage, stages, shuffle_mgr,
                                                  sup, run_info, ns=ns)
@@ -270,6 +303,8 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
                     sp.set(transport="file", bytes=logical,
                            **monitor.stage_span_attrs(
                                run_info["query_id"], stage.stage_id))
+                if progress is not None:
+                    progress.stage_end(qid, stage.stage_id)
             elif stage.kind == "broadcast":
                 with trace.span("stage", stage_id=stage.stage_id,
                                 stage_kind="broadcast", fingerprint=fp,
@@ -279,6 +314,8 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
                     sp.set(**monitor.stage_span_attrs(
                         run_info["query_id"], stage.stage_id))
                 run_info["broadcast_stages"] += 1
+                if progress is not None:
+                    progress.stage_end(qid, stage.stage_id)
             else:
                 parts = _input_tasks(stage, stages, fallback=num_partitions)
                 with trace.span("stage", stage_id=stage.stage_id,
@@ -287,6 +324,8 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
                     out = _run_result_stage(stage, parts, sup, run_info)
                     sp.set(**monitor.stage_span_attrs(
                         run_info["query_id"], stage.stage_id))
+                if progress is not None:
+                    progress.stage_end(qid, stage.stage_id)
                 return _merge_fallback_root_sort(root, out, parts)
         raise AssertionError("no result stage produced")
     finally:

@@ -1,0 +1,274 @@
+"""Flight recorder (runtime/flight_recorder.py): one schema-versioned
+dossier per incident class — failure / shed / deadline / slo_breach /
+breaker_trip / resource_leak — captured crash-atomically under
+conf.flight_dir, exactly once per (query, trigger), with bounded
+retention, thread stacks on watchdog kills, and the disabled path
+costing nothing and writing nothing."""
+
+import os
+import time
+
+import pytest
+
+from blaze_tpu.config import conf
+from blaze_tpu.runtime import artifacts, faults, flight_recorder
+from blaze_tpu.runtime import monitor, trace
+from blaze_tpu.runtime import service as svc_mod
+from blaze_tpu.runtime.service import QueryService
+from blaze_tpu.runtime.supervisor import CircuitBreaker
+
+
+@pytest.fixture(autouse=True)
+def _clean_flight_conf():
+    saved = {k: getattr(conf, k) for k in (
+        "flight_dir", "flight_retention", "flight_triggers",
+        "trace_enabled", "monitor_enabled", "history_dir",
+        "max_task_retries", "enable_degradation_ladder",
+        "query_deadline_ms", "task_deadline_ms", "hang_detect_ms",
+        "max_concurrent_tasks", "tenant_slo_spec",
+        "breaker_failure_threshold", "fault_injection_spec")}
+    flight_recorder.reset()
+    trace.reset()
+    monitor.reset()
+    svc_mod.reset_slo()
+    yield
+    for k, v in saved.items():
+        setattr(conf, k, v)
+    faults.install(None)
+    faults.reset_telemetry()
+    flight_recorder.reset()
+    svc_mod.reset_slo()
+    trace.reset()
+    monitor.reset()
+
+
+@pytest.fixture(scope="module")
+def tables(tmp_path_factory):
+    from blaze_tpu.spark import validator
+
+    d = str(tmp_path_factory.mktemp("flight_tables"))
+    return validator.generate_tables(d, rows=2000)
+
+
+def _dossiers(d):
+    return sorted(n for n in os.listdir(d)
+                  if n.startswith("dossier_") and n.endswith(".json"))
+
+
+# ---------------------------------------------------------------------------
+# gating, dedupe, retention, atomicity
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_is_inert():
+    conf.flight_dir = ""
+    assert not flight_recorder.enabled("failure")
+    assert flight_recorder.capture(
+        "failure", "q1", error=RuntimeError("x")) is None
+    assert flight_recorder.counts() == {}
+
+
+def test_trigger_filter_selects_classes(tmp_path):
+    conf.flight_dir = str(tmp_path)
+    conf.flight_triggers = "deadline,hang"
+    assert not flight_recorder.enabled("failure")
+    assert flight_recorder.capture(
+        "failure", "q1", error=RuntimeError("x")) is None
+    assert _dossiers(tmp_path) == []
+    assert flight_recorder.enabled("deadline")
+    path = flight_recorder.capture("deadline", "q1",
+                                   error=faults.DeadlineError("late"))
+    assert path and os.path.exists(path)
+
+
+def test_capture_exactly_once_per_query_trigger(tmp_path):
+    conf.flight_dir = str(tmp_path)
+    p1 = flight_recorder.capture("failure", "qdup",
+                                 error=RuntimeError("boom"))
+    assert p1 is not None
+    # a retry storm re-reporting the same incident writes nothing new
+    assert flight_recorder.capture("failure", "qdup",
+                                   error=RuntimeError("boom")) is None
+    assert len(_dossiers(tmp_path)) == 1
+    # a DIFFERENT trigger on the same query is its own incident
+    assert flight_recorder.capture("resource_leak", "qdup",
+                                   detail={"resource_leaks": 1})
+    assert len(_dossiers(tmp_path)) == 2
+    assert flight_recorder.counts() == {"failure": 1, "resource_leak": 1}
+
+
+def test_retention_keeps_newest_and_no_temps(tmp_path):
+    conf.flight_dir = str(tmp_path)
+    conf.flight_retention = 3
+    for i in range(6):
+        assert flight_recorder.capture(
+            "failure", f"q{i}", error=RuntimeError(f"e{i}"))
+    names = _dossiers(tmp_path)
+    assert len(names) == 3
+    # filenames embed a ms stamp: name order is time order, newest kept
+    assert [n.rsplit("_", 1)[1] for n in names] == \
+        ["q3.json", "q4.json", "q5.json"]
+    # crash-atomic commit leaves no in-progress temps behind
+    assert not [n for n in os.listdir(tmp_path)
+                if artifacts.ORPHAN_TAG in n]
+
+
+def test_capture_failure_is_swallowed(tmp_path):
+    blocker = tmp_path / "not_a_dir"
+    blocker.write_text("x")  # makedirs(flight_dir) will fail
+    conf.flight_dir = str(blocker)
+    assert flight_recorder.capture(
+        "failure", "qerr", error=RuntimeError("boom")) is None
+    assert flight_recorder.last_error()
+
+
+# ---------------------------------------------------------------------------
+# per-trigger capture paths
+# ---------------------------------------------------------------------------
+
+
+def test_failure_dossier_end_to_end(tables, tmp_path):
+    from blaze_tpu.spark import validator
+    from blaze_tpu.spark.local_runner import run_plan
+
+    conf.flight_dir = str(tmp_path / "flight")
+    conf.trace_enabled = True
+    conf.monitor_enabled = True
+    conf.max_task_retries = 0
+    conf.enable_degradation_ladder = False
+    paths, frames = tables
+    plan, _ = validator.QUERIES["q2_q06_core_agg"](paths, frames, "bhj")
+    faults.install({"seed": 7,
+                    "points": {"serde.encode": {"nth": 1, "kind": "io"}}})
+    try:
+        with pytest.raises(Exception):
+            run_plan(plan, num_partitions=4, mesh_exchange="off",
+                     run_info={})
+    finally:
+        faults.install(None)
+
+    rows = flight_recorder.list_dossiers(conf.flight_dir)
+    assert len(rows) == 1
+    assert rows[0]["trigger"] == "failure"
+    doc = flight_recorder.load(rows[0]["path"])
+    assert doc["schema_version"] == flight_recorder.SCHEMA_VERSION
+    assert doc["query_id"] == rows[0]["query_id"]
+    assert doc["error"]["type"]
+    assert doc["trace_events"], "trace-ring slice must be embedded"
+    assert doc["knobs"]["flight_dir"] == conf.flight_dir
+    assert doc["knobs"]["max_task_retries"] == 0
+    assert isinstance(doc["critical_path"], dict) and doc["critical_path"]
+    assert isinstance(doc["findings"], list)
+    assert doc["ledger"].get("query_id") == doc["query_id"]
+
+
+def test_deadline_dossier_has_thread_stacks(tables, tmp_path):
+    from blaze_tpu.spark import validator
+    from blaze_tpu.spark.local_runner import run_plan
+
+    conf.flight_dir = str(tmp_path / "flight")
+    conf.trace_enabled = True
+    conf.query_deadline_ms = 600
+    paths, frames = tables
+    plan, _ = validator.QUERIES["q1_scan_filter_project"](paths, frames,
+                                                          "bhj")
+    faults.install({"seed": 23, "points": {"op": {"kind": "stall",
+                                                  "nth": 1, "ms": 30_000}}})
+    try:
+        with pytest.raises(faults.DeadlineError):
+            run_plan(plan, num_partitions=4, mesh_exchange="off",
+                     run_info={})
+    finally:
+        faults.install(None)
+
+    rows = [r for r in flight_recorder.list_dossiers(conf.flight_dir)
+            if r["trigger"] == "deadline"]
+    assert len(rows) == 1
+    doc = flight_recorder.load(rows[0]["path"])
+    assert doc["error"]["type"] == "DeadlineError"
+    stacks = doc["thread_stacks"]
+    assert stacks and stacks["stacks"], \
+        "deadline dossiers must carry the where-was-everyone page"
+    assert any(st["frames"] for st in stacks["stacks"])
+
+
+def test_shed_dossier_from_admission_reject(tmp_path):
+    conf.flight_dir = str(tmp_path)
+    with QueryService(max_concurrent=1, queue_depth=0) as svc:
+        hold = svc.admit("acme")
+        with pytest.raises(faults.AdmissionRejected):
+            svc.admit("globex")
+        svc._release(hold)
+    rows = flight_recorder.list_dossiers(conf.flight_dir)
+    shed = [r for r in rows if r["trigger"] == "shed"]
+    assert len(shed) == 1
+    doc = flight_recorder.load(shed[0]["path"])
+    assert doc["tenant_id"] == "globex"
+    assert doc["error"]["type"] == "AdmissionRejected"
+    assert doc["ledger"]["admission_outcome"] == "rejected"
+
+
+def test_slo_breach_dossier_from_release_scoring(tmp_path):
+    conf.flight_dir = str(tmp_path)
+    conf.tenant_slo_spec = {"acme": {"latency_ms": 5.0, "target": 0.9}}
+    svc_mod.reset_slo()
+    with QueryService(max_concurrent=2, queue_depth=0) as svc:
+        s = svc.admit("acme")
+        time.sleep(0.05)  # total latency >> the 5ms objective
+        svc._release(s)
+    rows = [r for r in flight_recorder.list_dossiers(conf.flight_dir)
+            if r["trigger"] == "slo_breach"]
+    assert len(rows) == 1
+    doc = flight_recorder.load(rows[0]["path"])
+    assert doc["tenant_id"] == "acme"
+    assert doc["detail"]["objective_ms"] == 5.0
+    assert doc["detail"]["latency_ms"] > 5.0
+
+
+def test_breaker_trip_dossier(tmp_path):
+    conf.flight_dir = str(tmp_path)
+    conf.breaker_failure_threshold = 1
+    br = CircuitBreaker(run_info={})
+    err = faults.RetryableError("persistent operator failure")
+    err.point = "op.FilterExec"
+    with trace.context(query_id="qbrk"):
+        br.note_failure(err, "transient")
+    rows = [r for r in flight_recorder.list_dossiers(conf.flight_dir)
+            if r["trigger"] == "breaker_trip"]
+    assert len(rows) == 1
+    doc = flight_recorder.load(rows[0]["path"])
+    assert doc["query_id"] == "qbrk"
+    assert doc["detail"] == {"op_kind": "FilterExec", "failures": 1}
+
+
+def test_resource_leak_dossier_on_clean_exit(tmp_path):
+    conf.flight_dir = str(tmp_path)
+    # no propagating exception: on_query_end must still flag the leak
+    flight_recorder.on_query_end(
+        "qleak", {"query_id": "qleak", "resource_leaks": 2})
+    rows = flight_recorder.list_dossiers(conf.flight_dir)
+    assert [r["trigger"] for r in rows] == ["resource_leak"]
+    doc = flight_recorder.load(rows[0]["path"])
+    assert doc["detail"] == {"resource_leaks": 2}
+
+
+def test_clean_query_writes_no_dossier(tables, tmp_path):
+    from blaze_tpu.spark import validator
+    from blaze_tpu.spark.local_runner import run_plan
+
+    conf.flight_dir = str(tmp_path / "flight")
+    conf.trace_enabled = True
+    conf.monitor_enabled = True
+    paths, frames = tables
+    plan, _ = validator.QUERIES["q2_q06_core_agg"](paths, frames, "bhj")
+    run_plan(plan, num_partitions=4, mesh_exchange="off", run_info={})
+    assert flight_recorder.list_dossiers(conf.flight_dir) == []
+    assert flight_recorder.counts() == {}
+
+
+def test_dossiers_total_gauge_exported(tmp_path):
+    conf.flight_dir = str(tmp_path)
+    conf.monitor_enabled = True
+    flight_recorder.capture("failure", "qg", error=RuntimeError("x"))
+    text = monitor.prometheus_text()
+    assert 'blaze_flight_dossiers_total{trigger="failure"} 1' in text

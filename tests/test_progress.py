@@ -1,0 +1,266 @@
+"""Live query introspection (runtime/progress.py + the debug endpoints
+on the metrics server): per-stage waterfalls fed from the runner and the
+batch-boundary heartbeat, monotone progress ratios, history-driven ETA,
+attempt/retry/rung annotations, GET /queries + /queries/<qid> +
+/healthz routing, and the disabled path keeping the registry empty."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from blaze_tpu.config import conf
+from blaze_tpu.runtime import monitor, progress, trace
+
+
+@pytest.fixture(autouse=True)
+def _clean_progress_conf():
+    saved = {k: getattr(conf, k) for k in (
+        "progress_enabled", "trace_enabled", "monitor_enabled",
+        "metrics_port", "metrics_host", "history_dir",
+        "tenant_slo_spec")}
+    progress.reset()
+    monitor.reset()
+    trace.reset()
+    yield
+    for k, v in saved.items():
+        setattr(conf, k, v)
+    progress.reset()
+    monitor.shutdown()
+    monitor.reset()
+    trace.reset()
+
+
+@pytest.fixture(scope="module")
+def tables(tmp_path_factory):
+    from blaze_tpu.spark import validator
+
+    d = str(tmp_path_factory.mktemp("progress_tables"))
+    return validator.generate_tables(d, rows=2000)
+
+
+# ---------------------------------------------------------------------------
+# registry lifecycle + snapshots (unit level)
+# ---------------------------------------------------------------------------
+
+
+def test_summary_fields_and_slo_headroom():
+    conf.tenant_slo_spec = {"t1": {"latency_ms": 10_000, "target": 0.9}}
+    progress.begin_query("qa", tenant_id="t1")
+    progress.stage_begin("qa", 0, "shuffle")
+    rows = progress.snapshot_queries()
+    assert len(rows) == 1
+    s = rows[0]
+    assert s["query_id"] == "qa" and s["tenant_id"] == "t1"
+    assert s["phase"] == "stage:0"
+    assert s["stages_total"] == 1 and s["stages_done"] == 0
+    assert s["slo_objective_ms"] == 10_000
+    assert s["slo_headroom_ms"] is not None and s["slo_headroom_ms"] > 0
+    assert 0.0 <= s["progress_ratio"] < 1.0
+    progress.finish_query("qa")
+    assert progress.active() == []
+
+
+def test_ratio_is_monotone_and_never_claims_done():
+    progress.begin_query("qm")
+    last = 0.0
+    for sid in range(3):
+        progress.stage_begin("qm", sid, "map")
+        r = progress.snapshot_queries()[0]["progress_ratio"]
+        assert r >= last
+        last = r
+        progress.stage_end("qm", sid)
+        r = progress.snapshot_queries()[0]["progress_ratio"]
+        assert r >= last
+        last = r
+    # stage-count fallback: all stages done but the query still live —
+    # the ratio must not claim completion (total count unknown mid-run)
+    assert last < 1.0
+
+
+def test_batch_rows_attributed_via_context_and_fallback():
+    progress.begin_query("qb")
+    progress.stage_begin("qb", 2, "scan")
+    with trace.context(query_id="qb", stage_id=2):
+        progress.on_batch(None, 100)
+    # no context: the single-live-query + current-stage fallback applies
+    progress.on_batch(None, 50)
+    snap = progress.snapshot_query("qb")
+    assert snap["rows"] == 150
+    st = snap["stages"][0]
+    assert st["rows"] == 150 and st["batches"] == 2
+
+
+def test_attempts_retries_and_rungs_land_on_waterfall():
+    progress.begin_query("qw")
+    progress.stage_begin("qw", 1, "agg", tasks=4)
+    ctx = {"query_id": "qw", "stage_id": 1, "task_id": 7}
+    progress.attempt_update(ctx, "a1", "running")
+    progress.attempt_update(ctx, "a2", "running", speculative=True)
+    progress.attempt_update(ctx, "a1", "killed:hung")
+    progress.attempt_update(ctx, "a2", "ok", speculative=True)
+    with trace.context(query_id="qw", stage_id=1):
+        progress.note_event("retry", "transient")
+        progress.note_event("ladder_rung", "halve_batch")
+    st = progress.snapshot_query("qw")["stages"][0]
+    states = {a["attempt_id"]: a["state"] for a in st["attempts"]}
+    assert states == {"a1": "killed:hung", "a2": "ok"}
+    assert any(a["speculative"] for a in st["attempts"])
+    assert st["speculations"] == 1
+    assert st["retries"] == 1 and st["rungs"] == ["halve_batch"]
+
+
+def test_eta_from_stage_expectations(monkeypatch):
+    monkeypatch.setattr(progress, "_stage_expectation", lambda fp: 50.0)
+    progress.begin_query("qe")
+    progress.stage_begin("qe", 0, "scan", fingerprint="fp0")
+    progress.stage_end("qe", 0)
+    progress.stage_begin("qe", 1, "agg", fingerprint="fp1")
+    s = progress.snapshot_queries()[0]
+    # one finished + one just-started 50ms stage: ~50ms remains
+    assert s["eta_ms"] is not None and 0.0 <= s["eta_ms"] <= 50.0
+    # expected-cost weighting: halfway through the known work
+    assert 0.4 <= s["progress_ratio"] <= 0.99
+
+
+def test_eta_null_without_history():
+    conf.history_dir = ""
+    progress.begin_query("qn")
+    progress.stage_begin("qn", 0, "scan", fingerprint="fp0")
+    assert progress.snapshot_queries()[0]["eta_ms"] is None
+
+
+def test_disabled_keeps_registry_empty(tables):
+    from blaze_tpu.spark import validator
+    from blaze_tpu.spark.local_runner import run_plan
+
+    conf.progress_enabled = False
+    paths, frames = tables
+    plan, _ = validator.QUERIES["q2_q06_core_agg"](paths, frames, "bhj")
+    run_plan(plan, num_partitions=4, mesh_exchange="off", run_info={})
+    assert progress.active() == []
+    status, _, body = monitor.serve_path("/queries")
+    assert status == 200 and json.loads(body) == []
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: a real catalogue run under the tracker
+# ---------------------------------------------------------------------------
+
+
+def test_real_run_tracks_stages_monotonically(tables):
+    from blaze_tpu.spark import validator
+    from blaze_tpu.spark.local_runner import run_plan
+
+    conf.progress_enabled = True
+    conf.trace_enabled = True
+    conf.monitor_enabled = True
+    paths, frames = tables
+    plan, _ = validator.QUERIES["q3_join_agg_sort"](paths, frames, "smj")
+
+    snaps = []
+    stop = threading.Event()
+
+    def scraper():
+        while not stop.is_set():
+            for s in progress.snapshot_queries():
+                snaps.append(s)
+            time.sleep(0.001)
+
+    t = threading.Thread(target=scraper)
+    t.start()
+    try:
+        run_plan(plan, num_partitions=4, mesh_exchange="off", run_info={})
+    finally:
+        stop.set()
+        t.join(timeout=10)
+
+    assert progress.active() == [], "registry must drain at query end"
+    assert snaps, "a ~0.5s query scraped at 1ms must be seen live"
+    assert any(s["stages_total"] >= 1 for s in snaps)
+    assert any(s["rows"] > 0 for s in snaps)
+    ratios = [s["progress_ratio"] for s in snaps]
+    assert all(b >= a for a, b in zip(ratios, ratios[1:]))
+    assert all(0.0 <= r < 1.0 for r in ratios)
+
+
+# ---------------------------------------------------------------------------
+# HTTP endpoints (metrics server routing)
+# ---------------------------------------------------------------------------
+
+
+def test_endpoints_serve_live_registry():
+    conf.monitor_enabled = True
+    conf.trace_enabled = True
+    progress.begin_query("qhttp", tenant_id="acme")
+    progress.stage_begin("qhttp", 0, "scan")
+    srv = monitor.MetricsServer(0)
+    url = f"http://127.0.0.1:{srv.port}"
+    try:
+        with urllib.request.urlopen(f"{url}/queries", timeout=10) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"] == "application/json"
+            rows = json.loads(r.read())
+        assert [q["query_id"] for q in rows] == ["qhttp"]
+
+        with urllib.request.urlopen(f"{url}/queries/qhttp",
+                                    timeout=10) as r:
+            detail = json.loads(r.read())
+        assert detail["tenant_id"] == "acme"
+        assert [st["stage_id"] for st in detail["stages"]] == [0]
+        assert set(detail["stages"][0]) >= {
+            "kind", "state", "started_offset_ms", "elapsed_ms", "rows",
+            "attempts", "retries", "rungs", "speculations"}
+        assert isinstance(detail["critical_path_so_far_ms"], dict)
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{url}/queries/nope", timeout=10)
+        assert ei.value.code == 404
+
+        progress.finish_query("qhttp")
+        with urllib.request.urlopen(f"{url}/queries", timeout=10) as r:
+            assert json.loads(r.read()) == []
+    finally:
+        srv.close()
+
+    # the scrapes themselves joined the trace record
+    kinds = [r["kind"] for r in trace.TRACE.snapshot()
+             if r.get("kind") == "progress_snapshot"]
+    assert kinds, "endpoint scrapes must emit progress_snapshot events"
+
+
+def test_healthz_payload():
+    conf.monitor_enabled = True
+    status, ctype, body = monitor.serve_path("/healthz")
+    assert status == 200 and ctype == "application/json"
+    doc = json.loads(body)
+    assert doc["ok"] is True
+    assert set(doc) >= {"ring_samples", "ring_capacity", "sampler_alive",
+                        "trace_events", "queries_running"}
+
+
+def test_server_binds_loopback_by_default():
+    assert conf.metrics_host == "127.0.0.1"
+    srv = monitor.MetricsServer(0)
+    try:
+        assert srv.host == "127.0.0.1"
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz", timeout=10) as r:
+            assert r.status == 200
+    finally:
+        srv.close()
+
+
+def test_progress_ratio_gauge_exported():
+    conf.monitor_enabled = True
+    progress.begin_query("qgauge")
+    progress.stage_begin("qgauge", 0, "scan")
+    progress.stage_end("qgauge", 0)
+    text = monitor.prometheus_text()
+    assert 'blaze_query_progress_ratio{qid="qgauge"}' in text
+    monitor.serve_path("/queries")
+    text = monitor.prometheus_text()
+    assert 'blaze_endpoint_requests_total{route="queries"} 1' in text

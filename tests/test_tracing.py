@@ -1,6 +1,8 @@
-"""Profiling hooks (runtime/tracing.py): jax profiler traces + the metric
-report (SURVEY §5.4 — the reference surfaces per-op metrics in the Spark
-UI; we additionally capture XLA device timelines)."""
+"""Profiling hooks (trace.profiled_span / trace.metric_report): jax
+profiler traces + the metric report (SURVEY §5.4 — the reference
+surfaces per-op metrics in the Spark UI; we additionally capture XLA
+device timelines). runtime/tracing.py survives only as a deprecation
+shim; the alias test below pins its names to the trace.py pathway."""
 
 import os
 
@@ -12,7 +14,8 @@ from blaze_tpu.config import conf
 from blaze_tpu.exprs import ir
 from blaze_tpu.ops.basic import FilterExec, MemorySourceExec
 from blaze_tpu.runtime.executor import collect
-from blaze_tpu.runtime.tracing import metric_report, profiled_scope
+from blaze_tpu.runtime.trace import metric_report
+from blaze_tpu.runtime.tracing import profiled_scope  # legacy shim path
 
 
 def test_profiler_trace_written(tmp_path, rng):
@@ -50,9 +53,10 @@ def test_profiled_scope_is_the_trace_span_pathway():
     """The legacy name is an alias of trace.profiled_span — one
     instrumentation pathway; with tracing on, the block lands in the
     ring as a "profile" span carrying the scope name."""
-    from blaze_tpu.runtime import trace
+    from blaze_tpu.runtime import trace, tracing
 
     assert profiled_scope is trace.profiled_span
+    assert tracing.metric_report is trace.metric_report
     saved = conf.trace_enabled
     conf.trace_enabled = True
     trace.reset()

@@ -41,6 +41,7 @@ RECORD_FUNCS: Dict[str, Tuple[Set[str], Tuple[str, ...]]] = {
     "history": ({"observe_rows", "observe_groups", "record_run"},
                 ("history_dir", "history")),
     "faults": ({"inject"}, ("fault_injection_spec",)),
+    "progress": ({"on_batch"}, ("progress_enabled", "progress")),
 }
 
 
