@@ -10,10 +10,10 @@ PYENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 .PHONY: check check-fast check-faults check-supervisor check-trace \
 	check-pipeline check-pipeline-soak check-perf check-perf-update \
 	check-obs check-history check-lint check-service check-doctor \
-	check-flight test test-fast validate validate-fast warm
+	check-flight check-executors test test-fast validate validate-fast warm
 
 check: check-lint test validate check-perf check-history check-service \
-	check-doctor check-flight
+	check-doctor check-flight check-executors
 	@echo "CHECK OK — safe to commit"
 
 # Static invariant gate (tools/blazelint): lock discipline, knob
@@ -149,6 +149,18 @@ check-doctor:
 # (valid summary schema, monotone progress). Emits FLIGHT_r15.json.
 check-flight:
 	$(PYENV) python tools/blaze_inspect.py --gate --json-out FLIGHT_r15.json
+
+# Process-executor gate (ISSUE 12): weak-scaling smoke at 1/2/4
+# executor processes (task throughput must grow with seats), the
+# validator catalogue carried by the pool at each seat count (answers
+# diffed against the pandas oracle, >= 1 stage actually pooled), and
+# SIGKILL / SIGTERM / hung kill-recovery rounds fired at a busy
+# executor mid-stage — each must recover to the oracle with exactly one
+# executor_death dossier, a shrink-then-recover capacity timeline, zero
+# leaks, and zombie late results epoch-fenced. Emits EXECUTORS_r16.json.
+check-executors:
+	$(PYENV) python tools/chaos_soak.py --executors \
+	  --json-out EXECUTORS_r16.json
 
 # Pre-warm the persistent compile caches (runtime/compile_service):
 # replays the shape manifest + the TPC-DS catalogue into the XLA cache.

@@ -324,6 +324,32 @@ _DECLARATIONS: Tuple[Knob, ...] = (
              "/queries/<qid>. Off (default) every hook site is one "
              "truthiness check — same posture as trace/monitor."),
 
+    # -- process-isolated executors (runtime/executor_pool.py) --
+    Knob("executor_count", 0,
+         doc="Process-isolated executor pool width: N worker processes "
+             "each owning a virtual device slice, fed TaskSpecs over a "
+             "length-prefixed control socket. 0 (default) keeps the "
+             "single-process thread runtime."),
+    Knob("executor_slots", 2,
+         doc="Concurrent task slots per executor process; the service's "
+             "admission capacity degrades to live_executors x slots when "
+             "a pool is attached."),
+    Knob("executor_heartbeat_ms", 100,
+         doc="Executor -> driver heartbeat period over the control "
+             "socket (a worker thread pushes beats; any inbound frame "
+             "also refreshes liveness)."),
+    Knob("executor_death_ms", 2000,
+         doc="Heartbeat staleness past which the driver declares an "
+             "executor dead (fences its epoch, re-queues its in-flight "
+             "tasks, recomputes capacity). A reaped PID is declared "
+             "dead immediately regardless of this threshold."),
+    Knob("executor_restart_max", 3,
+         doc="Replacement spawns per executor seat after a death; "
+             "exhausting it retires the seat (capacity stays degraded)."),
+    Knob("executor_restart_backoff_ms", 100,
+         doc="Base backoff before replacement spawn i of a seat is "
+             "~backoff * 2^i."),
+
     # -- per-operator enable flags (tier b, spark.blaze.enable.<op>) --
     Knob("enable_ops", default_factory=dict,
          doc="Per-operator enable flags ({'filter': False} routes that "

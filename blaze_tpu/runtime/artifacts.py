@@ -22,12 +22,14 @@ from __future__ import annotations
 import itertools
 import os
 import re
-from typing import Callable, List, Sequence
+import threading
+from typing import Callable, Dict, List, Sequence
 
 from blaze_tpu.runtime import faults, trace
 
 ORPHAN_TAG = ".inprogress."
 _SPILL_RE = re.compile(r"^blz(\d+)-.*\.spill$")
+_EPOCH_RE = re.compile(r"\.e(\d+)(\.[A-Za-z0-9_]+)$")
 _seq = itertools.count()
 
 # Per-directory sweep mutex. Two processes (or two concurrent tasks whose
@@ -118,6 +120,110 @@ def commit_shuffle_pair(write_fn, data_path: str, index_path: str,
         _unlink_quiet(tmp_data)
         _unlink_quiet(tmp_index)
         raise
+
+
+# ---------------------------------------------------------------------------
+# Epoch fencing (process-isolated executor attempts)
+# ---------------------------------------------------------------------------
+#
+# A zombie executor — declared dead on heartbeat staleness but still
+# running — may finish its task and write/report AFTER the driver has
+# re-queued the task to a survivor. Fencing makes the late attempt
+# harmless twice over: (1) every attempt writes to EPOCH-STAMPED final
+# names (`shuffle_0_1.e2.data`), so a stale attempt can never overwrite
+# the retried attempt's files; (2) the driver admits a result only when
+# its epoch matches the fence, so a stale attempt can never double-count
+# in the ledger. sweep_stale_epochs() reclaims the losers' files.
+
+
+def stamp_epoch(path: str, epoch: int) -> str:
+    """Epoch-stamped twin of `path` (`x.data` -> `x.e<epoch>.data`).
+    Epoch <= 0 (the in-process runtime) leaves the name unchanged."""
+    if epoch <= 0:
+        return path
+    base, ext = os.path.splitext(path)
+    return f"{base}.e{epoch}{ext}"
+
+
+def epoch_of(path: str) -> int:
+    """Attempt epoch embedded in a stamped name; 0 for unstamped names."""
+    m = _EPOCH_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else 0
+
+
+def sweep_stale_epochs(data_path: str, index_path: str,
+                       accepted_epoch: int) -> List[str]:
+    """Remove stale-epoch twins of a committed pair: every `.e<k>.` twin
+    of either name with k != accepted_epoch. Returns removed paths."""
+    removed: List[str] = []
+    for final in (data_path, index_path):
+        d = os.path.dirname(final) or "."
+        base, ext = os.path.splitext(os.path.basename(final))
+        try:
+            names = os.listdir(d)
+        except OSError:
+            continue
+        for name in names:
+            if not (name.startswith(base + ".e") and name.endswith(ext)):
+                continue
+            mid = name[len(base):]
+            m = _EPOCH_RE.match(mid)
+            if m is None or int(m.group(1)) == accepted_epoch:
+                continue
+            path = os.path.join(d, name)
+            _unlink_quiet(path)
+            removed.append(path)
+    if removed:
+        trace.event("orphan_sweep", removed=len(removed),
+                    what="stale_epoch")
+    return removed
+
+
+class EpochFence:
+    """Per-task attempt-epoch arbiter for the executor pool.
+
+    The driver holds ONE fence per pool: `advance(key)` mints the next
+    attempt epoch for a task (called at first dispatch and at every
+    re-queue after an executor death), and `admit(key, epoch)` accepts a
+    result only when it carries the CURRENT epoch — anything older was
+    fenced by a re-queue and is dropped (counted, traced, files swept by
+    the caller). `check(key, epoch)` is the raising form for commit
+    paths that want the StaleAttemptError surface."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._epochs: Dict[str, int] = {}
+        self.fenced_total = 0
+
+    def advance(self, key: str) -> int:
+        with self._lock:
+            nxt = self._epochs.get(key, 0) + 1
+            self._epochs[key] = nxt
+            return nxt
+
+    def current(self, key: str) -> int:
+        with self._lock:
+            return self._epochs.get(key, 0)
+
+    def admit(self, key: str, epoch: int) -> bool:
+        with self._lock:
+            ok = self._epochs.get(key, 0) == epoch
+            if not ok:
+                self.fenced_total += 1
+        if not ok:
+            faults.TELEMETRY.add("attempts_fenced", 1)
+            trace.event("epoch_fenced", task=key, epoch=epoch)
+        return ok
+
+    def check(self, key: str, epoch: int) -> None:
+        if not self.admit(key, epoch):
+            raise faults.StaleAttemptError(
+                f"attempt epoch {epoch} fenced for {key} "
+                f"(current {self.current(key)})")
+
+    def forget(self, key: str) -> None:
+        with self._lock:
+            self._epochs.pop(key, None)
 
 
 def _unlink_quiet(path: str) -> None:

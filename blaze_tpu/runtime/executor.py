@@ -194,6 +194,34 @@ def run_task_with_resilience(attempt: Callable[[], object], *,
                                               saved_target)
 
 
+def run_pool_plan(node, ctx: ExecContext, what: str = "pool_task"):
+    """Executor-PROCESS entry for one shipped plan proto
+    (runtime/executor_pool.py worker): decode -> execute -> crash-atomic
+    commit, driven through the in-process resilience ladder — a
+    transient fault burns an executor-local retry (or a resource fault a
+    ladder rung) before it costs the driver a cross-process re-queue.
+    No row fallback here: the driver owns the lineage and re-executes
+    lost partitions itself. conf.task_deadline_ms bounds all attempts,
+    same contract as the supervised thread path. Returns the executed
+    operator (its metrics carry the stage statistics the worker reports
+    back)."""
+    import time as _time
+
+    from blaze_tpu.config import conf
+    from blaze_tpu.plan import decode_plan
+
+    def attempt():
+        op = decode_plan(node)  # fresh operator state per attempt
+        list(execute_plan(op, ctx))
+        return op
+
+    deadline = None
+    if conf.task_deadline_ms and conf.task_deadline_ms > 0:
+        deadline = _time.monotonic() + conf.task_deadline_ms / 1000.0
+    return run_task_with_resilience(attempt, what=what, ctx=ctx,
+                                    deadline=deadline)
+
+
 def _note_rung(run_info: Optional[dict], rung: int) -> None:
     if run_info is not None:
         run_info["ladder_rung"] = max(run_info.get("ladder_rung", 0), rung)

@@ -1,0 +1,1002 @@
+"""Process-isolated executor pool: crash containment for the runtime.
+
+Ref: Spark's executor model (PAPER.md §1 — Spark remains the
+distributed runtime; executors die, the driver detects it, lost
+partitions are re-executed from persisted shuffle artifacts). This
+module is that driver/executor split for the local runtime: N worker
+PROCESSES, each owning a virtual device slice, receive TaskSpecs over a
+length-prefixed control socket (the serde frame discipline —
+runtime/shuffle_server.py holds the shared framing) and read upstream
+shuffle input from the driver's ShuffleServer, so one hard fault (OOM
+kill, segfault, wedged interpreter) costs ONE process, not the service.
+
+The robustness path, not the transport, is the point:
+
+  heartbeat   every worker pushes beats over the control socket; ANY
+              inbound frame refreshes liveness (supervisor.ProcessPeer —
+              the thread heartbeat posture generalized to PIDs).
+
+  death       supervisor.ProcessWatchdog declares an executor dead on
+              reap/exit (exact exit code / killing signal) or heartbeat
+              staleness past conf.executor_death_ms — the latter may be
+              a ZOMBIE that is still running.
+
+  fencing     every task attempt carries an epoch (artifacts.EpochFence)
+              stamped into its TaskSpec, its shuffle artifact names
+              (`shuffle_0_1.e2.data`) and the result accounting: a
+              re-queue advances the fence, so a zombie's late result is
+              rejected at the driver (never double-counted) and its late
+              files land on stale names that get swept — they can never
+              overwrite the retried attempt's artifacts.
+
+  lineage     only the LOST partitions re-execute: completed map outputs
+              live in driver-committed .data/.index files served by the
+              ShuffleServer, so surviving artifacts are re-read, not
+              recomputed. Re-queues are bounded with exponential backoff.
+
+  degradation on a death the pool's membership callbacks fire — the
+              QueryService recomputes admission capacity as
+              live_executors x conf.executor_slots, parks (re-queues)
+              displaced arrivals instead of failing them, and restores
+              capacity when the replacement process (bounded by
+              conf.executor_restart_max, backed off) rejoins.
+
+Worker processes are spawned as `python -m
+blaze_tpu.runtime.executor_pool --worker` with their identity and socket
+paths in the environment; the driver-side conf snapshot rides along so
+knobs agree across the process boundary.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from blaze_tpu.config import KNOBS, conf
+from blaze_tpu.runtime import shuffle_server as ss
+
+_ENV_TOKEN = "BLAZE_EXEC_TOKEN"
+_ENV_SEAT = "BLAZE_EXEC_SEAT"
+_ENV_CTL = "BLAZE_EXEC_SOCK"
+_ENV_SHUFFLE = "BLAZE_EXEC_SHUFFLE_SOCK"
+_ENV_CONF = "BLAZE_TPU_WORKER_CONF"
+
+# knobs a worker must NOT inherit verbatim: a worker never spawns its own
+# pool, never serves metrics, and never exports traces/dossiers/history
+# (the driver owns observability; worker task stats ride the result msg)
+_WORKER_CONF_OVERRIDES = {
+    "executor_count": 0,
+    "metrics_port": 0,
+    "trace_enabled": False,
+    "trace_export_dir": "",
+    "history_dir": "",
+    "flight_dir": "",
+    "progress_enabled": False,
+    "fault_injection_spec": {},
+}
+
+
+class PoolTaskSpec:
+    """One schedulable unit for the process pool (the TaskSpec twin for
+    the process boundary: everything must be serializable). `key` is the
+    fence key — unique per logical task; `payload` is the JSON header the
+    worker dispatches on; `blob` carries the plan proto bytes."""
+
+    __slots__ = ("key", "kind", "payload", "blob", "what")
+
+    def __init__(self, key: str, kind: str, payload: Optional[dict] = None,
+                 blob: bytes = b"", what: str = "") -> None:
+        self.key = key
+        self.kind = kind
+        self.payload = dict(payload or {})
+        self.blob = blob
+        self.what = what or key
+
+
+class _PoolTask:
+    """Pool-internal task state: current epoch, retry/death budgets, and
+    the terminal outcome."""
+
+    __slots__ = ("spec", "epoch", "state", "result", "error", "tries",
+                 "death_requeues", "not_before", "executor")
+
+    def __init__(self, spec: PoolTaskSpec, epoch: int) -> None:
+        self.spec = spec
+        self.epoch = epoch
+        self.state = "queued"  # queued | running | done | error
+        self.result: Optional[dict] = None
+        self.error: Optional[BaseException] = None
+        self.tries = 0
+        self.death_requeues = 0
+        self.not_before = 0.0
+        self.executor: Optional["ExecutorHandle"] = None
+
+    @property
+    def finished(self) -> bool:
+        return self.state in ("done", "error")
+
+
+class ExecutorHandle:
+    """Driver-side view of one executor process."""
+
+    def __init__(self, seat: int, generation: int, token: str, pid: int,
+                 proc: Optional[subprocess.Popen],
+                 conn: socket.socket) -> None:
+        self.seat = seat
+        self.generation = generation
+        self.token = token
+        self.pid = pid
+        self.proc = proc
+        self.conn = conn
+        self.send_lock = threading.Lock()
+        self.inflight: Dict[str, _PoolTask] = {}  # guarded by pool lock
+        self.dead = False                         # guarded by pool lock
+        self.closing = False
+        self.joined_at = time.monotonic()
+        self.last_beat = self.joined_at
+
+    @property
+    def exec_id(self) -> str:
+        return f"exec{self.seat}"
+
+
+class PoolUnavailableError(ConnectionError):
+    """No live executor can run a queued task and no replacement is
+    pending: callers degrade to the in-process runtime."""
+
+
+class ExecutorPool:
+    """Spawns, supervises, feeds and buries executor processes.
+
+    Lifecycle: `start()` spawns conf.executor_count workers and waits
+    for their control-socket handshakes; `run_tasks(specs)` executes a
+    batch with epoch-fenced re-queue on executor death; `close()` tears
+    everything down. `activate(pool)` publishes the pool process-wide so
+    the local runner routes eligible stages here and the service derives
+    its admission capacity from membership."""
+
+    _READY_TIMEOUT = 90.0
+    _HELLO_TIMEOUT = 30.0
+
+    def __init__(self, count: Optional[int] = None,
+                 slots: Optional[int] = None) -> None:
+        self.count = int(count if count is not None
+                         else conf.executor_count)
+        self.slots = max(1, int(slots if slots is not None
+                                else conf.executor_slots))
+        from blaze_tpu.runtime import artifacts, supervisor
+
+        self.fence = artifacts.EpochFence()
+        self.watchdog = supervisor.ProcessWatchdog()
+        self._dir = tempfile.mkdtemp(prefix="blzex-")
+        # pool-unique token prefix: two pools in one process (tests, a
+        # service restart) must not collide in the flight recorder's
+        # (query_id, trigger) exactly-once dedup or the watchdog registry
+        self._pool_id = os.path.basename(self._dir)[len("blzex-"):]
+        self._ctl_path = os.path.join(self._dir, "ctl.sock")
+        self.server = ss.ShuffleServer(os.path.join(self._dir, "shf.sock"))
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._seats: Dict[int, ExecutorHandle] = {}
+        # declared-dead handles: a heartbeat-dead ZOMBIE's socket stays
+        # open (its late results must arrive to be fenced) and its
+        # process may still run — close() reaps whatever is left here
+        self._graveyard: List[ExecutorHandle] = []
+        self._awaiting: Dict[str, tuple] = {}  # token -> (seat, gen, proc)
+        self._queue: List[_PoolTask] = []
+        self._running: Dict[str, _PoolTask] = {}
+        self._seat_restarts: Dict[int, int] = {}
+        self._respawns_pending = 0
+        self._membership_cbs: List[Callable[["ExecutorPool"], None]] = []
+        self._closed = False
+        self._listener: Optional[socket.socket] = None
+        self._threads: List[threading.Thread] = []
+        self.deaths_total = 0
+        self.restarts_total = 0
+        self.tasks_done = 0
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "ExecutorPool":
+        if self.count <= 0:
+            raise ValueError("executor pool needs count >= 1")
+        listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        listener.bind(self._ctl_path)
+        listener.listen(self.count * 2 + 4)
+        self._listener = listener
+        self.server.start()
+        for name, target in (("blz-pool-accept", self._accept_loop),
+                             ("blz-pool-dispatch", self._dispatch_loop)):
+            t = threading.Thread(target=target, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+        for seat in range(self.count):
+            self._spawn(seat, 0)
+        deadline = time.monotonic() + self._READY_TIMEOUT
+        with self._cv:
+            while (len([h for h in self._seats.values() if not h.dead])
+                   < self.count):
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise TimeoutError(
+                        f"executor pool: {len(self._seats)}/{self.count} "
+                        f"workers joined within {self._READY_TIMEOUT}s")
+                self._cv.wait(min(left, 0.25))
+        return self
+
+    def _spawn(self, seat: int, generation: int) -> None:
+        token = f"exec{seat}g{generation}.{self._pool_id}"
+        env = dict(os.environ)
+        env[_ENV_TOKEN] = token
+        env[_ENV_SEAT] = str(seat)
+        env[_ENV_CTL] = self._ctl_path
+        env[_ENV_SHUFFLE] = self.server.sock_path
+        snapshot = {name: getattr(conf, name) for name in KNOBS}
+        snapshot.update(_WORKER_CONF_OVERRIDES)
+        env[_ENV_CONF] = json.dumps(snapshot)
+        # the worker resolves blaze_tpu by module name regardless of the
+        # driver's cwd (pytest may chdir into a tmp dir)
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = (pkg_root + os.pathsep + env["PYTHONPATH"]
+                             if env.get("PYTHONPATH") else pkg_root)
+        err_path = os.path.join(self._dir, f"{token}.err")
+        with open(err_path, "ab") as err:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "blaze_tpu.runtime.executor_pool",
+                 "--worker"],
+                env=env, stdin=subprocess.DEVNULL,
+                stdout=subprocess.DEVNULL, stderr=err)
+        with self._cv:
+            self._awaiting[token] = (seat, generation, proc)
+        from blaze_tpu.runtime import trace
+
+        trace.event("executor_spawn", exec_id=f"exec{seat}",
+                    generation=generation, pid=proc.pid)
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _addr = self._listener.accept()
+            except OSError:
+                return  # listener closed
+            threading.Thread(target=self._handshake, args=(conn,),
+                             name="blz-pool-hello", daemon=True).start()
+
+    def _handshake(self, conn: socket.socket) -> None:
+        conn.settimeout(self._HELLO_TIMEOUT)
+        try:
+            msg, _blob = ss.recv_msg(conn)
+        except (ConnectionError, OSError):
+            conn.close()
+            return
+        conn.settimeout(None)
+        token = msg.get("token", "")
+        with self._cv:
+            pending = self._awaiting.pop(token, None)
+        if msg.get("type") != "hello" or pending is None:
+            conn.close()
+            return
+        seat, generation, proc = pending
+        handle = ExecutorHandle(seat, generation, token,
+                                int(msg.get("pid", proc.pid)), proc, conn)
+        with self._cv:
+            if self._closed:
+                handle.closing = True
+            self._seats[seat] = handle
+            self._cv.notify_all()
+        if handle.closing:
+            conn.close()
+            return
+        self.watchdog.register(
+            token, handle.pid,
+            lambda peer, reason, rc, h=handle: self._declare_dead(
+                h, reason, rc, emit_event=False),
+            poll=proc.poll)
+        t = threading.Thread(target=self._reader, args=(handle,),
+                             name=f"blz-pool-rd-{seat}", daemon=True)
+        t.start()
+        self._threads.append(t)
+        self._notify_membership()
+
+    # -- socket reader -------------------------------------------------
+
+    def _reader(self, handle: ExecutorHandle) -> None:
+        """Per-executor inbound loop. Keeps reading a heartbeat-declared
+        zombie's socket so its late results arrive — and get fenced —
+        instead of rotting in the kernel buffer."""
+        while True:
+            try:
+                msg, _blob = ss.recv_msg(handle.conn)
+            except (ConnectionError, OSError):
+                break
+            handle.last_beat = time.monotonic()
+            self.watchdog.beat(handle.token)
+            if msg.get("type") == "result":
+                self._on_result(handle, msg)
+        if not handle.closing:
+            # EOF before shutdown: the process died (or is dying) — don't
+            # wait the heartbeat staleness out
+            self._declare_dead(handle, "exit",
+                               handle.proc.poll() if handle.proc else None)
+
+    def _on_result(self, handle: ExecutorHandle, msg: dict) -> None:
+        from blaze_tpu.runtime import artifacts
+
+        key, epoch = msg.get("task", ""), int(msg.get("epoch", 0))
+        if not self.fence.admit(key, epoch):
+            # the zombie's late write: reject the result and sweep its
+            # stale-named files; the ledger never sees it
+            for p in (msg.get("data_path"), msg.get("index_path")):
+                if p and artifacts.epoch_of(p) == epoch:
+                    artifacts._unlink_quiet(p)
+            return
+        with self._cv:
+            task = self._running.get(key)
+            if task is None or task.epoch != epoch:
+                return
+            del self._running[key]
+            handle.inflight.pop(key, None)
+            if msg.get("ok"):
+                task.state, task.result = "done", msg
+                self.tasks_done += 1
+            else:
+                self._handle_task_failure_locked(task, msg)
+            self._cv.notify_all()
+
+    def _handle_task_failure_locked(self, task: _PoolTask,
+                                    msg: dict) -> None:
+        from blaze_tpu.runtime import faults, trace
+
+        category = msg.get("category", "fatal")
+        retryable = category in ("retryable", "resource")
+        if retryable and task.tries < int(conf.max_task_retries):
+            task.tries += 1
+            task.epoch = self.fence.advance(task.spec.key)
+            task.not_before = (time.monotonic()
+                               + conf.retry_backoff_ms
+                               * (2 ** (task.tries - 1)) / 1000.0)
+            task.state = "queued"
+            task.executor = None
+            self._queue.append(task)
+            trace.event("executor_task_requeued", task=task.spec.key,
+                        cause="error", category=category,
+                        epoch=task.epoch, tries=task.tries)
+            return
+        cls = faults.CATEGORY_CLASSES.get(category, faults.FatalError)
+        task.state = "error"
+        task.error = cls(
+            f"{task.spec.what}: executor task failed "
+            f"[{msg.get('error', '?')}] {msg.get('message', '')}")
+
+    # -- death & recovery ----------------------------------------------
+
+    def _declare_dead(self, handle: ExecutorHandle, reason: str,
+                      rc: Optional[int], emit_event: bool = True) -> None:
+        """Idempotent executor-death path: fence + re-queue the in-flight
+        tasks, record the dossier, recompute capacity, schedule the
+        replacement. Runs from the watchdog, a reader EOF, or a failed
+        send — first caller wins."""
+        from blaze_tpu.runtime import faults, trace
+
+        now = time.monotonic()
+        with self._cv:
+            if handle.dead or self._closed:
+                return
+            handle.dead = True
+            displaced = list(handle.inflight.values())
+            handle.inflight.clear()
+            self.deaths_total += 1
+            recovery: Dict[str, str] = {}
+            for task in displaced:
+                self._running.pop(task.spec.key, None)
+                if (task.death_requeues
+                        < max(1, int(conf.executor_restart_max))):
+                    task.death_requeues += 1
+                    task.epoch = self.fence.advance(task.spec.key)
+                    task.not_before = (
+                        now + conf.retry_backoff_ms
+                        * (2 ** (task.death_requeues - 1)) / 1000.0)
+                    task.state = "queued"
+                    task.executor = None
+                    self._queue.append(task)
+                    recovery[task.spec.key] = "re-queued"
+                else:
+                    task.state = "error"
+                    task.error = faults.FatalError(
+                        f"{task.spec.what}: lost to repeated executor "
+                        f"deaths ({task.death_requeues} re-queues)")
+                    recovery[task.spec.key] = "shed"
+            self._graveyard.append(handle)
+            restarts = self._seat_restarts.get(handle.seat, 0)
+            will_respawn = restarts < int(conf.executor_restart_max)
+            if will_respawn:
+                self._seat_restarts[handle.seat] = restarts + 1
+                self._respawns_pending += 1
+            self._cv.notify_all()
+        self.watchdog.unregister(handle.token)
+        if emit_event:
+            # the watchdog path already emitted its executor_death event
+            trace.event("executor_death", exec_id=handle.token,
+                        pid=handle.pid, reason=reason, exit_code=rc)
+        for task in displaced:
+            if recovery.get(task.spec.key) == "re-queued":
+                trace.event("executor_task_requeued", task=task.spec.key,
+                            cause="executor_death", epoch=task.epoch)
+        self._capture_death_dossier(handle, reason, rc, displaced,
+                                    recovery, now)
+        self._notify_membership()
+        if will_respawn:
+            threading.Thread(
+                target=self._respawn, args=(handle.seat, restarts,
+                                            handle.generation + 1),
+                name="blz-pool-respawn", daemon=True).start()
+        else:
+            trace.event("degrade", what="executor_retired",
+                        exec_id=handle.exec_id, restarts=restarts)
+
+    def _capture_death_dossier(self, handle: ExecutorHandle, reason: str,
+                               rc: Optional[int], displaced, recovery,
+                               now: float) -> None:
+        if not conf.flight_dir:
+            return
+        from blaze_tpu.runtime import flight_recorder
+
+        signal_no = -rc if (rc is not None and rc < 0) else None
+        # one dossier per kill: keyed on the executor GENERATION token,
+        # so a seat's successive deaths each capture exactly once
+        flight_recorder.capture(
+            "executor_death", handle.token, detail={
+                "exec_id": handle.exec_id,
+                "seat": handle.seat,
+                "generation": handle.generation,
+                "pid": handle.pid,
+                "reason": reason,
+                "exit_code": rc,
+                "signal": signal_no,
+                "last_heartbeat_age_ms": round(
+                    (now - handle.last_beat) * 1000),
+                "tasks_in_flight": [t.spec.what for t in displaced],
+                "recovery": recovery,
+                "live_executors": self.live_count(),
+                "capacity": self.capacity(),
+            })
+
+
+    def _respawn(self, seat: int, restarts: int, generation: int) -> None:
+        backoff = (conf.executor_restart_backoff_ms
+                   * (2 ** restarts) / 1000.0)
+        time.sleep(backoff)
+        with self._cv:
+            self._respawns_pending -= 1
+            if self._closed:
+                return
+        self.restarts_total += 1
+        self._spawn(seat, generation)
+
+    # -- membership / capacity -----------------------------------------
+
+    def on_membership(self, cb: Callable[["ExecutorPool"], None]) -> None:
+        with self._lock:
+            self._membership_cbs.append(cb)
+
+    def _notify_membership(self) -> None:
+        with self._lock:
+            cbs = list(self._membership_cbs)
+        for cb in cbs:
+            try:
+                cb(self)
+            except Exception:  # noqa: BLE001 — listeners must not wedge us
+                pass
+
+    def live_handles(self) -> List[ExecutorHandle]:
+        with self._lock:
+            return [h for h in self._seats.values() if not h.dead]
+
+    def live_count(self) -> int:
+        return len(self.live_handles())
+
+    def capacity(self) -> int:
+        return self.live_count() * self.slots
+
+    def executors(self) -> List[dict]:
+        with self._lock:
+            return [{"exec_id": h.exec_id, "pid": h.pid,
+                     "generation": h.generation, "up": not h.dead,
+                     "inflight": len(h.inflight)}
+                    for h in self._seats.values()]
+
+    def stats(self) -> dict:
+        with self._lock:
+            live = sum(1 for h in self._seats.values() if not h.dead)
+            inflight = sum(len(h.inflight) for h in self._seats.values())
+            deaths, restarts = self.deaths_total, self.restarts_total
+            done = self.tasks_done
+        return {"count": self.count, "live": live,
+                "capacity": live * self.slots, "slots": self.slots,
+                "inflight": inflight, "deaths_total": deaths,
+                "restarts_total": restarts,
+                "fenced_total": self.fence.fenced_total,
+                "tasks_done": done}
+
+    # -- dispatch ------------------------------------------------------
+
+    def _pick_locked(self) -> Optional[tuple]:
+        now = time.monotonic()
+        handles = [h for h in self._seats.values()
+                   if not h.dead and len(h.inflight) < self.slots]
+        if not handles:
+            return None
+        for i, task in enumerate(self._queue):
+            if task.not_before <= now:
+                handle = min(handles, key=lambda h: (len(h.inflight),
+                                                     h.seat))
+                self._queue.pop(i)
+                task.state = "running"
+                task.executor = handle
+                handle.inflight[task.spec.key] = task
+                self._running[task.spec.key] = task
+                return task, handle
+        return None
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            with self._cv:
+                picked = self._pick_locked()
+                while picked is None and not self._closed:
+                    timeout = 0.05 if self._queue else None
+                    self._cv.wait(timeout)
+                    picked = self._pick_locked()
+                if picked is None:
+                    return  # closed
+            task, handle = picked
+            header = {"type": "task", "task": task.spec.key,
+                      "epoch": task.epoch, "kind": task.spec.kind,
+                      "payload": task.spec.payload}
+            try:
+                ss.send_msg(handle.conn, header, task.spec.blob,
+                            lock=handle.send_lock)
+            except (ConnectionError, OSError):
+                # broken pipe: the executor is gone; death handling
+                # re-queues this task (it is in handle.inflight)
+                self._declare_dead(handle, "send_error",
+                                   handle.proc.poll() if handle.proc
+                                   else None)
+
+    # -- public task API -----------------------------------------------
+
+    def run_tasks(self, specs: List[PoolTaskSpec],
+                  timeout: Optional[float] = None) -> List[dict]:
+        """Run a batch of tasks, returning their result messages in spec
+        order. Raises the first task error (classified), or
+        PoolUnavailableError when every executor seat is retired —
+        callers degrade to the in-process runtime."""
+        if not specs:
+            return []
+        from blaze_tpu.runtime import faults
+
+        tasks = [_PoolTask(spec, self.fence.advance(spec.key))
+                 for spec in specs]
+        deadline = (time.monotonic() + timeout) if timeout else None
+        try:
+            with self._cv:
+                if self._closed:
+                    raise RuntimeError("executor pool is closed")
+                self._queue.extend(tasks)
+                self._cv.notify_all()
+                while True:
+                    if all(t.finished for t in tasks):
+                        break
+                    if self._closed:
+                        raise RuntimeError(
+                            "executor pool closed mid-stage")
+                    alive = any(not h.dead
+                                for h in self._seats.values())
+                    if (not alive and self._respawns_pending == 0
+                            and not self._awaiting):
+                        self._abandon_locked(tasks)
+                        raise PoolUnavailableError(
+                            "no live executors and no replacement "
+                            "pending")
+                    if (deadline is not None
+                            and time.monotonic() > deadline):
+                        self._abandon_locked(tasks)
+                        raise faults.DeadlineError(
+                            "executor pool stage timed out")
+                    self._cv.wait(0.1)
+            errs = [t for t in tasks if t.state == "error"]
+            if errs:
+                raise errs[0].error
+            return [t.result for t in tasks]
+        finally:
+            # a straggler result after this point finds no fence entry
+            # (missing key == epoch 0) and is rejected like any stale
+            # attempt, so forgetting keeps the fence bounded per batch
+            for spec in specs:
+                self.fence.forget(spec.key)
+
+    def _abandon_locked(self, tasks: List[_PoolTask]) -> None:
+        """Drop a failed batch: unqueue its pending tasks and fence its
+        running ones so straggler results are rejected."""
+        for t in tasks:
+            if t.state == "queued":
+                try:
+                    self._queue.remove(t)
+                except ValueError:
+                    pass
+                t.state = "error"
+                if t.error is None:
+                    from blaze_tpu.runtime import faults
+
+                    t.error = faults.FaultError("sibling task failed")
+            elif t.state == "running":
+                self._running.pop(t.spec.key, None)
+                if t.executor is not None:
+                    t.executor.inflight.pop(t.spec.key, None)
+                self.fence.advance(t.spec.key)  # fence the straggler
+
+    # -- chaos / test hooks --------------------------------------------
+
+    def hang_executor(self, seat: int, ms: int) -> bool:
+        """Ask a worker to stop heartbeating (and defer sends) for `ms`
+        without dying — the hung/zombie fault for the chaos soak."""
+        with self._lock:
+            handle = self._seats.get(seat)
+        if handle is None or handle.dead:
+            return False
+        try:
+            ss.send_msg(handle.conn, {"type": "hang", "ms": int(ms)},
+                        lock=handle.send_lock)
+            return True
+        except (ConnectionError, OSError):
+            return False
+
+    def pids(self) -> Dict[int, int]:
+        with self._lock:
+            return {h.seat: h.pid for h in self._seats.values()
+                    if not h.dead}
+
+    def busy_pids(self) -> Dict[int, int]:
+        with self._lock:
+            return {h.seat: h.pid for h in self._seats.values()
+                    if not h.dead and h.inflight}
+
+    # -- teardown ------------------------------------------------------
+
+    def close(self) -> None:
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            handles = list(self._seats.values())
+            graveyard = list(self._graveyard)
+            for h in handles + graveyard:
+                h.closing = True
+            self._cv.notify_all()
+        for h in handles:
+            try:
+                ss.send_msg(h.conn, {"type": "shutdown"},
+                            lock=h.send_lock)
+            except (ConnectionError, OSError):
+                pass
+        for h in handles:
+            if h.proc is None:
+                continue
+            try:
+                h.proc.wait(timeout=2.0)
+            except subprocess.TimeoutExpired:
+                h.proc.kill()
+                try:
+                    h.proc.wait(timeout=2.0)
+                except subprocess.TimeoutExpired:
+                    pass
+        for h in graveyard:
+            # a heartbeat-dead zombie may STILL be running: reap it now
+            if h.proc is not None and h.proc.poll() is None:
+                h.proc.kill()
+                try:
+                    h.proc.wait(timeout=2.0)
+                except subprocess.TimeoutExpired:
+                    pass
+        for h in handles + graveyard:
+            try:
+                h.conn.close()
+            except OSError:
+                pass
+        self.watchdog.close()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            finally:
+                self._listener = None
+        self.server.close()
+        shutil.rmtree(self._dir, ignore_errors=True)
+        deactivate(self)
+
+
+# ---------------------------------------------------------------------------
+# Process-wide active pool (the local runner / service / monitor hook)
+# ---------------------------------------------------------------------------
+
+_active_lock = threading.Lock()
+_active_pool: Optional[ExecutorPool] = None
+
+
+def activate(pool: ExecutorPool) -> ExecutorPool:
+    global _active_pool
+    with _active_lock:
+        _active_pool = pool
+    return pool
+
+
+def deactivate(pool: Optional[ExecutorPool] = None) -> None:
+    global _active_pool
+    with _active_lock:
+        if pool is None or _active_pool is pool:
+            _active_pool = None
+
+
+def active() -> Optional[ExecutorPool]:
+    with _active_lock:
+        return _active_pool
+
+
+def pool_stats() -> Optional[dict]:
+    """Monitor-facing snapshot: None when no pool is active (gauges are
+    omitted entirely in that mode — the in-process runtime has no
+    executor topology to report)."""
+    pool = active()
+    if pool is None:
+        return None
+    stats = pool.stats()
+    stats["executors"] = pool.executors()
+    return stats
+
+
+# ---------------------------------------------------------------------------
+# Worker side
+# ---------------------------------------------------------------------------
+
+
+class _Worker:
+    """Executor-process main object: control-socket loop + beat thread.
+    Task handlers run on their own threads (the driver bounds concurrency
+    at conf.executor_slots); heavy engine imports are deferred to the
+    first plan task so protocol-only workers stay cheap."""
+
+    def __init__(self) -> None:
+        self.token = os.environ[_ENV_TOKEN]
+        self.ctl_path = os.environ[_ENV_CTL]
+        self.shuffle_path = os.environ.get(_ENV_SHUFFLE, "")
+        self.sock: Optional[socket.socket] = None
+        self.send_lock = threading.Lock()
+        self.stop = threading.Event()
+        # hang fault (chaos): beats stop and outbound sends stall until
+        # this monotonic instant — the process neither exits nor beats
+        self.hang_until = 0.0
+        self._client: Optional[ss.ShuffleClient] = None
+        self._client_lock = threading.Lock()
+        self._rid_refs: Dict[str, int] = {}
+        self._rid_lock = threading.Lock()
+
+    # -- plumbing ------------------------------------------------------
+
+    def _send(self, header: dict, blob: bytes = b"") -> None:
+        wait = self.hang_until - time.monotonic()
+        if wait > 0:
+            # a hung executor's results arrive LATE — after the driver
+            # declared it dead and fenced its epoch
+            time.sleep(wait)
+        ss.send_msg(self.sock, header, blob, lock=self.send_lock)
+
+    def _beat_loop(self) -> None:
+        period = max(int(conf.executor_heartbeat_ms), 10) / 1000.0
+        while not self.stop.wait(period):
+            if time.monotonic() < self.hang_until:
+                continue  # hung: silence, but stay alive
+            try:
+                ss.send_msg(self.sock, {"type": "beat"},
+                            lock=self.send_lock)
+            except (ConnectionError, OSError):
+                # driver gone: a leaderless executor must not linger
+                self.stop.set()
+                os._exit(0)
+
+    def shuffle_client(self) -> ss.ShuffleClient:
+        with self._client_lock:
+            if self._client is None:
+                self._client = ss.ShuffleClient(self.shuffle_path)
+            return self._client
+
+    # -- task handlers -------------------------------------------------
+
+    def _acquire_rid(self, rid: str, provider) -> None:
+        from blaze_tpu.runtime import resources
+
+        with self._rid_lock:
+            n = self._rid_refs.get(rid, 0)
+            self._rid_refs[rid] = n + 1
+            if n == 0:
+                resources.put(rid, provider)
+
+    def _release_rid(self, rid: str) -> None:
+        from blaze_tpu.runtime import resources
+
+        with self._rid_lock:
+            n = self._rid_refs.get(rid, 1) - 1
+            if n <= 0:
+                self._rid_refs.pop(rid, None)
+                resources.pop(rid)
+            else:
+                self._rid_refs[rid] = n
+
+    def _run_plan(self, payload: dict, blob: bytes, epoch: int) -> dict:
+        from blaze_tpu.ops.base import ExecContext
+        from blaze_tpu.plan import plan_pb2 as pb
+        from blaze_tpu.runtime import artifacts
+        from blaze_tpu.runtime.executor import run_pool_plan
+
+        node = pb.PlanNode()
+        node.ParseFromString(blob)
+        # the fence stamp: this attempt's artifacts land on epoch-named
+        # files, so even a zombie's completed write can't collide with a
+        # retried attempt's output
+        data_path = artifacts.stamp_epoch(node.shuffle_writer.data_file,
+                                          epoch)
+        index_path = artifacts.stamp_epoch(node.shuffle_writer.index_file,
+                                           epoch)
+        node.shuffle_writer.data_file = data_path
+        node.shuffle_writer.index_file = index_path
+        client = self.shuffle_client()
+        rids = list(payload.get("rids") or [])
+
+        def make_provider(rid):
+            # exactly one positional param: _call_provider passes the
+            # task partition to 1-arg providers (a default-arg closure
+            # would be miscounted as 2-arg and handed num_partitions)
+            def provider(partition):
+                return iter(ss.split_frames(client.fetch(rid, partition)))
+            return provider
+
+        for rid in rids:
+            self._acquire_rid(rid, make_provider(rid))
+        try:
+            ctx = ExecContext(partition=int(payload.get("partition", 0)),
+                              num_partitions=int(
+                                  payload.get("num_partitions", 1)))
+            # the in-process resilience ladder runs INSIDE the worker:
+            # transient faults retry here before costing the driver a
+            # cross-process re-queue (runtime/executor.run_pool_plan)
+            op = run_pool_plan(node, ctx,
+                               what=payload.get("what", "pool_plan"))
+            logical = int(op.metrics.values.get("shuffle_logical_bytes",
+                                                0))
+            return {"data_path": data_path, "index_path": index_path,
+                    "logical_bytes": logical}
+        finally:
+            for rid in rids:
+                self._release_rid(rid)
+
+    def _run_flaky(self, payload: dict) -> dict:
+        """Test handler: fail the first `times` attempts (counted in a
+        driver-provided file so the count survives this process dying),
+        then succeed."""
+        from blaze_tpu.runtime import faults
+
+        marker = payload["marker"]
+        n = 0
+        try:
+            with open(marker, "r") as f:
+                n = int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            n = 0
+        if n < int(payload.get("times", 1)):
+            with open(marker, "w") as f:
+                f.write(str(n + 1))
+            cls = faults.CATEGORY_CLASSES.get(
+                payload.get("category", "retryable"), faults.FatalError)
+            raise cls(f"flaky task (attempt {n + 1})")
+        return {"attempts_failed": n}
+
+    def _run_task(self, msg: dict, blob: bytes) -> None:
+        key, epoch = msg.get("task", ""), int(msg.get("epoch", 0))
+        kind = msg.get("kind", "")
+        payload = msg.get("payload") or {}
+        try:
+            if kind == "plan":
+                result = self._run_plan(payload, blob, epoch)
+            elif kind == "echo":
+                result = {"value": payload.get("value")}
+            elif kind == "sleep":
+                end = time.monotonic() + float(payload.get("ms", 0)) / 1e3
+                while time.monotonic() < end and not self.stop.is_set():
+                    time.sleep(0.01)
+                result = {}
+            elif kind == "flaky":
+                result = self._run_flaky(payload)
+            else:
+                raise ValueError(f"unknown task kind: {kind}")
+        except BaseException as e:  # noqa: BLE001 — classified + relayed
+            from blaze_tpu.runtime import faults
+
+            try:
+                self._send({"type": "result", "task": key, "epoch": epoch,
+                            "ok": False, "category": faults.classify(e),
+                            "error": type(e).__name__,
+                            "message": str(e)[:500]})
+            except (ConnectionError, OSError):
+                pass
+            return
+        reply = {"type": "result", "task": key, "epoch": epoch,
+                 "ok": True}
+        reply.update(result)
+        try:
+            self._send(reply)
+        except (ConnectionError, OSError):
+            pass
+
+    # -- main loop -----------------------------------------------------
+
+    def run(self) -> int:
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.connect(self.ctl_path)
+        self.sock = sock
+        ss.send_msg(sock, {"type": "hello", "token": self.token,
+                           "pid": os.getpid()}, lock=self.send_lock)
+        beat = threading.Thread(target=self._beat_loop, name="blz-wk-beat",
+                                daemon=True)
+        beat.start()
+        try:
+            while not self.stop.is_set():
+                try:
+                    msg, blob = ss.recv_msg(sock)
+                except (ConnectionError, OSError):
+                    break  # driver gone
+                mtype = msg.get("type")
+                if mtype == "task":
+                    threading.Thread(target=self._run_task,
+                                     args=(msg, blob),
+                                     name="blz-wk-task",
+                                     daemon=True).start()
+                elif mtype == "ping":
+                    self._send({"type": "pong"})
+                elif mtype == "hang":
+                    self.hang_until = (time.monotonic()
+                                       + int(msg.get("ms", 0)) / 1000.0)
+                elif mtype == "shutdown":
+                    break
+        finally:
+            self.stop.set()
+            with self._client_lock:
+                client, self._client = self._client, None
+            if client is not None:
+                client.close()
+            try:
+                sock.close()
+            except OSError:
+                pass
+        return 0
+
+
+def _worker_main() -> int:
+    overrides = os.environ.get(_ENV_CONF, "")
+    if overrides:
+        for name, value in json.loads(overrides).items():
+            if name in KNOBS:
+                setattr(conf, name, value)
+    return _Worker().run()
+
+
+if __name__ == "__main__":
+    if "--worker" in sys.argv:
+        sys.exit(_worker_main())
+    sys.exit("executor_pool is a library; run with --worker as a pool "
+             "child process")

@@ -141,6 +141,17 @@ class AdmissionRejected(FatalError):
         self.wait_ms = wait_ms
 
 
+class StaleAttemptError(FaultError):
+    """An epoch-fenced attempt lost: a newer attempt of the same task was
+    dispatched (its executor was declared dead) and the fence advanced
+    past this attempt's epoch. Classified "killed" — like losing the
+    first-commit-wins speculation race, the attempt did not fail and must
+    not be retried or counted against any budget; its output is simply
+    discarded (runtime/artifacts.EpochFence)."""
+
+    category = "killed"
+
+
 CATEGORY_CLASSES = {
     "retryable": RetryableError,
     "resource": ResourceExhaustedError,

@@ -402,6 +402,14 @@ class ResourceMonitor:
         s["admission_queue_depth"] = st["queue_depth"]
         s["admission_parked"] = st["parked"]
         s["admission_rejected"] = st["rejected"]
+        from blaze_tpu.runtime import executor_pool
+
+        ps = executor_pool.pool_stats()
+        if ps is not None:
+            s["executors_live"] = ps["live"]
+            s["executor_capacity"] = ps["capacity"]
+            s["executor_deaths"] = ps["deaths_total"]
+            s["executor_restarts"] = ps["restarts_total"]
         self._ring.append(s)
         return s
 
@@ -486,6 +494,11 @@ GAUGE_NAMES = (
     "blaze_flight_dossiers_total",
     "blaze_query_progress_ratio",
     "blaze_endpoint_requests_total",
+    "blaze_executor_up",
+    "blaze_executor_live",
+    "blaze_executor_restarts_total",
+    "blaze_executor_deaths_total",
+    "blaze_service_capacity",
 )
 GAUGE_PREFIXES = (
     "blaze_pipeline_",  # pipeline.TELEMETRY counters
@@ -636,6 +649,31 @@ def prometheus_text() -> str:
          [({"tenant": t}, s["breaches"])
           for t, s in sorted(slo.items())])
 
+    # process-isolated executor pool (runtime/executor_pool.py): per-seat
+    # liveness, restart/death counters, and the degraded admission
+    # capacity. Families stay present (empty) with no pool attached so
+    # dashboards see a series disappear per-executor, never per-family.
+    from blaze_tpu.runtime import executor_pool
+
+    ps = executor_pool.pool_stats()
+    emit("blaze_executor_up", "gauge",
+         "Executor process liveness (1 = heartbeating, 0 = declared dead)",
+         [({"exec_id": e["exec_id"]}, 1 if e["up"] else 0)
+          for e in (ps or {}).get("executors", ())])
+    emit("blaze_executor_live", "gauge",
+         "Live executor processes in the pool",
+         [({}, ps["live"])] if ps else [])
+    emit("blaze_executor_restarts_total", "counter",
+         "Executor processes respawned after a death",
+         [({}, ps["restarts_total"])] if ps else [])
+    emit("blaze_executor_deaths_total", "counter",
+         "Executor deaths declared (exit, heartbeat, send error)",
+         [({}, ps["deaths_total"])] if ps else [])
+    emit("blaze_service_capacity", "gauge",
+         "Admission capacity (live_executors x slots when a pool is "
+         "attached, else max_concurrent_queries)",
+         [({}, service.capacity())])
+
     # incident capture + live introspection (flight_recorder/progress):
     # lazy imports — both modules import monitor at module level
     from blaze_tpu.runtime import flight_recorder, progress
@@ -703,12 +741,23 @@ def _note_request(route: str) -> None:
 
 def health_snapshot() -> Dict[str, Any]:
     """Cheap liveness payload (GET /healthz): ring occupancy + sampler
-    staleness for container probes, without the full exposition."""
+    staleness for container probes, without the full exposition. With an
+    executor pool attached, ok flips False ONLY at zero live executors
+    (degraded-but-serving capacity is healthy — the probe must not
+    restart a pod that is recovering one seat)."""
+    from blaze_tpu.runtime import executor_pool
+
     s = sampler()
     ring = s.ring() if s is not None else []
     last_ts = ring[-1].get("ts") if ring else None
+    ps = executor_pool.pool_stats()
+    ok = True
+    if ps is not None:
+        ok = ps["live"] > 0
     return {
-        "ok": True,
+        "ok": ok,
+        "executors_live": ps["live"] if ps else None,
+        "capacity": ps["capacity"] if ps else None,
         "ring_samples": len(ring),
         "ring_capacity": int(conf.monitor_ring_samples),
         "sampler_alive": bool(s is not None and s._thread is not None
@@ -731,8 +780,12 @@ def serve_path(path: str) -> Tuple[int, str, bytes]:
                 prometheus_text().encode())
     if path == "/healthz":
         _note_request("healthz")
-        return (200, "application/json",
-                json.dumps(health_snapshot()).encode())
+        snap = health_snapshot()
+        # 503 only at zero live executors: a load balancer must keep
+        # routing to a DEGRADED pool (it still serves, at reduced
+        # capacity) and only eject a truly dead one
+        return (200 if snap["ok"] else 503, "application/json",
+                json.dumps(snap).encode())
     # live introspection (runtime/progress.py): lazy import — progress
     # imports monitor at module level
     if path == "/queries":
@@ -776,10 +829,10 @@ class MetricsServer:
                 except Exception as e:  # noqa: BLE001 — scrape, not crash
                     self.send_error(500, str(e)[:100])
                     return
-                if status != 200:
+                if status != 200 and not body:
                     self.send_error(status)
                     return
-                self.send_response(200)
+                self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()

@@ -60,7 +60,7 @@ from blaze_tpu.config import conf
 from blaze_tpu.runtime import faults, memory, supervisor, trace
 
 __all__ = ["QuerySession", "QueryService", "SloTracker", "stats",
-           "slo_stats"]
+           "slo_stats", "capacity"]
 
 
 class QuerySession:
@@ -228,6 +228,7 @@ class QueryService:
         self._threads: List[threading.Thread] = []
         self.scheduler: Optional[supervisor.FairScheduler] = None
         self._open = False
+        self._pool = None  # attached executor pool (capacity source)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -239,7 +240,40 @@ class QueryService:
         with self._lock:
             self._open = True
         _active = self
+        # a process-isolated pool that is already active becomes the
+        # capacity source automatically (graceful-degradation contract)
+        from blaze_tpu.runtime import executor_pool
+
+        pool = executor_pool.active()
+        if pool is not None:
+            self.attach_pool(pool)
         return self
+
+    def attach_pool(self, pool) -> None:
+        """Derive admission capacity from an executor pool: capacity =
+        live_executors x slots, recomputed on every membership change
+        (death or rejoin). A shrink does not kill running queries — it
+        parks new arrivals until a seat rejoins or their deadline sheds
+        them; capacity 0 parks everything (and /healthz goes 503)."""
+        # plain attribute store: capacity() reads _pool from admission
+        # waits that already hold the slot condition — no extra lock
+        self._pool = pool
+        pool.on_membership(self._on_pool_change)
+        self._on_pool_change(pool)
+
+    def _on_pool_change(self, pool) -> None:
+        cap = pool.capacity()
+        trace.event("capacity_changed", capacity=cap,
+                    live_executors=pool.live_count(), slots=pool.slots)
+        with self._slot_free:
+            # capacity may have GROWN (rejoin): wake the waiting room
+            self._slot_free.notify_all()
+
+    def capacity(self) -> int:
+        pool = self._pool
+        if pool is not None:
+            return pool.capacity()
+        return self.max_concurrent
 
     def close(self) -> None:
         global _active
@@ -328,7 +362,7 @@ class QueryService:
         with self._slot_free:
             if not self._open:
                 raise RuntimeError("QueryService is closed")
-            if self._running >= self.max_concurrent:
+            if self._running >= self.capacity():
                 if self._parked >= self.queue_depth:
                     self._shed_locked(session, "queue_full", 0.0)
                 parked = True
@@ -338,7 +372,10 @@ class QueryService:
                             tenant_id=session.tenant_id,
                             queue_depth=self._parked)
                 try:
-                    while self._open and self._running >= self.max_concurrent:
+                    # capacity() is re-read every wake: an executor death
+                    # shrinks it mid-wait (stay parked), a rejoin grows
+                    # it (admit)
+                    while self._open and self._running >= self.capacity():
                         timeout = None
                         if session.deadline_at is not None:
                             timeout = session.deadline_at - time.monotonic()
@@ -350,7 +387,7 @@ class QueryService:
                 wait_ms = (time.monotonic() - session.arrived_at) * 1000.0
                 if not self._open:
                     raise RuntimeError("QueryService closed while parked")
-                if self._running >= self.max_concurrent:
+                if self._running >= self.capacity():
                     # deadline expired in the waiting room — shed without
                     # starting a run that could only end in DeadlineError
                     self._shed_locked(session, "deadline_while_parked",
@@ -443,6 +480,7 @@ class QueryService:
     # -- introspection -----------------------------------------------------
 
     def stats(self) -> Dict[str, int]:
+        cap = self.capacity()
         with self._lock:
             return {
                 "running": self._running,
@@ -450,6 +488,7 @@ class QueryService:
                 "admitted": self._admitted_total,
                 "parked": self._parked_total,
                 "rejected": self._rejected_total,
+                "capacity": cap,
             }
 
 
@@ -467,8 +506,23 @@ def stats() -> Dict[str, int]:
     svc = _active
     if svc is None:
         return {"running": 0, "queue_depth": 0, "admitted": 0,
-                "parked": 0, "rejected": 0}
+                "parked": 0, "rejected": 0, "capacity": capacity()}
     return svc.stats()
+
+
+def capacity() -> int:
+    """Current admission capacity: the active service's (pool-derived
+    when one is attached), else the active pool's, else the static
+    conf.max_concurrent_queries."""
+    svc = _active
+    if svc is not None:
+        return svc.capacity()
+    from blaze_tpu.runtime import executor_pool
+
+    pool = executor_pool.active()
+    if pool is not None:
+        return pool.capacity()
+    return max(1, int(conf.max_concurrent_queries))
 
 
 # SLO state is process-wide, not per-QueryService: objectives describe

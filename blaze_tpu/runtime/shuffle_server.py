@@ -1,0 +1,294 @@
+"""Shuffle service: serves committed `.data`/`.index` segments (and
+broadcast frame lists) to executor processes over a Unix socket.
+
+Ref: Spark's shuffle service — reduce tasks fetch map outputs from the
+node that committed them, not from the writer task (which may be dead).
+Here the driver owns the crash-atomic artifacts (artifacts.py commit
+protocol), so it serves them: an executor's ipc_reader resolves a
+"<qid>/shuffle:<sid>" resource to a client that fetches partition
+segments from THIS server. Because segments are read from the committed
+files, a map executor can die after commit and its output remains
+fetchable — the lineage property executor-death recovery relies on
+(re-execute only the LOST partitions).
+
+Wire format (shared with the executor control socket,
+runtime/executor_pool.py): the serde frame discipline applied to control
+messages — `u32 magic | u32 raw_len | u32 comp_len | u32 blob_len |
+compressed(json header) | blob`. The header rides the same
+compressor family as shuffle frames (serde's zstd-or-zlib posture at
+conf.zstd_level); the blob is opaque bytes — for segment replies it is a
+concatenation of serde "BTB1" frames, handed to IpcReaderExec undecoded.
+
+Kept import-light on purpose: executor worker processes import this
+before deciding whether a task needs the engine at all, so nothing here
+may pull jax/numpy.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import threading
+import zlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+MAGIC = b"BCS1"
+_HEAD = struct.Struct("<4sIII")
+# largest accepted frame: a poisoned/corrupt length prefix must not make
+# recv_msg attempt a multi-GiB allocation
+MAX_FRAME = 1 << 31
+
+
+class WireError(ConnectionError):
+    """Framing violation (bad magic / oversized length): the peer is not
+    speaking the protocol — callers treat it like a lost connection."""
+
+
+def send_msg(sock: socket.socket, header: dict, blob: bytes = b"",
+             lock: Optional[threading.Lock] = None) -> None:
+    """Serialize + frame one message; `lock` serializes concurrent
+    senders sharing the socket (a torn frame is unrecoverable)."""
+    raw = json.dumps(header, separators=(",", ":")).encode()
+    comp = zlib.compress(raw, 1)
+    buf = _HEAD.pack(MAGIC, len(raw), len(comp), len(blob)) + comp
+    if lock is not None:
+        with lock:
+            sock.sendall(buf)
+            if blob:
+                sock.sendall(blob)
+    else:
+        sock.sendall(buf)
+        if blob:
+            sock.sendall(blob)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame"
+                                  if chunks else "peer closed")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def recv_msg(sock: socket.socket) -> Tuple[dict, bytes]:
+    """Read one framed message; raises ConnectionError on EOF/short read
+    and WireError on a malformed frame."""
+    head = _recv_exact(sock, _HEAD.size)
+    magic, raw_len, comp_len, blob_len = _HEAD.unpack(head)
+    if magic != MAGIC:
+        raise WireError(f"bad frame magic {magic!r}")
+    if max(raw_len, comp_len, blob_len) > MAX_FRAME:
+        raise WireError("frame length exceeds MAX_FRAME")
+    raw = zlib.decompress(_recv_exact(sock, comp_len))
+    if len(raw) != raw_len:
+        raise WireError("frame raw_len mismatch")
+    blob = _recv_exact(sock, blob_len) if blob_len else b""
+    return json.loads(raw.decode()), blob
+
+
+def _read_segment(data_path: str, index_path: str, partition: int) -> bytes:
+    """One map output's bytes for `partition`, located through the
+    committed little-endian u64 offsets index (the FileSegment fetch of
+    shuffle_manager.get_reader, without the decode)."""
+    with open(index_path, "rb") as f:
+        offsets = f.read()
+    n = len(offsets) // 8
+    if partition + 1 >= n:
+        raise IndexError(f"partition {partition} out of range for "
+                         f"{index_path} ({n - 1} partitions)")
+    start, end = struct.unpack_from("<2Q", offsets, partition * 8)
+    if end == start:
+        return b""
+    with open(data_path, "rb") as f:
+        f.seek(start)
+        return f.read(end - start)
+
+
+class ShuffleServer:
+    """Driver-side artifact server. `register_shuffle` publishes a
+    completed stage's map outputs under its resource id;
+    `register_frames` publishes a broadcast stage's frame list. Executors
+    fetch with {"type": "fetch", "rid": ..., "partition": p} and get the
+    concatenated serde frames back as the reply blob."""
+
+    def __init__(self, sock_path: str) -> None:
+        self.sock_path = sock_path
+        self._lock = threading.Lock()
+        # rid -> list of (data_path, index_path) map outputs
+        self._shuffles: Dict[str, List[Tuple[str, str]]] = {}
+        # rid -> broadcast frame list (already serde frames)
+        self._frames: Dict[str, List[bytes]] = {}
+        self._listener: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._closed = threading.Event()
+        self.fetches = 0
+
+    # -- registry ------------------------------------------------------
+
+    def register_shuffle(self, rid: str,
+                         outputs: Sequence[Tuple[str, str]]) -> None:
+        with self._lock:
+            self._shuffles[rid] = list(outputs)
+
+    def register_frames(self, rid: str, frames: Sequence[bytes]) -> None:
+        with self._lock:
+            self._frames[rid] = list(frames)
+
+    def unregister(self, rid: str) -> None:
+        with self._lock:
+            self._shuffles.pop(rid, None)
+            self._frames.pop(rid, None)
+
+    def unregister_prefix(self, prefix: str) -> None:
+        """Drop every rid of a finished query's namespace."""
+        with self._lock:
+            for reg in (self._shuffles, self._frames):
+                for rid in [r for r in reg if r.startswith(prefix)]:
+                    reg.pop(rid, None)
+
+    def registered(self) -> List[str]:
+        with self._lock:
+            return sorted(self._shuffles) + sorted(self._frames)
+
+    # -- serving -------------------------------------------------------
+
+    def start(self) -> None:
+        listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        listener.bind(self.sock_path)
+        listener.listen(64)
+        self._listener = listener
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="blz-shufsrv", daemon=True)
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                conn, _addr = self._listener.accept()
+            except OSError:
+                return  # listener closed
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             name="blz-shufsrv-conn", daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                try:
+                    msg, _blob = recv_msg(conn)
+                except ConnectionError:
+                    return
+                if msg.get("type") != "fetch":
+                    send_msg(conn, {"ok": False,
+                                    "error": "unknown request type"})
+                    continue
+                rid = msg.get("rid", "")
+                partition = int(msg.get("partition", 0))
+                try:
+                    blob = self._fetch(rid, partition)
+                except Exception as e:  # noqa: BLE001 — relayed to peer
+                    send_msg(conn, {"ok": False, "rid": rid,
+                                    "error": f"{type(e).__name__}: {e}"})
+                    continue
+                send_msg(conn, {"ok": True, "rid": rid}, blob)
+        finally:
+            conn.close()
+
+    def _fetch(self, rid: str, partition: int) -> bytes:
+        with self._lock:
+            outputs = self._shuffles.get(rid)
+            frames = self._frames.get(rid)
+            self.fetches += 1
+        if outputs is not None:
+            return b"".join(_read_segment(d, i, partition)
+                            for d, i in outputs)
+        if frames is not None:
+            return b"".join(frames)
+        raise KeyError(f"resource not served: {rid}")
+
+    def close(self) -> None:
+        self._closed.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            finally:
+                self._listener = None
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=1.0)
+            self._accept_thread = None
+        try:
+            os.unlink(self.sock_path)
+        except OSError:
+            pass
+
+
+class ShuffleClient:
+    """Executor-side fetch client: one connection, request/response under
+    a lock (concurrent task slots in one worker share it)."""
+
+    def __init__(self, sock_path: str) -> None:
+        self.sock_path = sock_path
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+
+    def _ensure_locked(self) -> socket.socket:
+        if self._sock is None:
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            s.connect(self.sock_path)
+            self._sock = s
+        return self._sock
+
+    def _close_locked(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    def fetch(self, rid: str, partition: int) -> bytes:
+        with self._lock:
+            try:
+                sock = self._ensure_locked()
+                send_msg(sock, {"type": "fetch", "rid": rid,
+                                "partition": partition})
+                msg, blob = recv_msg(sock)
+            except (ConnectionError, OSError):
+                # one reconnect: the driver may have restarted the
+                # listener; a second failure is the caller's problem
+                self._close_locked()
+                sock = self._ensure_locked()
+                send_msg(sock, {"type": "fetch", "rid": rid,
+                                "partition": partition})
+                msg, blob = recv_msg(sock)
+        if not msg.get("ok"):
+            raise KeyError(msg.get("error", f"fetch failed: {rid}"))
+        return blob
+
+    def close(self) -> None:
+        with self._lock:
+            self._close_locked()
+
+
+def split_frames(blob: bytes) -> List[bytes]:
+    """Split a fetched segment into its serde "BTB1" frames (layout:
+    columnar/serde.py — u32 magic | u32 raw_len | u32 comp_len | body).
+    IpcReaderExec decodes raw frame bytes itself, so executors never need
+    the serde module just to route segments."""
+    frames: List[bytes] = []
+    off = 0
+    total = len(blob)
+    while off < total:
+        if off + 12 > total:
+            raise WireError("truncated shuffle frame header")
+        _raw_len, comp_len = struct.unpack_from("<II", blob, off + 4)
+        end = off + 12 + comp_len
+        if end > total:
+            raise WireError("truncated shuffle frame body")
+        frames.append(blob[off:end])
+        off = end
+    return frames

@@ -233,6 +233,124 @@ class CircuitBreaker:
             return not self._tripped.isdisjoint(op_kinds)
 
 
+class ProcessPeer:
+    """One supervised executor process: the PID twin of TaskAttempt.
+    `beat()` is bumped by ANY inbound control-socket frame (push beats
+    included), the same no-second-instrument posture as the thread
+    heartbeat; `poll` is the owner's reaper (subprocess.Popen.poll) so a
+    zombie child is seen as dead even though os.kill(pid, 0) still
+    succeeds on it."""
+
+    __slots__ = ("key", "pid", "last_beat", "poll", "on_death", "dead")
+
+    def __init__(self, key: str, pid: int,
+                 on_death: Callable[["ProcessPeer", str, Optional[int]],
+                                    None],
+                 poll: Optional[Callable[[], Optional[int]]] = None) -> None:
+        self.key = key
+        self.pid = pid
+        self.last_beat = time.monotonic()
+        self.poll = poll
+        self.on_death = on_death
+        self.dead = False
+
+    def beat(self) -> None:
+        self.last_beat = time.monotonic()
+
+
+class ProcessWatchdog:
+    """Executor-death detector: the thread watchdog's heartbeat/staleness
+    scan generalized to PIDs (ROADMAP item 1). A peer is declared dead
+    when its process is reaped/vanished (reason "exit", with the exit
+    code — negative = killing signal) or when its heartbeat goes stale
+    past conf.executor_death_ms (reason "heartbeat" — the process may
+    still be RUNNING; the owner must fence its epoch so its late results
+    are rejected). Each peer's on_death fires exactly once, off-thread
+    from the socket readers, and must never raise."""
+
+    _TICK = 0.05
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._peers: Dict[str, ProcessPeer] = {}
+        self._closed = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def register(self, key: str, pid: int, on_death,
+                 poll=None) -> ProcessPeer:
+        peer = ProcessPeer(key, pid, on_death, poll=poll)
+        with self._lock:
+            self._peers[key] = peer
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._loop, name="blz-procdog", daemon=True)
+                self._thread.start()
+        return peer
+
+    def unregister(self, key: str) -> None:
+        with self._lock:
+            self._peers.pop(key, None)
+
+    def beat(self, key: str) -> None:
+        with self._lock:
+            peer = self._peers.get(key)
+        if peer is not None:
+            peer.beat()
+
+    def _pid_gone(self, peer: ProcessPeer) -> Tuple[bool, Optional[int]]:
+        if peer.poll is not None:
+            rc = peer.poll()
+            if rc is not None:
+                return True, rc
+            return False, None
+        from blaze_tpu.runtime.artifacts import _pid_alive
+
+        return (not _pid_alive(peer.pid)), None
+
+    def _loop(self) -> None:
+        while not self._closed.is_set():
+            death_ms = max(int(conf.executor_death_ms), 1)
+            self._closed.wait(min(self._TICK, death_ms / 4000.0))
+            try:
+                self._scan()
+            except Exception:  # noqa: BLE001 — watchdog must never die
+                pass
+
+    def _scan(self) -> None:
+        now = time.monotonic()
+        stale_s = max(int(conf.executor_death_ms), 1) / 1000.0
+        with self._lock:
+            peers = list(self._peers.values())
+        for peer in peers:
+            if peer.dead:
+                continue
+            gone, rc = self._pid_gone(peer)
+            if gone:
+                reason = "exit"
+            elif now - peer.last_beat > stale_s:
+                reason, rc = "heartbeat", None
+            else:
+                continue
+            peer.dead = True
+            self.unregister(peer.key)
+            faults.TELEMETRY.add("executor_deaths", 1)
+            trace.event("executor_death", exec_id=peer.key, pid=peer.pid,
+                        reason=reason, exit_code=rc,
+                        stale_ms=round((now - peer.last_beat) * 1000))
+            try:
+                peer.on_death(peer, reason, rc)
+            except Exception:  # noqa: BLE001 — callback must not kill scan
+                pass
+
+    def close(self) -> None:
+        self._closed.set()
+        with self._lock:
+            thread = self._thread
+            self._peers.clear()
+        if thread is not None:
+            thread.join(timeout=1.0)
+
+
 class _SessionQueue:
     """FairScheduler-internal per-session run queue (stride scheduling
     state): FIFO within the session, virtual time across sessions."""

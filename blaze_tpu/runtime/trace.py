@@ -170,9 +170,15 @@ EVENT_KINDS = (
     "compile_compiled",     # compile_service: fresh XLA compilation
     "compile_hit",          # compile_service: persistent-cache hit
     "compile_miss",         # compile_service: persistent-cache miss
+    "capacity_changed",     # service: admission capacity recomputed on
+                            # executor-pool membership change
     "deadline_exceeded",    # executor: task/query budget exhausted
     "deadline_kill",        # supervisor: budget exhausted mid-attempt
     "degrade",              # executor: resilience-ladder rung taken
+    "epoch_fenced",         # artifacts.EpochFence: stale attempt rejected
+    "executor_death",       # supervisor/pool: executor process declared dead
+    "executor_spawn",       # executor_pool: worker process launched
+    "executor_task_requeued",  # executor_pool: displaced/failed task re-queued
     "fault_injected",       # faults.inject: armed point fired
     "flight_capture",       # flight_recorder: incident dossier written
     "hang_detected",        # supervisor watchdog: heartbeat stale
@@ -535,7 +541,8 @@ _RESILIENCE_EVENT_KINDS = (
     "retry", "ladder_rung", "hang_detected", "hang_relaunch",
     "deadline_kill", "deadline_exceeded", "speculation_launch",
     "speculation_win", "speculation_loss", "breaker_trip",
-    "fault_injected", "task_error", "degrade",
+    "fault_injected", "task_error", "degrade", "executor_death",
+    "executor_task_requeued", "epoch_fenced",
 )
 
 

@@ -160,6 +160,7 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
     run_info.setdefault("mesh_stages", 0)
     run_info.setdefault("file_stages", 0)
     run_info.setdefault("broadcast_stages", 0)
+    run_info.setdefault("pool_stages", 0)
     from blaze_tpu.config import conf
 
     # task setup reclaims dead writers' leftovers (artifact temps in the
@@ -217,6 +218,13 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
     # admission-stamped query deadline; breaker state stays per-query
     # (one CircuitBreaker per Supervisor, one Supervisor per run_plan).
     sup = Supervisor(run_info, session=session)
+    # process-isolated executors (runtime/executor_pool.py): when a pool
+    # is active, eligible shuffle-map stages ship their task plans to
+    # worker PROCESSES (crash containment) instead of the thread pool;
+    # the pool failing degrades back to the in-process path below
+    from blaze_tpu.runtime import executor_pool
+
+    pool = executor_pool.active()
     # live-introspection taps (runtime/progress.py): conditional import
     # once per run, one is-None check per stage — zero work when off
     if conf.progress_enabled:
@@ -254,6 +262,36 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
                 with trace.span("stage", stage_id=stage.stage_id,
                                 stage_kind="shuffle_map", fingerprint=fp,
                                 tasks=_input_tasks(stage, stages)) as sp:
+                    prids = (_pool_stage_rids(stage)
+                             if pool is not None else None)
+                    if prids is not None:
+                        try:
+                            logical = _run_shuffle_stage_pooled(
+                                stage, stages, shuffle_mgr, pool,
+                                run_info, ns, prids)
+                        except Exception as e:  # noqa: BLE001 — classified
+                            cat = faults.classify(e)
+                            if cat in ("fatal", "plan"):
+                                raise
+                            # pool unavailable / exhausted retries:
+                            # degrade to the in-process transports —
+                            # same row multisets either way
+                            faults.note_error(cat, run_info)
+                            faults.note_degradation("pool_to_thread",
+                                                    run_info)
+                            trace.event("degrade", what="pool_to_thread",
+                                        category=cat,
+                                        error=type(e).__name__)
+                        else:
+                            shuffle_bytes[stage.stage_id] = logical
+                            run_info["pool_stages"] += 1
+                            sp.set(transport="pool", bytes=logical,
+                                   **monitor.stage_span_attrs(
+                                       run_info["query_id"],
+                                       stage.stage_id))
+                            if progress is not None:
+                                progress.stage_end(qid, stage.stage_id)
+                            continue
                     if mesh_exchange == "auto":
                         from blaze_tpu.parallel.stage_exchange import (
                             run_mesh_shuffle_stage,
@@ -309,8 +347,14 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
                 with trace.span("stage", stage_id=stage.stage_id,
                                 stage_kind="broadcast", fingerprint=fp,
                                 tasks=1) as sp:
-                    _run_broadcast_stage(stage, stages, sup, run_info,
-                                         ns=ns)
+                    frames = _run_broadcast_stage(stage, stages, sup,
+                                                  run_info, ns=ns)
+                    if pool is not None:
+                        # executors read broadcasts from the driver's
+                        # shuffle server, same frames the local
+                        # provider replays
+                        pool.server.register_frames(
+                            f"{ns}broadcast:{stage.stage_id}", frames)
                     sp.set(**monitor.stage_span_attrs(
                         run_info["query_id"], stage.stage_id))
                 run_info["broadcast_stages"] += 1
@@ -352,6 +396,9 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
                         f"{ns}broadcast:{stage.stage_id}",
                         f"{ns}broadcast_sink:{stage.stage_id}"):
                 resources.pop(key)
+            if pool is not None:
+                pool.server.unregister(f"{ns}shuffle:{stage.stage_id}")
+                pool.server.unregister(f"{ns}broadcast:{stage.stage_id}")
             shuffle_mgr.unregister_shuffle(stage.stage_id)
 
 
@@ -450,6 +497,106 @@ def _run_shuffle_stage(stage: Stage, stages: List[Stage],
     return logical
 
 
+def _pool_stage_rids(stage: Stage) -> Optional[List[str]]:
+    """Reader resource ids of a shuffle-map stage when EVERY one is
+    servable to executor processes over the driver's shuffle server
+    (committed shuffle partitions, broadcast frame lists). None marks the
+    stage pool-ineligible — it needs driver-local state a worker process
+    cannot reach (FFI export iterators, UDF eval callbacks, RSS/sink
+    consumers, fs providers, or an `:all` reader whose provider exists
+    only in the driver registry) — and it runs in-process instead."""
+    rids: List[str] = []
+    servable = True
+
+    def walk(msg) -> None:
+        nonlocal servable
+        for fd, val in msg.ListFields():
+            if fd.type == fd.TYPE_MESSAGE:
+                vals = val if _is_repeated_field(fd) else (val,)
+                for v in vals:
+                    walk(v)
+            elif fd.name == "provider_resource_id":
+                local = local_resource_id(val)
+                if ((local.startswith("shuffle:")
+                     and not local.endswith(":all"))
+                        or local.startswith("broadcast:")):
+                    rids.append(val)
+                else:
+                    servable = False
+            elif fd.name.endswith("resource_id") and val:
+                servable = False
+
+    walk(stage.plan)
+    return rids if servable else None
+
+
+def _is_repeated_field(fd) -> bool:
+    # protobuf >= 5.x deprecates FieldDescriptor.label (plan/fingerprint)
+    rep = getattr(fd, "is_repeated", None)
+    if rep is not None and not callable(rep):
+        return bool(rep)
+    return fd.label == fd.LABEL_REPEATED
+
+
+def _run_shuffle_stage_pooled(stage: Stage, stages: List[Stage],
+                              shuffle_mgr, pool, run_info, ns: str,
+                              rids: List[str]) -> int:
+    """The map stage on the PROCESS pool: each task's plan proto ships to
+    an executor over the control socket; the worker epoch-stamps the
+    writer paths, reads upstream input from the driver's shuffle server,
+    and commits crash-atomically in its own process. The driver admits
+    each result through the epoch fence, points the writer slot at the
+    accepted attempt's files, commits the MapStatus, sweeps stale-epoch
+    twins, and publishes the outputs to BOTH registries — the in-process
+    resource registry (downstream result/broadcast stages run locally)
+    and the shuffle server (downstream POOLED stages fetch from
+    workers)."""
+    from blaze_tpu.runtime import executor_pool
+
+    ntasks = _input_tasks(stage, stages)
+    reader_schema = decode_plan(stage.plan.shuffle_writer.input).schema
+    handle = shuffle_mgr.register_shuffle(
+        stage.stage_id, stage.num_partitions, reader_schema)
+    specs: List[executor_pool.PoolTaskSpec] = []
+    slots = []
+    for task in range(ntasks):
+        node = pb.PlanNode()
+        node.CopyFrom(stage.plan)
+        slot = shuffle_mgr.get_writer(handle, task)
+        node.shuffle_writer.data_file = slot.data_path
+        node.shuffle_writer.index_file = slot.index_path
+        specs.append(executor_pool.PoolTaskSpec(
+            key=f"{ns}shuffle:{stage.stage_id}:{task}",
+            kind="plan",
+            payload={"partition": task, "num_partitions": ntasks,
+                     "rids": rids,
+                     "what": f"shuffle_map[{stage.stage_id}:{task}]"},
+            blob=node.SerializeToString(),
+            what=f"shuffle_map[{stage.stage_id}:{task}]"))
+        slots.append(slot)
+    results = pool.run_tasks(specs)
+    logical = 0
+    for res, slot in zip(results, slots):
+        base_data, base_index = slot.data_path, slot.index_path
+        # the accepted attempt's epoch-stamped pair becomes the slot's
+        # committed artifact; every fenced twin is swept
+        slot.data_path = res["data_path"]
+        slot.index_path = res["index_path"]
+        written = int(res.get("logical_bytes", 0))
+        trace.record_value("shuffle_write_bytes", written)
+        logical += written
+        slot.commit()
+        artifacts.sweep_stale_epochs(
+            base_data, base_index, artifacts.epoch_of(res["data_path"]))
+    resources.put(f"{ns}shuffle:{stage.stage_id}",
+                  lambda partition: shuffle_mgr.get_reader_host(handle,
+                                                                partition))
+    pool.server.register_shuffle(
+        f"{ns}shuffle:{stage.stage_id}",
+        [(slot.data_path, slot.index_path) for slot in slots])
+    return logical
+
+
 def _fallback_shuffle_task(stage: Stage, node: pb.PlanNode, task: int,
                            ntasks: int):
     """Ladder rung 3 for a map task: run the map subtree on the row
@@ -494,7 +641,7 @@ def _fallback_shuffle_task(stage: Stage, node: pb.PlanNode, task: int,
 
 def _run_broadcast_stage(stage: Stage, stages: List[Stage],
                          sup: Supervisor, run_info=None,
-                         ns: str = "") -> None:
+                         ns: str = "") -> List[bytes]:
     # a broadcast stage runs ONE task but must see its upstream shuffles'
     # WHOLE output — a plan like broadcast(final_agg(exchange(...)))
     # would otherwise read only partition 0 and broadcast a quarter of
@@ -518,6 +665,7 @@ def _run_broadcast_stage(stage: Stage, stages: List[Stage],
         op_kinds=stage.op_kinds(), speculatable=False)])
     resources.put(f"{ns}broadcast:{stage.stage_id}",
                   lambda partition=0: iter(list(frames)))
+    return frames
 
 
 def _fallback_broadcast_task(stage: Stage, stages: List[Stage],

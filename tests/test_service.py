@@ -327,8 +327,12 @@ def test_current_session_via_thread_local():
 def test_stats_zero_without_service():
     assert svc_mod.active() is None
     st = svc_mod.stats()
+    # capacity falls back to conf.max_concurrent_queries when neither a
+    # service nor an executor pool is active
     assert st == {"running": 0, "queue_depth": 0, "admitted": 0,
-                  "parked": 0, "rejected": 0}
+                  "parked": 0, "rejected": 0,
+                  "capacity": svc_mod.capacity()}
+    assert st["capacity"] >= 1
 
 
 # ---------------------------------------------------------------------------

@@ -102,6 +102,20 @@ def render(metrics: dict, source: str) -> str:
         f"parked={int(g('blaze_admission_parked_total'))} "
         f"rejected={rejected}"
         + ("  ** LOAD SHEDDING **" if rejected else ""))
+    exec_rows = [(k, v) for k, v in metrics.items()
+                 if k.startswith("blaze_executor_up{")]
+    if exec_rows:
+        live = int(g("blaze_executor_live"))
+        up = " ".join(
+            k.split('exec_id="', 1)[-1].rstrip('"}')
+            + ("=up" if v else "=DOWN")
+            for k, v in sorted(exec_rows))
+        lines.append(
+            f"execs    live={live} "
+            f"capacity={int(g('blaze_service_capacity'))} "
+            f"deaths={int(g('blaze_executor_deaths_total'))} "
+            f"restarts={int(g('blaze_executor_restarts_total'))}  {up}"
+            + ("  ** NO EXECUTORS LIVE **" if live == 0 else ""))
     tenants = [(k, v) for k, v in metrics.items()
                if k.startswith("blaze_tenant_mem_used_bytes{")]
     for key, v in sorted(tenants):

@@ -296,6 +296,227 @@ def _service_soak(tables, args):
     return rounds
 
 
+def _executor_kill_round(tables, kind, flight_dir, seed_tag):
+    """One kill-recovery round: run the q3 catalogue query with a 2-seat
+    executor pool active, fire the `kind` fault at the first executor
+    seen busy mid-stage, and demand (a) the answer still matches the
+    pandas oracle, (b) exactly one executor_death dossier for the kill,
+    (c) the admission capacity timeline shrinks then recovers, and
+    (d) zero leaked resources or orphan artifacts.
+
+    kinds: sigkill | sigterm (process dies) | hung (stops heartbeating
+    without dying — the zombie; its late results must be epoch-fenced)."""
+    import signal
+    import threading
+
+    from blaze_tpu.config import conf
+    from blaze_tpu.runtime import executor_pool as ep
+    from blaze_tpu.runtime import flight_recorder
+    from blaze_tpu.spark import validator
+    from blaze_tpu.spark.local_runner import run_plan
+
+    paths, frames = tables
+    plan, oracle = validator.QUERIES["q3_join_agg_sort"](paths, frames,
+                                                         "smj")
+    saved = {k: getattr(conf, k) for k in
+             ("flight_dir", "executor_death_ms", "executor_heartbeat_ms")}
+    conf.flight_dir = flight_dir
+    conf.executor_death_ms = 800
+    conf.executor_heartbeat_ms = 50
+    rec = {"round": f"kill_{kind}_{seed_tag}", "kind": kind}
+    timeline = []
+    work_dir = tempfile.mkdtemp(prefix="chaos_exec_")
+    t0 = time.time()
+    pool = ep.ExecutorPool(count=2, slots=2)
+    try:
+        pool.start()
+        t_start = time.monotonic()
+        timeline.append((0.0, pool.capacity()))
+        pool.on_membership(lambda p: timeline.append(
+            (round(time.monotonic() - t_start, 3), p.capacity())))
+        ep.activate(pool)
+        info = {}
+        box = {}
+
+        def run():
+            try:
+                box["out"] = run_plan(plan, num_partitions=4,
+                                      work_dir=work_dir,
+                                      mesh_exchange="off", run_info=info)
+            except Exception as e:  # noqa: BLE001 — recorded below
+                box["err"] = e
+
+        t = threading.Thread(target=run)
+        t.start()
+        # fire at the first busy executor; cold workers pay the jax
+        # import + compile on their first task, so the window is wide
+        fired = False
+        deadline = time.monotonic() + 120
+        while not fired and t.is_alive() and time.monotonic() < deadline:
+            busy = pool.busy_pids()
+            if busy:
+                seat, pid = next(iter(busy.items()))
+                if kind == "sigkill":
+                    os.kill(pid, signal.SIGKILL)
+                elif kind == "sigterm":
+                    os.kill(pid, signal.SIGTERM)
+                else:
+                    pool.hang_executor(seat, 3000)
+                fired = True
+            else:
+                time.sleep(0.002)
+        t.join(timeout=300)
+        rec["fired"] = fired
+        if "err" in box:
+            rec["outcome"] = "classified_fail"
+            rec["error"] = f"{type(box['err']).__name__}: {box['err']}"[:300]
+        elif not fired:
+            rec["outcome"] = "no_fire"
+        else:
+            diff = validator._compare(
+                validator._to_pandas(box["out"]).reset_index(drop=True),
+                oracle().reset_index(drop=True))
+            rec["outcome"] = "recovered" if diff is None else "wrong_answer"
+            if diff is not None:
+                rec["diff"] = diff
+        # let the respawn land so the timeline shows the recovery edge
+        deadline = time.monotonic() + 30
+        while pool.live_count() < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        # the hung worker wakes ~3s in and sends its stale result; give
+        # the fence a beat to reject it before reading the counters
+        if kind == "hung":
+            time.sleep(3.5)
+        rec["pool_stages"] = info.get("pool_stages", 0)
+        rec["stats"] = pool.stats()
+        deaths = [d for d in flight_recorder.list_dossiers(flight_dir)
+                  if d.get("trigger") == "executor_death"]
+        rec["death_dossiers"] = len(deaths)
+        rec["capacity_timeline"] = timeline
+        caps = [c for _t, c in timeline]
+        rec["capacity_shrank"] = fired and min(caps) < caps[0]
+        rec["capacity_recovered"] = pool.capacity() == caps[0]
+        rec["dossier_ok"] = (not fired) or len(deaths) == 1
+    finally:
+        ep.deactivate(pool)
+        pool.close()
+        for k, v in saved.items():
+            setattr(conf, k, v)
+    rec["seconds"] = round(time.time() - t0, 3)
+    rec.update(_leaks([work_dir]))
+    shutil.rmtree(work_dir, ignore_errors=True)
+    return rec
+
+
+def _executor_soak(tables, args):
+    """The --executors sweep (ISSUE 12 artifact, EXECUTORS_r16.json):
+
+    1. weak-scaling smoke at 1/2/4 executors — work grows with the seat
+       count (6 fixed-length tasks per seat), so ideal wall time is flat
+       and task throughput must scale; the 4-seat pool must beat the
+       1-seat pool.
+    2. a pooled catalogue-correctness round per seat count — every
+       answer diffed against the pandas oracle, with at least one stage
+       actually carried by the pool.
+    3. kill-recovery rounds: SIGKILL, SIGTERM, and the hung/zombie
+       variant fired at a busy executor mid-stage.
+    """
+    from blaze_tpu.runtime import executor_pool as ep
+    from blaze_tpu.runtime import flight_recorder
+    from blaze_tpu.spark import validator
+    from blaze_tpu.spark.local_runner import run_plan
+
+    paths, frames = tables
+    rounds = []
+
+    # -- 1. weak scaling ------------------------------------------------
+    scaling = []
+    for n in (1, 2, 4):
+        pool = ep.ExecutorPool(count=n, slots=2)
+        try:
+            pool.start()
+            # warm: the first round-trip per worker absorbs socket setup
+            pool.run_tasks([ep.PoolTaskSpec(f"warm:{i}", "echo",
+                                            {"value": i})
+                            for i in range(n)], timeout=120)
+            tasks = 6 * n
+            specs = [ep.PoolTaskSpec(f"scale:{i}", "sleep", {"ms": 150})
+                     for i in range(tasks)]
+            t0 = time.time()
+            pool.run_tasks(specs, timeout=120)
+            wall = time.time() - t0
+            scaling.append({"executors": n, "slots": 2, "tasks": tasks,
+                            "seconds": round(wall, 3),
+                            "throughput_tps": round(tasks / wall, 2)})
+            print(f"[scale] {n} executors: {tasks} tasks in {wall:.2f}s "
+                  f"({tasks / wall:.1f} tasks/s)", flush=True)
+        finally:
+            pool.close()
+    rounds.append({"round": "weak_scaling", "cells": scaling,
+                   "scaling_ok": (scaling[-1]["throughput_tps"]
+                                  > scaling[0]["throughput_tps"])})
+
+    # -- 2. pooled catalogue correctness ---------------------------------
+    for n in (1, 2, 4):
+        pool = ep.ExecutorPool(count=n, slots=2)
+        rec = {"round": f"pooled_catalogue_{n}x", "executors": n,
+               "queries": []}
+        work_dirs = []
+        t0 = time.time()
+        try:
+            pool.start()
+            ep.activate(pool)
+            for query, mode in QUERIES:
+                plan, oracle = validator.QUERIES[query](paths, frames, mode)
+                info = {}
+                wd = tempfile.mkdtemp(prefix="chaos_exec_")
+                work_dirs.append(wd)
+                q = {"query": query}
+                try:
+                    out = run_plan(plan, num_partitions=4, work_dir=wd,
+                                   mesh_exchange="off", run_info=info)
+                    diff = validator._compare(
+                        validator._to_pandas(out).reset_index(drop=True),
+                        oracle().reset_index(drop=True))
+                    q["outcome"] = ("clean_ok" if diff is None
+                                    else "wrong_answer")
+                    if diff is not None:
+                        q["diff"] = diff
+                except Exception as e:  # noqa: BLE001 — recorded
+                    q["outcome"] = "classified_fail"
+                    q["error"] = f"{type(e).__name__}: {e}"[:300]
+                q["pool_stages"] = info.get("pool_stages", 0)
+                rec["queries"].append(q)
+            rec["stats"] = pool.stats()
+        finally:
+            ep.deactivate(pool)
+            pool.close()
+        rec["seconds"] = round(time.time() - t0, 3)
+        rec["pool_carried_stages"] = sum(
+            q["pool_stages"] for q in rec["queries"])
+        rec.update(_leaks(work_dirs))
+        for wd in work_dirs:
+            shutil.rmtree(wd, ignore_errors=True)
+        print(f"[pooled] {n}x: "
+              + " ".join(sorted({q['outcome'] for q in rec['queries']}))
+              + f" pool_stages={rec['pool_carried_stages']} "
+              f"{rec['seconds']:.1f}s", flush=True)
+        rounds.append(rec)
+
+    # -- 3. kill-recovery ------------------------------------------------
+    flight_root = tempfile.mkdtemp(prefix="chaos_flight_")
+    for i, kind in enumerate(("sigkill", "sigterm", "hung")):
+        fd = os.path.join(flight_root, kind)
+        r = _executor_kill_round(tables, kind, fd, f"r{i}")
+        rounds.append(r)
+        print(f"[kill]  {kind:8s} {r['outcome']:15s} "
+              f"dossiers={r['death_dossiers']} "
+              f"capacity={r['capacity_timeline']} {r['seconds']:.1f}s",
+              flush=True)
+    shutil.rmtree(flight_root, ignore_errors=True)
+    return rounds
+
+
 def _overhead(tables):
     """Disabled-path cost: the microbench backs the <=1%-claim at the
     per-call level; the catalogue A/B shows end-to-end parity with an
@@ -387,6 +608,11 @@ def main() -> int:
                     help="concurrent multi-tenant soak through "
                          "runtime/service.QueryService (admission, quotas, "
                          "fair scheduling, per-query breaker isolation)")
+    ap.add_argument("--executors", action="store_true",
+                    help="process-isolated executor soak: weak-scaling "
+                         "smoke at 1/2/4 seats, pooled catalogue "
+                         "correctness, and SIGKILL/SIGTERM/hung "
+                         "kill-recovery rounds with epoch fencing")
     ap.add_argument("--concurrent-queries", type=int, default=8,
                     help="client sessions per --service round")
     ap.add_argument("--tenants", type=int, default=3,
@@ -399,7 +625,8 @@ def main() -> int:
     ap.add_argument("--json-out", default=None)
     args = ap.parse_args()
     if args.json_out is None:
-        args.json_out = ("SERVICE_r13.json" if args.service
+        args.json_out = ("EXECUTORS_r16.json" if args.executors
+                         else "SERVICE_r13.json" if args.service
                          else "SUPERVISOR_r07.json" if args.supervisor
                          else "PIPELINE_SOAK_r09.json" if args.pipeline
                          else "FAULTS_r06.json")
@@ -428,6 +655,41 @@ def main() -> int:
 
     tmpdir = tempfile.mkdtemp(prefix="chaos_tables_")
     tables = validator.generate_tables(tmpdir, rows=args.rows)
+
+    if args.executors:
+        rounds = _executor_soak(tables, args)
+        shutil.rmtree(tmpdir, ignore_errors=True)
+        for k, v in saved_conf.items():
+            setattr(conf, k, v)
+        bad = []
+        for r in rounds:
+            for q in r.get("queries", []):
+                if q["outcome"] != "clean_ok":
+                    bad.append(q)
+            if r.get("outcome") not in (None, "recovered"):
+                bad.append({"round": r["round"],
+                            "outcome": r.get("outcome")})
+            if (r.get("orphans") or r.get("mem_leaked")
+                    or r.get("pipeline_leaked") or r.get("resource_leaked")):
+                bad.append({"round": r["round"], "leaks": True})
+            for flag in ("scaling_ok", "dossier_ok", "capacity_shrank",
+                         "capacity_recovered"):
+                if r.get(flag) is False:
+                    bad.append({"round": r["round"], flag: False})
+            if (r.get("round", "").startswith("pooled_catalogue")
+                    and not r.get("pool_carried_stages")):
+                bad.append({"round": r["round"], "pool_carried": 0})
+        report = {
+            "rows": args.rows, "seed": args.seed,
+            "ok": not bad, "bad": bad, "rounds": rounds,
+        }
+        with open(args.json_out, "w") as f:
+            json.dump(report, f, indent=1)
+        print(f"\nexecutor soak {'OK' if report['ok'] else 'FAILED'} "
+              f"-> {args.json_out}")
+        if bad:
+            print(f"bad: {bad}")
+        return 0 if report["ok"] else 1
 
     if args.service:
         conf.max_concurrent_queries = max(
