@@ -8,12 +8,13 @@
 PYENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
 .PHONY: check check-fast check-faults check-supervisor check-trace \
+	check-durability \
 	check-pipeline check-pipeline-soak check-perf check-perf-update \
 	check-obs check-history check-lint check-service check-doctor \
 	check-flight check-executors test test-fast validate validate-fast warm
 
 check: check-lint test validate check-perf check-history check-service \
-	check-doctor check-flight check-executors
+	check-doctor check-flight check-executors check-durability
 	@echo "CHECK OK — safe to commit"
 
 # Static invariant gate (tools/blazelint): lock discipline, knob
@@ -161,6 +162,20 @@ check-flight:
 check-executors:
 	$(PYENV) python tools/chaos_soak.py --executors \
 	  --json-out EXECUTORS_r16.json
+
+# Durability gate (ISSUE 13): the corruption sweep bit-flips committed
+# artifacts (shuffle .data frame, .index offsets, spill frame) at every
+# CORRUPT_POINTS cell — each flip must be DETECTED by the checksum
+# layer, the file QUARANTINED, shuffle outputs lineage-REPAIRED by
+# re-running only the producing map task under a new epoch, and the
+# answer still oracle-equal — plus the driver-crash round: a journaling
+# subprocess driver SIGKILLed mid-query must, on restart, replay its
+# write-ahead journal (verified committed stages reused with ZERO map
+# tasks re-run, the crashed attempt billed failed with a driver_restart
+# flight dossier) and answer oracle-equal. Emits DURABILITY_r17.json.
+check-durability:
+	$(PYENV) python tools/chaos_soak.py --durability --driver \
+	  --json-out DURABILITY_r17.json
 
 # Pre-warm the persistent compile caches (runtime/compile_service):
 # replays the shape manifest + the TPC-DS catalogue into the XLA cache.

@@ -317,7 +317,7 @@ _DECLARATIONS: Tuple[Knob, ...] = (
     Knob("flight_triggers", "all",
          doc="Comma list selecting which incident classes capture "
              "(failure, shed, deadline, hang, slo_breach, breaker_trip, "
-             "resource_leak); 'all' arms every class."),
+             "resource_leak, driver_restart); 'all' arms every class."),
     Knob("progress_enabled", False,
          doc="Live per-query progress tracking (runtime/progress.py): "
              "per-stage rows/attempts/ETA served at /queries and "
@@ -349,6 +349,36 @@ _DECLARATIONS: Tuple[Knob, ...] = (
     Knob("executor_restart_backoff_ms", 100,
          doc="Base backoff before replacement spawn i of a seat is "
              "~backoff * 2^i."),
+
+    # -- durable execution (runtime/artifacts.py, runtime/journal.py) --
+    Knob("artifact_checksums", True,
+         doc="Per-frame CRC32 + whole-file digests stamped into shuffle "
+             ".index files at commit time and verified on every read "
+             "path (server segment fetch, local shuffle reads, spill "
+             "re-read). A mismatch quarantines the artifact and triggers "
+             "lineage re-execution of the producing map task under a "
+             "fresh epoch. Off = commit/read behave as before (legacy "
+             "footer-less indexes are always accepted)."),
+    Knob("journal_dir", "", env="BLAZE_TPU_JOURNAL_DIR",
+         doc="Write-ahead query journal directory ('' disables): one "
+             "crash-atomic JSONL per query recording admission, plan "
+             "fingerprints, each stage commit (artifact paths, epochs, "
+             "checksums) and completion — the recovery scan replays "
+             "incomplete journals after a driver crash."),
+    Knob("journal_retention", 256,
+         doc="Journal files retained (newest N complete journals; "
+             "incomplete ones are never pruned until recovered)."),
+    Knob("recovery_enabled", True,
+         doc="Driver-crash recovery scan at driver start (beside the "
+             "orphan sweep): incomplete journals are replayed — verified "
+             "committed stages become resumable, unverifiable queries "
+             "are billed failed with a driver_restart dossier. Needs "
+             "journal_dir."),
+    Knob("shuffle_connect_timeout_ms", 5000,
+         doc="ShuffleClient socket connect/read timeout and total retry "
+             "budget: fetches retry with exponential backoff within this "
+             "window instead of blocking forever on a hung shuffle "
+             "server. 0 = legacy blocking socket with one reconnect."),
 
     # -- per-operator enable flags (tier b, spark.blaze.enable.<op>) --
     Knob("enable_ops", default_factory=dict,

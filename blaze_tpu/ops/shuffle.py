@@ -447,19 +447,24 @@ class RssShuffleWriterExec(ShuffleWriterExec):
 def read_shuffle_partition(data_path: str, index_path: str, partition: int,
                            schema: Schema) -> Iterator[ColumnBatch]:
     """Reduce-side local read of one partition's frames (the FileSegment
-    zero-copy path of BlazeBlockStoreShuffleReaderBase, SURVEY.md §2.6)."""
-    offsets = np.frombuffer(open(index_path, "rb").read(), "<u8")
-    start, end = int(offsets[partition]), int(offsets[partition + 1])
+    zero-copy path of BlazeBlockStoreShuffleReaderBase, SURVEY.md §2.6).
+    The segment is fetched + checksum-verified through
+    artifacts.fetch_segment — a corrupt map output is quarantined and
+    repaired by lineage re-execution before a single frame decodes."""
+    import io
+
+    from blaze_tpu.runtime import artifacts
+
+    blob = artifacts.fetch_segment(data_path, index_path, partition)
     # one decompressor for the whole partition: zstd context setup costs
     # per .decompress() call dominate small frames
     dctx = serde.zstandard.ZstdDecompressor()
-    with open(data_path, "rb") as f:
-        f.seek(start)
-        while f.tell() < end:
-            b = serde.read_batch(f, schema, dctx=dctx)
-            if b is None:
-                break
-            yield b
+    f = io.BytesIO(blob)
+    while True:
+        b = serde.read_batch(f, schema, dctx=dctx)
+        if b is None:
+            break
+        yield b
 
 
 def read_shuffle_partition_host(data_path: str, index_path: str,
@@ -467,16 +472,18 @@ def read_shuffle_partition_host(data_path: str, index_path: str,
     """Same fetch, decoded only to HOST numpy frames (serde.HostBatch):
     IpcReaderExec coalesces them into one macro-batch upload instead of
     paying a device decode per frame."""
-    offsets = np.frombuffer(open(index_path, "rb").read(), "<u8")
-    start, end = int(offsets[partition]), int(offsets[partition + 1])
+    import io
+
+    from blaze_tpu.runtime import artifacts
+
+    blob = artifacts.fetch_segment(data_path, index_path, partition)
     dctx = serde.zstandard.ZstdDecompressor()
-    with open(data_path, "rb") as f:
-        f.seek(start)
-        while f.tell() < end:
-            hb = serde.read_batch_host(f, schema, dctx=dctx)
-            if hb is None:
-                break
-            yield hb
+    f = io.BytesIO(blob)
+    while True:
+        hb = serde.read_batch_host(f, schema, dctx=dctx)
+        if hb is None:
+            break
+        yield hb
 
 
 class IpcReaderExec(Operator):

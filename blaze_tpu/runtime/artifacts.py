@@ -22,12 +22,27 @@ from __future__ import annotations
 import itertools
 import os
 import re
+import struct
 import threading
-from typing import Callable, Dict, List, Sequence
+import zlib
+from typing import (Callable, Dict, List, Optional, Sequence, Set, Tuple)
 
+from blaze_tpu.config import conf
 from blaze_tpu.runtime import faults, trace
 
 ORPHAN_TAG = ".inprogress."
+QUARANTINE_TAG = ".quarantine"
+# serde frame magic (columnar/serde.py layout: u32 magic | u32 raw_len |
+# u32 comp_len | body) — hardcoded like shuffle_server.split_frames so
+# this module stays importable without numpy/jax
+_FRAME_MAGIC = b"BTB1"
+# checksum footer appended to committed .index files:
+#   BIXC | u32 n_frames | n x (u64 frame_offset, u32 frame_crc)
+#        | u32 data_crc | u32 index_crc | u32 footer_len | BIXC
+# index_crc covers the offsets region AND the footer through data_crc,
+# so a flip anywhere but the trailing 12 bytes is caught by one crc;
+# those last bytes are structural (length + magic) and fail the parse.
+CHECKSUM_MAGIC = b"BIXC"
 _SPILL_RE = re.compile(r"^blz(\d+)-.*\.spill$")
 _EPOCH_RE = re.compile(r"\.e(\d+)(\.[A-Za-z0-9_]+)$")
 _seq = itertools.count()
@@ -99,6 +114,8 @@ def commit_shuffle_pair(write_fn, data_path: str, index_path: str,
     claimed = False
     try:
         lengths = write_fn(tmp_data, tmp_index)
+        if conf.artifact_checksums:
+            _append_index_footer(tmp_data, tmp_index)
         _fsync_path(tmp_data)
         _fsync_path(tmp_index)
         faults.inject("shuffle.commit")
@@ -113,6 +130,8 @@ def commit_shuffle_pair(write_fn, data_path: str, index_path: str,
         os.replace(tmp_index, index_path)
         trace.event("artifact_commit", what="shuffle_pair",
                     gated=gate is not None)
+        faults.maybe_corrupt("corrupt.shuffle_data", data_path)
+        faults.maybe_corrupt("corrupt.shuffle_index", index_path)
         return lengths
     except BaseException:
         if claimed:
@@ -120,6 +139,330 @@ def commit_shuffle_pair(write_fn, data_path: str, index_path: str,
         _unlink_quiet(tmp_data)
         _unlink_quiet(tmp_index)
         raise
+
+
+# ---------------------------------------------------------------------------
+# Artifact integrity: commit-time checksums, read-path verification,
+# quarantine + lineage repair
+# ---------------------------------------------------------------------------
+#
+# The commit protocol above guarantees a visible pair is COMPLETE; it
+# says nothing about the pair staying CORRECT. A bit flip or torn write
+# that survives fsync would be served to readers as truth — so commit
+# stamps per-frame CRC32s (and whole-file digests) into a self-
+# describing .index footer, every read path verifies what it is about
+# to decode, and a mismatch quarantines the pair and re-executes only
+# the producing map task under a fresh epoch (the lineage property the
+# executor-death recovery already relies on).
+
+
+def walk_frames(fp) -> Tuple[List[Tuple[int, int]], int]:
+    """Walk a .data file's serde frames, returning ([(offset,
+    frame_crc32)], whole_file_crc32); raises ValueError on a torn or
+    non-frame layout."""
+    frames: List[Tuple[int, int]] = []
+    data_crc = 0
+    off = 0
+    while True:
+        head = fp.read(12)
+        if not head:
+            return frames, data_crc
+        if len(head) < 12 or head[:4] != _FRAME_MAGIC:
+            raise ValueError(f"bad frame header at offset {off}")
+        (comp_len,) = struct.unpack_from("<I", head, 8)
+        body = fp.read(comp_len)
+        if len(body) != comp_len:
+            raise ValueError(f"truncated frame at offset {off}")
+        frames.append((off, zlib.crc32(body, zlib.crc32(head))))
+        data_crc = zlib.crc32(body, zlib.crc32(head, data_crc))
+        off += 12 + comp_len
+
+
+def _append_index_footer(tmp_data: str, tmp_index: str) -> None:
+    """Stamp the checksum footer onto a STAGED index (commit time, before
+    fsync/publish). Data files that aren't serde frame streams are left
+    unstamped — their readers have no frame structure to verify."""
+    try:
+        with open(tmp_data, "rb") as f:
+            frames, data_crc = walk_frames(f)
+    except (OSError, ValueError):
+        return
+    with open(tmp_index, "rb") as f:
+        offsets = f.read()
+    body = bytearray(CHECKSUM_MAGIC)
+    body += struct.pack("<I", len(frames))
+    for off, crc in frames:
+        body += struct.pack("<QI", off, crc)
+    body += struct.pack("<I", data_crc)
+    index_crc = zlib.crc32(bytes(body), zlib.crc32(offsets))
+    body += struct.pack("<II", index_crc, len(body) + 12)
+    body += CHECKSUM_MAGIC
+    with open(tmp_index, "ab") as f:
+        f.write(bytes(body))
+
+
+def split_index(raw: bytes, path: str = "") -> Tuple[bytes, Optional[dict]]:
+    """Strip + parse the checksum footer from raw .index bytes.
+
+    Returns (offsets_bytes, meta) where meta is None for legacy
+    footer-less indexes (verification skipped) or {"frames": {abs_offset:
+    crc}, "data_crc": int, "n_frames": int}. With conf.artifact_checksums
+    on, a structurally mangled footer or an index-checksum mismatch
+    raises faults.CorruptArtifactError; off, the footer is stripped best
+    effort and verification is skipped."""
+    verify = bool(conf.artifact_checksums)
+    if len(raw) >= 24 and raw[-4:] == CHECKSUM_MAGIC:
+        (footer_len,) = struct.unpack_from("<I", raw, len(raw) - 8)
+        start = len(raw) - footer_len
+        ok = (24 <= footer_len <= len(raw)
+              and (footer_len - 24) % 12 == 0
+              and raw[start:start + 4] == CHECKSUM_MAGIC)
+        if ok:
+            (n,) = struct.unpack_from("<I", raw, start + 4)
+            ok = footer_len == 24 + 12 * n
+        if not ok:
+            if verify:
+                raise faults.CorruptArtifactError(
+                    f"mangled index footer in {path or '<index>'}")
+            return raw, None
+        if verify:
+            (index_crc,) = struct.unpack_from("<I", raw, len(raw) - 12)
+            if zlib.crc32(raw[:len(raw) - 12]) != index_crc:
+                raise faults.CorruptArtifactError(
+                    f"index checksum mismatch in {path or '<index>'}")
+        frames: Dict[int, int] = {}
+        for i in range(n):
+            foff, fcrc = struct.unpack_from("<QI", raw, start + 8 + 12 * i)
+            frames[foff] = fcrc
+        (data_crc,) = struct.unpack_from("<I", raw, start + 8 + 12 * n)
+        return raw[:start], {"frames": frames, "data_crc": data_crc,
+                             "n_frames": n}
+    if verify and CHECKSUM_MAGIC in raw:
+        # a footer was written but its trailing magic is gone: that is
+        # not a legacy index, it is a flipped byte in the footer
+        raise faults.CorruptArtifactError(
+            f"mangled index footer in {path or '<index>'}")
+    return raw, None
+
+
+def read_index(path: str) -> Tuple[bytes, Optional[dict]]:
+    """Offsets bytes + checksum meta of a committed .index (every index
+    reader routes through this so none ever sees footer bytes)."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    return split_index(raw, path)
+
+
+def verify_segment(blob: bytes, base: int, meta: Optional[dict],
+                   data_path: str) -> None:
+    """Verify a fetched segment's frames (`blob` starts at absolute file
+    offset `base`) against the commit-time frame crcs; no-op for legacy
+    artifacts (meta None) or with checksums off."""
+    if meta is None or not conf.artifact_checksums:
+        return
+    frames = meta["frames"]
+    off = 0
+    total = len(blob)
+    while off < total:
+        if off + 12 > total or blob[off:off + 4] != _FRAME_MAGIC:
+            raise faults.CorruptArtifactError(
+                f"torn frame at {data_path}+{base + off}")
+        (comp_len,) = struct.unpack_from("<I", blob, off + 8)
+        end = off + 12 + comp_len
+        if end > total:
+            raise faults.CorruptArtifactError(
+                f"truncated frame at {data_path}+{base + off}")
+        want = frames.get(base + off)
+        if want is None or zlib.crc32(blob[off:end]) != want:
+            raise faults.CorruptArtifactError(
+                f"frame checksum mismatch at {data_path}+{base + off}")
+        off = end
+
+
+def _fetch_segment_once(data_path: str, index_path: str,
+                        partition: int) -> bytes:
+    offsets_raw, meta = read_index(index_path)
+    n = len(offsets_raw) // 8
+    if partition + 1 >= n:
+        raise IndexError(f"partition {partition} out of range for "
+                         f"{index_path} ({n - 1} partitions)")
+    start, end = struct.unpack_from("<2Q", offsets_raw, partition * 8)
+    if end == start:
+        return b""
+    with open(data_path, "rb") as f:
+        f.seek(start)
+        blob = f.read(end - start)
+    if len(blob) != end - start:
+        raise faults.CorruptArtifactError(
+            f"short segment read from {data_path} "
+            f"(index names bytes the data file doesn't have)")
+    verify_segment(blob, start, meta, data_path)
+    return blob
+
+
+def fetch_segment(data_path: str, index_path: str, partition: int) -> bytes:
+    """One partition's verified segment bytes from a committed pair,
+    following quarantine redirects; detected corruption quarantines the
+    pair and re-executes the producing map task once (the repaired
+    lineage is then read)."""
+    for attempt in range(2):
+        data_path, index_path = resolve_artifact(data_path, index_path)
+        try:
+            return _fetch_segment_once(data_path, index_path, partition)
+        except faults.CorruptArtifactError as e:
+            if attempt:
+                raise
+            data_path, index_path = handle_corruption(
+                data_path, index_path, str(e))
+    raise AssertionError("unreachable")
+
+
+def verify_pair(data_path: str, index_path: str) -> bool:
+    """Full offline verification of a committed pair (the recovery
+    scan's reuse test): footer parses, index checksum matches, every
+    frame crc and the whole-file digest match. Never raises."""
+    try:
+        _offsets, meta = read_index(index_path)
+    except (OSError, faults.CorruptArtifactError):
+        return False
+    if meta is None:
+        return not conf.artifact_checksums
+    try:
+        with open(data_path, "rb") as f:
+            frames, data_crc = walk_frames(f)
+    except (OSError, ValueError):
+        return False
+    return data_crc == meta["data_crc"] and dict(frames) == meta["frames"]
+
+
+# -- quarantine + lineage repair --------------------------------------------
+
+_repair_cv = threading.Condition(threading.Lock())
+_repairs: Dict[str, Callable[[], Tuple[str, str]]] = {}
+_redirects: Dict[str, Tuple[str, str]] = {}
+_repairing: Set[str] = set()
+_integrity_stats = {"corruptions": 0, "quarantined": 0, "repaired": 0}
+
+
+def corruption_stats() -> Dict[str, int]:
+    """Process-lifetime integrity counters (monitor exports
+    blaze_artifact_corruptions_total from "corruptions")."""
+    with _repair_cv:
+        return dict(_integrity_stats)
+
+
+def register_repair(data_path: str,
+                    fn: Callable[[], Tuple[str, str]]) -> None:
+    """Register the lineage re-execution closure for a committed map
+    output: fn() re-runs ONLY the producing map task under a fresh
+    epoch, commits, and returns the new (data_path, index_path)."""
+    with _repair_cv:
+        _repairs[data_path] = fn
+
+
+def forget_repair(data_path: str) -> None:
+    with _repair_cv:
+        _repairs.pop(data_path, None)
+        _redirects.pop(data_path, None)
+
+
+def resolve_artifact(data_path: str,
+                     index_path: str) -> Tuple[str, str]:
+    """Follow quarantine redirects: after a repair, readers holding the
+    original registered paths transparently read the repaired pair."""
+    with _repair_cv:
+        seen = set()
+        while data_path in _redirects and data_path not in seen:
+            seen.add(data_path)
+            data_path, index_path = _redirects[data_path]
+        return data_path, index_path
+
+
+def quarantine(path: str) -> str:
+    """Move a corrupt artifact aside as `<path>.quarantine` (suffixed
+    `.quarantine.<n>` on name collision — repeated corruption of the
+    same lineage must not clobber earlier evidence). Returns the
+    quarantine name, or '' when the file is already gone."""
+    qpath = path + QUARANTINE_TAG
+    n = 0
+    while os.path.exists(qpath):
+        n += 1
+        qpath = f"{path}{QUARANTINE_TAG}.{n}"
+    try:
+        os.replace(path, qpath)
+    except OSError:
+        return ""
+    return qpath
+
+
+def note_corruption(path: str, detail: str = "") -> str:
+    """Count + trace + quarantine a corrupt artifact with NO lineage
+    repair (spill files: the owning task's retry rebuilds them from its
+    input stream). Returns the quarantine name ('' if already gone)."""
+    with _repair_cv:
+        _integrity_stats["corruptions"] += 1
+    faults.TELEMETRY.add("artifact_corruptions", 1)
+    trace.event("artifact_corrupt", path=os.path.basename(path),
+                detail=detail[:200])
+    qpath = quarantine(path)
+    with _repair_cv:
+        _integrity_stats["quarantined"] += 1
+    trace.event("artifact_quarantined", path=os.path.basename(path),
+                quarantined_as=os.path.basename(qpath) if qpath else "")
+    return qpath
+
+
+def handle_corruption(data_path: str, index_path: str,
+                      detail: str) -> Tuple[str, str]:
+    """Quarantine a corrupt pair and repair it via lineage re-execution.
+
+    First detector wins: it quarantines both files and runs the
+    registered repair closure; concurrent detectors of the SAME pair
+    park on the condition and follow the winner's redirect. Returns the
+    repaired (data_path, index_path); raises CorruptArtifactError when
+    no repair is registered or the re-execution itself failed."""
+    with _repair_cv:
+        red = _redirects.get(data_path)
+        if red is not None:
+            return red
+        while data_path in _repairing:
+            _repair_cv.wait(timeout=60.0)
+            red = _redirects.get(data_path)
+            if red is not None:
+                return red
+        red = _redirects.get(data_path)
+        if red is not None:
+            return red
+        _repairing.add(data_path)
+        fn = _repairs.get(data_path)
+        _integrity_stats["corruptions"] += 1
+    try:
+        faults.TELEMETRY.add("artifact_corruptions", 1)
+        trace.event("artifact_corrupt",
+                    path=os.path.basename(data_path),
+                    detail=detail[:200])
+        qd = quarantine(data_path)
+        quarantine(index_path)
+        with _repair_cv:
+            _integrity_stats["quarantined"] += 1
+        trace.event("artifact_quarantined",
+                    path=os.path.basename(data_path),
+                    quarantined_as=os.path.basename(qd) if qd else "")
+        faults.TELEMETRY.add("artifact_quarantines", 1)
+        if fn is None:
+            raise faults.CorruptArtifactError(
+                f"corrupt artifact {data_path}: {detail} "
+                f"(no lineage repair registered)")
+        new_pair = fn()
+        pair = (str(new_pair[0]), str(new_pair[1]))
+        with _repair_cv:
+            _redirects[data_path] = pair
+            _integrity_stats["repaired"] += 1
+        return pair
+    finally:
+        with _repair_cv:
+            _repairing.discard(data_path)
+            _repair_cv.notify_all()
 
 
 # ---------------------------------------------------------------------------

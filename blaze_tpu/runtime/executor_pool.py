@@ -81,6 +81,9 @@ _WORKER_CONF_OVERRIDES = {
     "flight_dir": "",
     "progress_enabled": False,
     "fault_injection_spec": {},
+    # only the driver journals (one journal per query) or replays them
+    "journal_dir": "",
+    "recovery_enabled": False,
 }
 
 

@@ -57,6 +57,7 @@ matches every point beneath it ("op.FilterExec").
 from __future__ import annotations
 
 import errno
+import os
 import random
 import threading
 import time
@@ -102,6 +103,14 @@ class HungError(RetryableError):
     fail, it was killed, and a false positive (a long jit compile
     between batch boundaries) must not consume the task's real retry
     budget. Relaunches skip the backoff sleep for the same reason."""
+
+
+class CorruptArtifactError(RetryableError):
+    """A committed artifact failed checksum verification (bit flip, torn
+    write that survived fsync, truncation). Retryable by taxonomy — the
+    artifact layer quarantines the file and re-executes the producing
+    map task under a fresh epoch (runtime/artifacts.handle_corruption),
+    so a retry reads the repaired lineage, not the poison."""
 
 
 class PlanError(FaultError, NotImplementedError):
@@ -251,6 +260,17 @@ KNOWN_POINTS = (
     "io.prefetch",
 )
 
+# corruption points (kind "corrupt" ONLY, fired through maybe_corrupt):
+# each bit-flips one byte of an already-COMMITTED artifact, modelling a
+# latent media error rather than a failing call — so they live outside
+# KNOWN_POINTS (the io/oom/stall sweeps would arm them to no effect).
+# tools/chaos_soak.py --durability sweeps this list.
+CORRUPT_POINTS = (
+    "corrupt.shuffle_data",
+    "corrupt.shuffle_index",
+    "corrupt.spill",
+)
+
 _counters: Dict[str, int] = {}
 _rngs: Dict[str, random.Random] = {}
 injection_log: List[Tuple[str, int]] = []  # (point, per-rule call index)
@@ -311,19 +331,11 @@ def _rule_for(points: dict, point: str):
         p = p[:i]
 
 
-def inject(point: str) -> None:
-    """Raise a classified fault at `point` if the active spec says so.
-
-    Disabled path (empty spec — production): one truthiness check."""
-    spec = conf.fault_injection_spec
-    if not spec:
-        return
-    points = spec.get("points")
-    if not points:
-        return
-    key, rule = _rule_for(points, point)
-    if rule is None:
-        return
+def _schedule_fire(spec: dict, point: str, key: str, rule: dict
+                   ) -> Tuple[bool, int]:
+    """Advance `key`'s deterministic schedule one call and decide whether
+    the rule fires; appends fired calls to the injection log. Shared by
+    inject() and maybe_corrupt() so both kinds replay bit-identically."""
     with _sched_lock:
         n = _counters[key] = _counters.get(key, 0) + 1
         if "nth" in rule:
@@ -340,6 +352,23 @@ def inject(point: str) -> None:
             fire = True
         if fire:
             injection_log.append((point, n))
+    return fire, n
+
+
+def inject(point: str) -> None:
+    """Raise a classified fault at `point` if the active spec says so.
+
+    Disabled path (empty spec — production): one truthiness check."""
+    spec = conf.fault_injection_spec
+    if not spec:
+        return
+    points = spec.get("points")
+    if not points:
+        return
+    key, rule = _rule_for(points, point)
+    if rule is None or rule.get("kind") == "corrupt":
+        return  # "corrupt" rules only act through maybe_corrupt()
+    fire, n = _schedule_fire(spec, point, key, rule)
     if not fire:
         return
     TELEMETRY.add("faults_injected", 1)
@@ -389,6 +418,45 @@ def _stall(point: str, n: int, rule: dict) -> None:
         elif ev.wait(step):
             raise TaskKilledError(
                 f"stalled attempt killed at {point} (call #{n})")
+
+
+def maybe_corrupt(point: str, path: str) -> bool:
+    """Bit-flip one byte of the COMMITTED artifact at `path` when the
+    active spec arms `point` with kind "corrupt"; returns True when the
+    file was mutated. Unlike inject() this fires AFTER publish — the
+    flip lands in the durable artifact exactly like a latent media
+    error, so the read-path checksum verification (not the commit
+    protocol) must catch it. The flipped offset derives from the spec
+    seed, point and call index: same seed, same poisoned byte."""
+    spec = conf.fault_injection_spec
+    if not spec:
+        return False
+    points = spec.get("points")
+    if not points:
+        return False
+    key, rule = _rule_for(points, point)
+    if rule is None or rule.get("kind") != "corrupt":
+        return False
+    fire, n = _schedule_fire(spec, point, key, rule)
+    if not fire:
+        return False
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return False
+    if size <= 0:
+        return False
+    off = _mix(spec.get("seed", 0), f"{point}#{n}") % size
+    with open(path, "r+b") as f:
+        f.seek(off)
+        byte = f.read(1)
+        f.seek(off)
+        f.write(bytes([byte[0] ^ 0x40]))
+    TELEMETRY.add("faults_injected", 1)
+    TELEMETRY.add(f"injected.{key}", 1)
+    trace.event("fault_injected", point=point, call=n,
+                fault_kind="corrupt")
+    return True
 
 
 def stats() -> Dict[str, int]:

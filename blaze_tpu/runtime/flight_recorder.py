@@ -52,7 +52,8 @@ from blaze_tpu.runtime import artifacts, monitor, trace
 SCHEMA_VERSION = 1
 
 TRIGGERS = ("failure", "shed", "deadline", "hang", "slo_breach",
-            "breaker_trip", "resource_leak", "executor_death")
+            "breaker_trip", "resource_leak", "executor_death",
+            "driver_restart")
 
 _lock = threading.Lock()
 _captured: set = set()            # (query_id, trigger): exactly-once

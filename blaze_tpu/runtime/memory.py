@@ -23,6 +23,7 @@ import tempfile
 import threading
 import time
 import weakref
+import zlib
 from typing import BinaryIO, Iterator, List, Optional
 
 from blaze_tpu.columnar import serde
@@ -391,6 +392,12 @@ class SpillFile:
         # frames written but not yet synced to disk: host buffer pages
         # that count against the owning manager's budget
         self.pending_bytes = 0
+        # per-frame (offset, crc32) recorded at write time: a spill
+        # never outlives its process, so the checksums live here rather
+        # than in a footer; read()/read_host() verify the file against
+        # them before a single frame decodes
+        self._frame_crcs: list = []
+        self._quarantined: list = []
         self._manager = manager
         if manager is not None:
             manager.track_spill(self)
@@ -406,6 +413,8 @@ class SpillFile:
         # the spill term is the injected stall + the file write itself
         buf = serde.serialize_batch(batch)
         t2 = time.perf_counter_ns()
+        if conf.artifact_checksums:
+            self._frame_crcs.append((self.bytes_written, zlib.crc32(buf)))
         self._fp.write(buf)
         n = len(buf)
         self.bytes_written += n
@@ -428,6 +437,31 @@ class SpillFile:
         self.pending_bytes = 0
         return freed
 
+    def _verify_frames(self) -> None:
+        """Re-read verification against the write-time frame crcs (the
+        spill never outlives the process, so in-memory checksums are the
+        whole-file digest). A mismatch quarantines the file and raises
+        CorruptArtifactError — retryable: the task's retry rebuilds its
+        spill from the input stream, there is no lineage to repair."""
+        from blaze_tpu.runtime import artifacts, faults
+
+        if not conf.artifact_checksums:
+            return
+        faults.maybe_corrupt("corrupt.spill", self.path)
+        self._fp.seek(0)
+        try:
+            frames, _crc = artifacts.walk_frames(self._fp)
+            ok = frames == self._frame_crcs
+        except ValueError:
+            ok = False
+        if not ok:
+            qpath = artifacts.note_corruption(
+                self.path, "spill frame checksum mismatch")
+            if qpath:
+                self._quarantined.append(qpath)
+            raise faults.CorruptArtifactError(
+                f"spill checksum mismatch in {self.path} (quarantined)")
+
     def read(self) -> Iterator[ColumnBatch]:
         from blaze_tpu.runtime import faults, pipeline
 
@@ -435,6 +469,7 @@ class SpillFile:
         if conf.fault_injection_spec:
             faults.inject("spill.read")
         self.flush_pages()
+        self._verify_frames()
         self._fp.seek(0)
         if conf.monitor_enabled:
             # the whole file is about to be re-read; counted up front
@@ -458,6 +493,7 @@ class SpillFile:
         if conf.fault_injection_spec:
             faults.inject("spill.read")
         self.flush_pages()
+        self._verify_frames()
         self._fp.seek(0)
         if conf.monitor_enabled:
             monitor.count_copy("spill", self.bytes_written)
@@ -477,6 +513,15 @@ class SpillFile:
                 os.unlink(self.path)
             except OSError:
                 pass
+            # a quarantined spill is ephemeral evidence: the retry that
+            # follows rebuilds the data, so closing reclaims it (a
+            # shuffle pair's quarantine, by contrast, is kept)
+            for q in self._quarantined:
+                try:
+                    os.unlink(q)
+                except OSError:
+                    pass
+            self._quarantined = []
 
     def __del__(self):
         self.close()

@@ -499,6 +499,8 @@ GAUGE_NAMES = (
     "blaze_executor_restarts_total",
     "blaze_executor_deaths_total",
     "blaze_service_capacity",
+    "blaze_artifact_corruptions_total",
+    "blaze_recovered_queries_total",
 )
 GAUGE_PREFIXES = (
     "blaze_pipeline_",  # pipeline.TELEMETRY counters
@@ -682,6 +684,15 @@ def prometheus_text() -> str:
          "Incident dossiers written by the flight recorder, by trigger",
          [({"trigger": t}, n)
           for t, n in sorted(flight_recorder.counts().items())])
+    from blaze_tpu.runtime import artifacts, journal
+
+    emit("blaze_artifact_corruptions_total", "counter",
+         "Corrupt artifacts detected on read paths (checksum mismatch)",
+         [({}, artifacts.corruption_stats()["corruptions"])])
+    emit("blaze_recovered_queries_total", "counter",
+         "Queries that reused journaled stage commits after a driver "
+         "restart",
+         [({}, journal.recovered_queries_total())])
     emit("blaze_query_progress_ratio", "gauge",
          "Live per-query progress ratio (0-1, monotone per query)",
          [({"qid": s["query_id"]}, s["progress_ratio"])

@@ -234,6 +234,12 @@ class QueryService:
 
     def start(self) -> "QueryService":
         global _active
+        # driver-crash recovery before the first admission: incomplete
+        # journals from a killed predecessor are replayed (verified
+        # stage commits harvested for reuse, the rest billed failed)
+        from blaze_tpu.runtime import journal
+
+        journal.ensure_recovery_scan()
         self.scheduler = supervisor.FairScheduler(
             max(1, int(conf.max_concurrent_tasks)))
         memory.get_manager().set_tenant_quotas(conf.tenant_quota_spec)

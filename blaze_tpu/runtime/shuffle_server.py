@@ -31,6 +31,7 @@ import os
 import socket
 import struct
 import threading
+import time
 import zlib
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -93,21 +94,17 @@ def recv_msg(sock: socket.socket) -> Tuple[dict, bytes]:
 
 
 def _read_segment(data_path: str, index_path: str, partition: int) -> bytes:
-    """One map output's bytes for `partition`, located through the
-    committed little-endian u64 offsets index (the FileSegment fetch of
-    shuffle_manager.get_reader, without the decode)."""
-    with open(index_path, "rb") as f:
-        offsets = f.read()
-    n = len(offsets) // 8
-    if partition + 1 >= n:
-        raise IndexError(f"partition {partition} out of range for "
-                         f"{index_path} ({n - 1} partitions)")
-    start, end = struct.unpack_from("<2Q", offsets, partition * 8)
-    if end == start:
-        return b""
-    with open(data_path, "rb") as f:
-        f.seek(start)
-        return f.read(end - start)
+    """One map output's VERIFIED bytes for `partition`, located through
+    the committed little-endian u64 offsets index (the FileSegment fetch
+    of shuffle_manager.get_reader, without the decode). Delegates to
+    artifacts.fetch_segment — checksum verification, quarantine and
+    lineage repair happen server-side, where the repair closures live.
+    The import is lazy to keep this module import-light (worker
+    processes import it before deciding whether they need the engine;
+    _read_segment only ever runs driver-side)."""
+    from blaze_tpu.runtime import artifacts
+
+    return artifacts.fetch_segment(data_path, index_path, partition)
 
 
 class ShuffleServer:
@@ -236,9 +233,23 @@ class ShuffleClient:
         self._lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
 
+    @staticmethod
+    def _timeout_ms() -> float:
+        # lazy conf import: importing blaze_tpu.config initializes the
+        # package (jax), which this module must not do at import time
+        from blaze_tpu.config import conf
+
+        return float(conf.shuffle_connect_timeout_ms)
+
     def _ensure_locked(self) -> socket.socket:
         if self._sock is None:
             s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            timeout_ms = self._timeout_ms()
+            if timeout_ms > 0:
+                # bounds connect AND every recv: a hung shuffle server
+                # surfaces as socket.timeout (an OSError the retry
+                # ladder absorbs) instead of blocking the task forever
+                s.settimeout(timeout_ms / 1000.0)
             s.connect(self.sock_path)
             self._sock = s
         return self._sock
@@ -250,21 +261,50 @@ class ShuffleClient:
             finally:
                 self._sock = None
 
+    def _fetch_once_locked(self, rid: str,
+                           partition: int) -> Tuple[dict, bytes]:
+        sock = self._ensure_locked()
+        send_msg(sock, {"type": "fetch", "rid": rid,
+                        "partition": partition})
+        return recv_msg(sock)
+
     def fetch(self, rid: str, partition: int) -> bytes:
+        """Fetch one partition segment, retrying lost/hung connections
+        on a bounded exponential-backoff ladder: the whole ladder (and
+        each socket read) fits inside conf.shuffle_connect_timeout_ms,
+        so a hung or restarting shuffle server costs a bounded wait,
+        never a wedged task. 0 restores the legacy posture — blocking
+        socket, one reconnect."""
+        timeout_ms = self._timeout_ms()
         with self._lock:
-            try:
-                sock = self._ensure_locked()
-                send_msg(sock, {"type": "fetch", "rid": rid,
-                                "partition": partition})
-                msg, blob = recv_msg(sock)
-            except (ConnectionError, OSError):
-                # one reconnect: the driver may have restarted the
-                # listener; a second failure is the caller's problem
-                self._close_locked()
-                sock = self._ensure_locked()
-                send_msg(sock, {"type": "fetch", "rid": rid,
-                                "partition": partition})
-                msg, blob = recv_msg(sock)
+            if timeout_ms <= 0:
+                try:
+                    msg, blob = self._fetch_once_locked(rid, partition)
+                except (ConnectionError, OSError):
+                    # one reconnect: the driver may have restarted the
+                    # listener; a second failure is the caller's problem
+                    self._close_locked()
+                    msg, blob = self._fetch_once_locked(rid, partition)
+            else:
+                deadline = time.monotonic() + timeout_ms / 1000.0
+                delay = 0.01
+                attempt = 0
+                while True:
+                    try:
+                        msg, blob = self._fetch_once_locked(rid, partition)
+                        break
+                    except (ConnectionError, OSError) as e:
+                        self._close_locked()
+                        attempt += 1
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            raise ConnectionError(
+                                f"shuffle fetch {rid}[{partition}] "
+                                f"failed after {attempt} attempts "
+                                f"within {int(timeout_ms)}ms: {e}"
+                            ) from e
+                        time.sleep(min(delay, remaining))
+                        delay = min(delay * 2.0, 0.5)
         if not msg.get("ok"):
             raise KeyError(msg.get("error", f"fetch failed: {rid}"))
         return blob

@@ -165,6 +165,8 @@ EVENT_KINDS = (
     "admission_parked",     # service: query queued behind a full pool
     "admission_rejected",   # service: load shed (queue full / deadline)
     "artifact_commit",      # runtime/artifacts.py: first-commit-wins publish
+    "artifact_corrupt",     # artifacts: read-path checksum mismatch
+    "artifact_quarantined", # artifacts: corrupt file renamed .quarantine
     "batch",                # ops/base.count_stream batch boundary
     "breaker_trip",         # supervisor: per-operator circuit breaker
     "compile_compiled",     # compile_service: fresh XLA compilation
@@ -175,6 +177,7 @@ EVENT_KINDS = (
     "deadline_exceeded",    # executor: task/query budget exhausted
     "deadline_kill",        # supervisor: budget exhausted mid-attempt
     "degrade",              # executor: resilience-ladder rung taken
+    "driver_recovery",      # journal: recovery scan replayed a journal
     "epoch_fenced",         # artifacts.EpochFence: stale attempt rejected
     "executor_death",       # supervisor/pool: executor process declared dead
     "executor_spawn",       # executor_pool: worker process launched
@@ -183,6 +186,8 @@ EVENT_KINDS = (
     "flight_capture",       # flight_recorder: incident dossier written
     "hang_detected",        # supervisor watchdog: heartbeat stale
     "hang_relaunch",        # supervisor: killed attempt relaunched
+    "journal_replay",       # local_runner: committed stage reused from
+                            # a recovered write-ahead journal
     "ladder_rung",          # executor: degradation ladder transition
     "mem_release",          # memory: reservation released by sweep
     "orphan_sweep",         # artifacts: stale attempt files removed
@@ -771,12 +776,19 @@ def build_run_record(query_id: str, run_info: Optional[dict] = None,
 
 def export_run_ledger(path: str, record: dict) -> None:
     """Append one JSONL line (atomic enough for trend tooling: a single
-    write() of one line; concurrent drivers interleave whole lines)."""
+    write() of one line; concurrent drivers interleave whole lines). A
+    crash-torn tail (a prior driver died mid-write, leaving a line with
+    no newline) is healed before appending, the history-store posture —
+    the new record must never concatenate onto garbage."""
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "a") as f:
-        f.write(json.dumps(record, default=str) + "\n")
+    with open(path, "ab+") as f:
+        if f.tell() > 0:
+            f.seek(-1, os.SEEK_END)
+            if f.read(1) != b"\n":
+                f.write(b"\n")
+        f.write((json.dumps(record, default=str) + "\n").encode())
 
 
 def rotate_export_dir(export_dir: Optional[str] = None,

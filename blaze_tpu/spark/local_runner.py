@@ -18,7 +18,9 @@ local-mode for the same reason, .github/workflows/tpcds.yml).
 
 from __future__ import annotations
 
+import base64
 import os
+import sys
 import tempfile
 from typing import Dict, List, Optional
 
@@ -27,7 +29,8 @@ from blaze_tpu.ops.base import ExecContext
 from blaze_tpu.ops.common import concat_batches
 from blaze_tpu.plan import decode_plan, fingerprint_plan
 from blaze_tpu.plan import plan_pb2 as pb
-from blaze_tpu.runtime import artifacts, faults, history, monitor
+from blaze_tpu.plan.fingerprint import fingerprint_query
+from blaze_tpu.runtime import artifacts, faults, history, journal, monitor
 from blaze_tpu.runtime import resources, trace
 from blaze_tpu.runtime import supervisor as supervisor_mod
 from blaze_tpu.runtime.executor import execute_plan, run_task_with_resilience
@@ -97,6 +100,12 @@ def run_plan(root: SparkPlan, num_partitions: int = 4,
     # whole-stage group cardinality accumulate under this qid until
     # record_run pops them at close (no-op with conf.history_dir unset)
     history.begin_query(qid)
+    # write-ahead journal (runtime/journal.py): the admission record
+    # opens this query's crash-recovery log (no-op with journal_dir
+    # unset); the terminal record in the finally below settles it
+    jnl = journal.journal_for(qid)
+    if jnl is not None:
+        jnl.admitted(tenant_id=tenant)
     if conf.progress_enabled:
         from blaze_tpu.runtime import progress
 
@@ -137,6 +146,14 @@ def run_plan(root: SparkPlan, num_partitions: int = 4,
         # roll-up so the record carries the byte/spill/compile counters)
         if conf.history_dir:
             history.record_run(qid, run_info)
+        if jnl is not None:
+            # terminal journal record (classified from the in-flight
+            # exception, the flight-recorder posture below): a journal
+            # with a complete line never enters a recovery replay
+            exc = sys.exc_info()[1]
+            jnl.complete("failed" if exc is not None else "ok",
+                         error=type(exc).__name__ if exc is not None
+                         else "")
         if conf.flight_dir:
             # black-box dossier on failure / deadline / hang / leak —
             # classifies the in-flight exception via sys.exc_info (this
@@ -161,6 +178,8 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
     run_info.setdefault("file_stages", 0)
     run_info.setdefault("broadcast_stages", 0)
     run_info.setdefault("pool_stages", 0)
+    run_info.setdefault("recovered_stages", 0)
+    run_info.setdefault("map_tasks_run", 0)
     from blaze_tpu.config import conf
 
     # task setup reclaims dead writers' leftovers (artifact temps in the
@@ -169,6 +188,10 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
     # (ledger.jsonl lines + trace_<qid>.json files — it grew without
     # limit before)
     artifacts.sweep_orphans([conf.spill_dir])
+    # driver-crash recovery (runtime/journal.py): replay incomplete
+    # journals once per process — verified stage commits land in the
+    # resume map each shuffle-map stage consults below
+    journal.ensure_recovery_scan()
     if conf.trace_export_dir:
         trace.rotate_export_dir()
     telemetry_before = faults.TELEMETRY.snapshot()
@@ -195,6 +218,20 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
         def provider(partition, nparts, _p=subtree):
             return fallback.export_iterator(_p, partition, nparts)
         resources.put(rid, provider)
+    jnl = journal.journal_for(run_info.get("query_id", ""))
+    if jnl is not None:
+        # the plan record pins what this journal is a log OF: the
+        # pre-AQE query fingerprint plus the stage skeleton (per-stage
+        # fingerprints — the resume keys — are journaled with each
+        # stage_commit, computed after AQE re-optimization)
+        jnl.plan(fingerprint=fingerprint_query(
+                     [fingerprint_plan(s.plan) for s in stages]),
+                 num_partitions=num_partitions,
+                 stages=[{"stage_id": s.stage_id, "kind": s.kind,
+                          "num_partitions": s.num_partitions,
+                          "plan_proto": base64.b64encode(
+                              s.plan.SerializeToString()).decode()}
+                         for s in stages])
     work_dir = work_dir or tempfile.mkdtemp(prefix="blaze_tpu_stages_")
     os.makedirs(work_dir, exist_ok=True)
 
@@ -250,7 +287,8 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
             # history statistics must key on. Skipped when nothing
             # records it (neither tracing nor the history store is on).
             fp = (fingerprint_plan(stage.plan)
-                  if conf.trace_enabled or conf.history_dir else None)
+                  if conf.trace_enabled or conf.history_dir
+                  or jnl is not None else None)
             if progress is not None:
                 progress.stage_begin(
                     qid, stage.stage_id, stage.kind, fingerprint=fp,
@@ -262,13 +300,28 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
                 with trace.span("stage", stage_id=stage.stage_id,
                                 stage_kind="shuffle_map", fingerprint=fp,
                                 tasks=_input_tasks(stage, stages)) as sp:
+                    if jnl is not None and fp:
+                        # a crashed driver's verified stage commit for
+                        # this fingerprint? reuse it — zero map tasks run
+                        logical = _resume_shuffle_stage(
+                            stage, stages, shuffle_mgr, fp, jnl,
+                            run_info, ns)
+                        if logical is not None:
+                            shuffle_bytes[stage.stage_id] = logical
+                            sp.set(transport="journal", bytes=logical,
+                                   **monitor.stage_span_attrs(
+                                       run_info["query_id"],
+                                       stage.stage_id))
+                            if progress is not None:
+                                progress.stage_end(qid, stage.stage_id)
+                            continue
                     prids = (_pool_stage_rids(stage)
                              if pool is not None else None)
                     if prids is not None:
                         try:
                             logical = _run_shuffle_stage_pooled(
                                 stage, stages, shuffle_mgr, pool,
-                                run_info, ns, prids)
+                                run_info, ns, prids, jnl=jnl, fp=fp)
                         except Exception as e:  # noqa: BLE001 — classified
                             cat = faults.classify(e)
                             if cat in ("fatal", "plan"):
@@ -332,7 +385,8 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
                                 progress.stage_end(qid, stage.stage_id)
                             continue
                     logical = _run_shuffle_stage(stage, stages, shuffle_mgr,
-                                                 sup, run_info, ns=ns)
+                                                 sup, run_info, ns=ns,
+                                                 jnl=jnl, fp=fp)
                     # logical (uncompressed) bytes: the mesh path reports
                     # the same unit, so the AQE threshold is
                     # transport-independent
@@ -441,7 +495,7 @@ def _schema_of_reader(node: pb.PlanNode):
 
 def _run_shuffle_stage(stage: Stage, stages: List[Stage],
                        shuffle_mgr, sup: Supervisor, run_info=None,
-                       ns: str = "") -> int:
+                       ns: str = "", jnl=None, fp=None) -> int:
     """Runs the map tasks through the shuffle manager (register ->
     per-task writer slot -> commit MapStatus -> reduce-side reader
     resource); returns the stage's total LOGICAL output bytes
@@ -485,12 +539,128 @@ def _run_shuffle_stage(stage: Stage, stages: List[Stage],
         slots.append(slot)
     ops = sup.run_tasks(("shuffle", stage.stage_id), specs)
     logical = 0
-    for op, slot in zip(ops, slots):
+    for task, (op, slot) in enumerate(zip(ops, slots)):
         written = op.metrics.values.get("shuffle_logical_bytes", 0)
         trace.record_value("shuffle_write_bytes", written)
         logical += written
+        _register_slot_repair(stage, slot, task, ntasks, run_info)
         slot.commit()
+    if run_info is not None:
+        run_info["map_tasks_run"] = (
+            run_info.get("map_tasks_run", 0) + ntasks)
+    if jnl is not None and fp:
+        jnl.stage_commit(stage.stage_id, fp, logical,
+                         _journal_outputs(slots))
+    resources.put(f"{ns}shuffle:{stage.stage_id}",
+                  lambda partition: shuffle_mgr.get_reader_host(handle,
+                                                                partition))
+    return logical
 
+
+# repair attempts are epoch-stamped off this fence so a re-executed map
+# output can never collide with its quarantined predecessor's name
+_repair_fence = artifacts.EpochFence()
+
+
+def _journal_outputs(slots) -> List[dict]:
+    """stage_commit payload: each map output's committed paths, epoch
+    and whole-file digest (the recovery scan's cross-check)."""
+    outs = []
+    for slot in slots:
+        crc = None
+        try:
+            _raw, meta = artifacts.read_index(slot.index_path)
+            if meta is not None:
+                crc = meta["data_crc"]
+        except (OSError, faults.CorruptArtifactError):
+            pass
+        outs.append({"map_id": slot.map_id,
+                     "data_path": slot.data_path,
+                     "index_path": slot.index_path,
+                     "epoch": artifacts.epoch_of(slot.data_path),
+                     "data_crc": crc})
+    return outs
+
+
+def _register_stage_repairs(stage: Stage, slots, ntasks: int,
+                            run_info=None) -> None:
+    for task, slot in enumerate(slots):
+        _register_slot_repair(stage, slot, task, ntasks, run_info)
+
+
+def _register_slot_repair(stage: Stage, slot, task: int, ntasks: int,
+                          run_info=None) -> None:
+    """Arm lineage repair for one committed map output: on read-path
+    corruption (artifacts.handle_corruption) ONLY the producing map task
+    re-runs — in-process, under a fresh repair epoch so the new pair
+    never collides with the quarantined names — recommits, and replaces
+    its MapStatus (shuffle_manager replace-by-map_id). Armed BEFORE the
+    slot's own commit: the MapStatus parse is itself a verifying read.
+    unregister_shuffle forgets the registration with the files."""
+    node = pb.PlanNode()
+    node.CopyFrom(stage.plan)
+
+    def repair(task=task, slot=slot, node=node):
+        epoch = _repair_fence.advance(slot.data_path)
+        new_data = artifacts.stamp_epoch(slot.data_path, epoch)
+        new_index = artifacts.stamp_epoch(slot.index_path, epoch)
+        node.shuffle_writer.data_file = new_data
+        node.shuffle_writer.index_file = new_index
+        op = decode_plan(node)
+        list(execute_plan(op, ExecContext(partition=task,
+                                          num_partitions=ntasks)))
+        slot.data_path, slot.index_path = new_data, new_index
+        slot.commit()
+        if run_info is not None:
+            run_info["map_tasks_run"] = (
+                run_info.get("map_tasks_run", 0) + 1)
+        # the repaired pair is itself repairable; the registration
+        # under the OLD name stays to serve its redirect
+        artifacts.register_repair(new_data, repair)
+        return new_data, new_index
+
+    artifacts.register_repair(slot.data_path, repair)
+
+
+def _resume_shuffle_stage(stage: Stage, stages: List[Stage], shuffle_mgr,
+                          fp: str, jnl, run_info,
+                          ns: str = "") -> Optional[int]:
+    """Reuse a crashed driver's committed stage: when the recovery scan
+    harvested a VERIFIED stage_commit for this stage's fingerprint, the
+    journaled pairs become this run's map outputs and no map task
+    re-runs (the `map_tasks_run` counter proves it). Returns the stage's
+    logical bytes, or None to execute normally."""
+    rec = journal.take_resume(fp)
+    if rec is None:
+        return None
+    ntasks = _input_tasks(stage, stages)
+    outputs = sorted(rec.get("outputs") or [],
+                     key=lambda o: int(o.get("map_id", 0)))
+    if len(outputs) != ntasks:
+        return None  # partitioning changed since the crash: recompute
+    reader_schema = decode_plan(stage.plan.shuffle_writer.input).schema
+    handle = shuffle_mgr.register_shuffle(
+        stage.stage_id, stage.num_partitions, reader_schema)
+    slots = []
+    try:
+        for task, out in enumerate(outputs):
+            slot = shuffle_mgr.get_writer(handle, task)
+            slot.data_path = str(out["data_path"])
+            slot.index_path = str(out["index_path"])
+            _register_slot_repair(stage, slot, task, ntasks, run_info)
+            slot.commit()
+            slots.append(slot)
+    except (OSError, ValueError, KeyError, faults.CorruptArtifactError):
+        # artifacts vanished between scan and resume: run the stage
+        shuffle_mgr.unregister_shuffle(stage.stage_id, delete_files=False)
+        return None
+    logical = int(rec.get("logical_bytes", 0))
+    trace.event("journal_replay", stage_id=stage.stage_id,
+                fingerprint=fp, tasks=ntasks)
+    run_info["recovered_stages"] = run_info.get("recovered_stages", 0) + 1
+    journal.note_query_recovered(run_info.get("query_id", ""))
+    # re-journal under THIS query's id: a second crash resumes the same
+    jnl.stage_commit(stage.stage_id, fp, logical, outputs)
     resources.put(f"{ns}shuffle:{stage.stage_id}",
                   lambda partition: shuffle_mgr.get_reader_host(handle,
                                                                 partition))
@@ -540,7 +710,7 @@ def _is_repeated_field(fd) -> bool:
 
 def _run_shuffle_stage_pooled(stage: Stage, stages: List[Stage],
                               shuffle_mgr, pool, run_info, ns: str,
-                              rids: List[str]) -> int:
+                              rids: List[str], jnl=None, fp=None) -> int:
     """The map stage on the PROCESS pool: each task's plan proto ships to
     an executor over the control socket; the worker epoch-stamps the
     writer paths, reads upstream input from the driver's shuffle server,
@@ -576,7 +746,7 @@ def _run_shuffle_stage_pooled(stage: Stage, stages: List[Stage],
         slots.append(slot)
     results = pool.run_tasks(specs)
     logical = 0
-    for res, slot in zip(results, slots):
+    for task, (res, slot) in enumerate(zip(results, slots)):
         base_data, base_index = slot.data_path, slot.index_path
         # the accepted attempt's epoch-stamped pair becomes the slot's
         # committed artifact; every fenced twin is swept
@@ -585,9 +755,18 @@ def _run_shuffle_stage_pooled(stage: Stage, stages: List[Stage],
         written = int(res.get("logical_bytes", 0))
         trace.record_value("shuffle_write_bytes", written)
         logical += written
+        # repairs re-run in-process even for pool-committed outputs: the
+        # reader resources the map subtree needs are in BOTH registries
+        _register_slot_repair(stage, slot, task, ntasks, run_info)
         slot.commit()
         artifacts.sweep_stale_epochs(
             base_data, base_index, artifacts.epoch_of(res["data_path"]))
+    if run_info is not None:
+        run_info["map_tasks_run"] = (
+            run_info.get("map_tasks_run", 0) + ntasks)
+    if jnl is not None and fp:
+        jnl.stage_commit(stage.stage_id, fp, logical,
+                         _journal_outputs(slots))
     resources.put(f"{ns}shuffle:{stage.stage_id}",
                   lambda partition: shuffle_mgr.get_reader_host(handle,
                                                                 partition))

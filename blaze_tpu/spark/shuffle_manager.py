@@ -67,8 +67,20 @@ class ShuffleWriteSlot:
 
     def commit(self) -> MapStatus:
         """Parse the committed .index into partition lengths and register
-        the MapStatus (ref: BlazeShuffleWriterBase.scala:84-109)."""
-        offsets = np.frombuffer(open(self.index_path, "rb").read(), "<u8")
+        the MapStatus (ref: BlazeShuffleWriterBase.scala:84-109).
+        artifacts.read_index strips (and verifies) the checksum footer
+        before the offsets are interpreted; a corrupt index is
+        quarantined and repaired through the registered lineage closure
+        before the commit proceeds on the repaired pair."""
+        from blaze_tpu.runtime import artifacts, faults
+
+        try:
+            raw, _meta = artifacts.read_index(self.index_path)
+        except faults.CorruptArtifactError as e:
+            self.data_path, self.index_path = artifacts.handle_corruption(
+                self.data_path, self.index_path, str(e))
+            raw, _meta = artifacts.read_index(self.index_path)
+        offsets = np.frombuffer(raw, "<u8")
         expected = self.handle.num_partitions + 1
         if len(offsets) != expected:
             raise ValueError(
@@ -109,10 +121,14 @@ class BlazeShuffleManager:
 
     def unregister_shuffle(self, shuffle_id: int,
                            delete_files: bool = True) -> None:
+        from blaze_tpu.runtime import artifacts
+
         self._handles.pop(shuffle_id, None)
         outputs = self._map_outputs.pop(shuffle_id, [])
-        if delete_files:
-            for st in outputs:
+        for st in outputs:
+            # lineage-repair registration dies with the output it covers
+            artifacts.forget_repair(st.data_path)
+            if delete_files:
                 for p in (st.data_path, st.index_path):
                     try:
                         os.remove(p)
@@ -127,7 +143,15 @@ class BlazeShuffleManager:
 
     def _register_map_output(self, shuffle_id: int,
                              status: MapStatus) -> None:
-        self._map_outputs[shuffle_id].append(status)
+        # replace-by-map_id, not append: a lineage repair (or a journal
+        # resume) re-commits an existing map output — duplicating the
+        # MapStatus would double-read that map's rows
+        outputs = self._map_outputs[shuffle_id]
+        for i, st in enumerate(outputs):
+            if st.map_id == status.map_id:
+                outputs[i] = status
+                return
+        outputs.append(status)
 
     # -- reduce side ----------------------------------------------------
 

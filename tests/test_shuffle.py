@@ -21,7 +21,7 @@ from blaze_tpu.ops.shuffle import (
     IpcReaderExec, IpcWriterExec, Partitioning, RssPartitionWriterBase,
     RssShuffleWriterExec, ShuffleWriterExec, read_shuffle_partition,
 )
-from blaze_tpu.runtime import resources
+from blaze_tpu.runtime import artifacts, resources
 from blaze_tpu.runtime.executor import collect, execute_plan
 
 SCHEMA = T.Schema([
@@ -89,8 +89,10 @@ def test_shuffle_write_read(rng, tmp_path):
                           str(tmp_path / "s.data"), str(tmp_path / "s.index"))
     assert list(execute_plan(w)) == []
 
-    # index = u64 offsets, monotone, last == file size
-    offs = np.frombuffer((tmp_path / "s.index").read_bytes(), "<u8")
+    # index = u64 offsets (plus integrity footer, stripped by read_index),
+    # monotone, last == file size
+    raw_offsets, _meta = artifacts.read_index(str(tmp_path / "s.index"))
+    offs = np.frombuffer(raw_offsets, "<u8")
     assert len(offs) == P + 1 and offs[0] == 0
     assert offs[-1] == os.path.getsize(tmp_path / "s.data")
     assert all(offs[i] <= offs[i + 1] for i in range(P))

@@ -31,6 +31,23 @@ never record a breaker reroute caused by a faulted neighbor. An
 admission-stress round (1 slot, tiny queue) must shed with typed
 rejections while every admitted query still answers correctly.
 
+`--durability` (ISSUE 13): the artifact-integrity sweep — every
+CORRUPT_POINTS cell arms a deterministic post-publish bit flip in a
+committed artifact (shuffle .data frame body, .index offsets, spill
+frame) and demands the checksum layer DETECT it (corruptions +1),
+QUARANTINE the flipped file (.quarantine rename), lineage-REPAIR
+shuffle outputs by re-running only the producing map task under a new
+epoch, and still match the pandas oracle. Spill cells run under a tiny
+memory budget so the sort actually spills; their recovery is the task
+retry ladder (no lineage repair), so `repaired` stays 0 there by
+design. `--driver` adds the driver-crash round: a subprocess driver
+journals its stage commits, is SIGKILLed while holding mid-query (all
+map stages committed, result stage not), and a restarted driver must
+replay the journal — verified committed stages reused (map_tasks_run
+== 0), the crashed attempt billed failed with a `driver_restart`
+flight dossier — and still answer oracle-equal. Both emit
+`DURABILITY_r17.json`.
+
 Each cell installs one deterministic fault spec (fail the first N calls
 of one KNOWN_POINTS prefix), runs a full driver-path query, and diffs
 the answer against the pandas oracle. A cell is
@@ -517,6 +534,189 @@ def _executor_soak(tables, args):
     return rounds
 
 
+def _corruption_sweep(tables, args):
+    """--durability corruption cells: CORRUPT_POINTS x catalogue queries.
+
+    Every armed cell must fire (a committed artifact really was
+    bit-flipped), be detected by the checksum layer, quarantine the
+    corrupt file, and still answer oracle-equal. Shuffle cells must
+    additionally lineage-repair (re-run just the producing map task);
+    spill cells recover through the task retry ladder instead, so
+    `repaired` is not demanded there. Spill cells pin a tiny memory
+    budget so the q3 sort actually spills — the corruption hook fires at
+    spill READ time, so a query that never spills can't exercise it."""
+    from blaze_tpu.runtime import artifacts, faults
+    from blaze_tpu.runtime import memory as M
+
+    # q1 is a single scan/filter/project stage — no exchange, no spill —
+    # so no corrupt point can fire there; arm only queries whose plans
+    # actually cross each point (q2/q3 shuffle; q3's smj sort spills
+    # under the tight budget)
+    point_queries = {
+        "corrupt.shuffle_data": QUERIES[1:],
+        "corrupt.shuffle_index": QUERIES[1:],
+        "corrupt.spill": [("q3_join_agg_sort", "smj")],
+    }
+    cells = []
+    for point in faults.CORRUPT_POINTS:
+        for query, mode in point_queries.get(point, QUERIES[1:]):
+            mgr = M.get_manager()
+            saved_total = mgr.total
+            if point == "corrupt.spill":
+                # spill corruption fires at spill READ time; shrink the
+                # live manager's budget so the sort really spills
+                mgr.total = 1 << 14
+            before = dict(artifacts.corruption_stats())
+            spec = {"seed": args.seed,
+                    "points": {point: {"kind": "corrupt", "nth": 1}}}
+            try:
+                cell = _run_cell(tables, query, mode, spec)
+            finally:
+                mgr.total = saved_total
+            after = artifacts.corruption_stats()
+            delta = {k: after[k] - before.get(k, 0) for k in after}
+            cell.update(point=point, kind="corrupt", corruption=delta)
+            cell["detected_ok"] = (
+                delta["corruptions"] >= 1 and delta["quarantined"] >= 1
+                and (point == "corrupt.spill" or delta["repaired"] >= 1))
+            cells.append(cell)
+            print(f"[cell] {point:20s} corrupt {query:22s} "
+                  f"{cell['outcome']:15s} {delta} {cell['seconds']:.1f}s",
+                  flush=True)
+    return cells
+
+
+# the --driver child: a real subprocess driver running the q3 catalogue
+# query with journaling on. BLZ_HOLD=1 parks the result stage AFTER all
+# map stages have committed and journaled (touching BLZ_READY so the
+# parent knows the window is open) — the parent SIGKILLs it there, the
+# closest deterministic stand-in for "driver crashes mid-query with
+# durable work on disk". The restarted child (BLZ_HOLD=0) must replay
+# the journal instead of recomputing.
+_DRIVER_CHILD = '''\
+import json, os, sys, time
+sys.path.insert(0, os.environ["BLZ_REPO"])
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from blaze_tpu.config import conf
+conf.journal_dir = os.environ["BLZ_JDIR"]
+conf.flight_dir = os.environ.get("BLZ_FDIR", "")
+conf.trace_enabled = False
+from blaze_tpu.spark import validator
+from blaze_tpu.spark import local_runner
+
+paths, frames = validator.generate_tables(
+    os.environ["BLZ_TDIR"], rows=int(os.environ["BLZ_ROWS"]), seed=7)
+if os.environ.get("BLZ_HOLD") == "1":
+    real = local_runner._run_result_stage
+
+    def hold(*a, **k):
+        with open(os.environ["BLZ_READY"], "w") as f:
+            f.write("ready")
+        time.sleep(600)  # the parent SIGKILLs inside this window
+        return real(*a, **k)
+
+    local_runner._run_result_stage = hold
+plan, oracle = validator.QUERIES["q3_join_agg_sort"](paths, frames, "smj")
+info = {}
+out = local_runner.run_plan(plan, num_partitions=4,
+                            work_dir=os.environ["BLZ_WDIR"],
+                            mesh_exchange="off", run_info=info)
+diff = validator._compare(
+    validator._to_pandas(out).reset_index(drop=True),
+    oracle().reset_index(drop=True))
+print("DRIVER_RESULT " + json.dumps({
+    "diff": diff,
+    "recovered_stages": info.get("recovered_stages", 0),
+    "map_tasks_run": info.get("map_tasks_run", 0)}))
+'''
+
+
+def _driver_kill_round(args):
+    """--driver round: SIGKILL a subprocess driver mid-query, restart it,
+    and demand the restarted driver (a) answers oracle-equal, (b) reuses
+    every journaled+verified stage commit (recovered_stages >= 1 and
+    ZERO map tasks re-run), (c) bills the crashed attempt failed with a
+    `driver_restart` terminal journal record and flight dossier."""
+    import glob
+    import signal
+    import subprocess
+
+    from blaze_tpu.runtime import flight_recorder, journal
+
+    root = tempfile.mkdtemp(prefix="chaos_driver_")
+    jdir = os.path.join(root, "journal")
+    fdir = os.path.join(root, "flight")
+    ready = os.path.join(root, "ready")
+    child = os.path.join(root, "driver_child.py")
+    with open(child, "w") as f:
+        f.write(_DRIVER_CHILD)
+    tdir = os.path.join(root, "tables")
+    os.makedirs(tdir, exist_ok=True)
+    env = dict(os.environ, BLZ_REPO=REPO, BLZ_JDIR=jdir, BLZ_FDIR=fdir,
+               BLZ_TDIR=tdir,
+               BLZ_WDIR=os.path.join(root, "work"),
+               BLZ_READY=ready, BLZ_ROWS=str(args.rows),
+               BLZ_HOLD="1", JAX_PLATFORMS="cpu")
+    rec = {"round": "driver_kill"}
+    t0 = time.time()
+    log1 = open(os.path.join(root, "run1.log"), "w")
+    p1 = subprocess.Popen([sys.executable, child], env=env,
+                          stdout=log1, stderr=subprocess.STDOUT)
+    deadline = time.monotonic() + 300
+    while (not os.path.exists(ready) and p1.poll() is None
+           and time.monotonic() < deadline):
+        time.sleep(0.05)
+    rec["held"] = os.path.exists(ready)
+    if p1.poll() is None:
+        p1.send_signal(signal.SIGKILL)
+    p1.wait(timeout=30)
+    log1.close()
+    rec["killed"] = p1.returncode == -signal.SIGKILL
+
+    jfiles = sorted(glob.glob(os.path.join(jdir, "journal_*.jsonl")))
+    rec["stages_committed_before_kill"] = sum(
+        1 for jf in jfiles for r in journal.load_records(jf)
+        if r.get("kind") == "stage_commit")
+
+    env2 = dict(env, BLZ_HOLD="0")
+    try:
+        p2 = subprocess.run([sys.executable, child], env=env2,
+                            capture_output=True, text=True, timeout=600)
+    except subprocess.TimeoutExpired:
+        rec["outcome"] = "classified_fail"
+        rec["error"] = "restarted driver timed out"
+        rec["seconds"] = round(time.time() - t0, 3)
+        shutil.rmtree(root, ignore_errors=True)
+        return rec
+    resume = None
+    for line in p2.stdout.splitlines():
+        if line.startswith("DRIVER_RESULT "):
+            resume = json.loads(line[len("DRIVER_RESULT "):])
+    rec["resume"] = resume
+    if resume is None:
+        rec["restart_output"] = (p2.stdout + p2.stderr)[-2000:]
+
+    rec["restart_dossiers"] = len(
+        [d for d in flight_recorder.list_dossiers(fdir)
+         if d.get("trigger") == "driver_restart"])
+    # the crashed attempt must carry a terminal billed-failed record
+    rec["billed_driver_restart"] = sum(
+        1 for jf in jfiles for r in journal.load_records(jf)
+        if r.get("kind") == "complete"
+        and r.get("error") == "driver_restart")
+    ok = (rec["held"] and rec["killed"]
+          and rec["stages_committed_before_kill"] >= 1
+          and resume is not None and resume.get("diff") is None
+          and resume.get("recovered_stages", 0) >= 1
+          and resume.get("map_tasks_run", -1) == 0
+          and rec["restart_dossiers"] == 1
+          and rec["billed_driver_restart"] == 1)
+    rec["outcome"] = "recovered" if ok else "failed"
+    rec["seconds"] = round(time.time() - t0, 3)
+    shutil.rmtree(root, ignore_errors=True)
+    return rec
+
+
 def _overhead(tables):
     """Disabled-path cost: the microbench backs the <=1%-claim at the
     per-call level; the catalogue A/B shows end-to-end parity with an
@@ -613,6 +813,17 @@ def main() -> int:
                          "smoke at 1/2/4 seats, pooled catalogue "
                          "correctness, and SIGKILL/SIGTERM/hung "
                          "kill-recovery rounds with epoch fencing")
+    ap.add_argument("--durability", action="store_true",
+                    help="artifact-integrity sweep: bit-flip committed "
+                         "shuffle/spill artifacts (CORRUPT_POINTS) and "
+                         "demand detection + quarantine + lineage repair "
+                         "with oracle-equal answers")
+    ap.add_argument("--driver", action="store_true",
+                    help="driver-crash round: SIGKILL a journaling "
+                         "subprocess driver mid-query, restart it, and "
+                         "demand journal replay (committed stages reused, "
+                         "crashed attempt billed failed) with an "
+                         "oracle-equal answer")
     ap.add_argument("--concurrent-queries", type=int, default=8,
                     help="client sessions per --service round")
     ap.add_argument("--tenants", type=int, default=3,
@@ -625,7 +836,9 @@ def main() -> int:
     ap.add_argument("--json-out", default=None)
     args = ap.parse_args()
     if args.json_out is None:
-        args.json_out = ("EXECUTORS_r16.json" if args.executors
+        args.json_out = ("DURABILITY_r17.json" if (args.durability
+                                                   or args.driver)
+                         else "EXECUTORS_r16.json" if args.executors
                          else "SERVICE_r13.json" if args.service
                          else "SUPERVISOR_r07.json" if args.supervisor
                          else "PIPELINE_SOAK_r09.json" if args.pipeline
@@ -655,6 +868,50 @@ def main() -> int:
 
     tmpdir = tempfile.mkdtemp(prefix="chaos_tables_")
     tables = validator.generate_tables(tmpdir, rows=args.rows)
+
+    if args.durability or args.driver:
+        cells = _corruption_sweep(tables, args) if args.durability else []
+        rounds = [_driver_kill_round(args)] if args.driver else []
+        shutil.rmtree(tmpdir, ignore_errors=True)
+        for k, v in saved_conf.items():
+            setattr(conf, k, v)
+        bad = []
+        for c in cells:
+            if c["outcome"] != "recovered":
+                bad.append({"cell": f"{c['point']}/{c['query']}",
+                            "outcome": c["outcome"]})
+            elif not c.get("detected_ok"):
+                bad.append({"cell": f"{c['point']}/{c['query']}",
+                            "detected_ok": False,
+                            "corruption": c.get("corruption")})
+            if (c.get("orphans") or c.get("mem_leaked")
+                    or c.get("pipeline_leaked")):
+                bad.append({"cell": f"{c['point']}/{c['query']}",
+                            "leaks": True})
+        for r in rounds:
+            if r.get("outcome") != "recovered":
+                bad.append({"round": r["round"],
+                            "outcome": r.get("outcome"), "detail": r})
+            print(f"[driver] {r['outcome']:10s} "
+                  f"committed={r.get('stages_committed_before_kill')} "
+                  f"resume={r.get('resume')} "
+                  f"dossiers={r.get('restart_dossiers')} "
+                  f"{r.get('seconds', 0):.1f}s", flush=True)
+        outcomes = {}
+        for c in cells:
+            outcomes[c["outcome"]] = outcomes.get(c["outcome"], 0) + 1
+        report = {
+            "rows": args.rows, "seed": args.seed,
+            "outcomes": outcomes, "ok": not bad, "bad": bad,
+            "cells": cells, "rounds": rounds,
+        }
+        with open(args.json_out, "w") as f:
+            json.dump(report, f, indent=1)
+        print(f"\ndurability soak {'OK' if report['ok'] else 'FAILED'} "
+              f"-> {args.json_out}")
+        if bad:
+            print(f"bad: {bad}")
+        return 0 if report["ok"] else 1
 
     if args.executors:
         rounds = _executor_soak(tables, args)

@@ -51,7 +51,10 @@ def load_ledger(trace_dir):
         for line in f:
             line = line.strip()
             if line:
-                entries.append(json.loads(line))
+                try:
+                    entries.append(json.loads(line))
+                except ValueError:
+                    continue  # crash-torn line: skip, don't die
     return entries
 
 
