@@ -8,13 +8,14 @@
 PYENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
 .PHONY: check check-fast check-faults check-supervisor check-trace \
-	check-durability \
+	check-durability check-dist-obs \
 	check-pipeline check-pipeline-soak check-perf check-perf-update \
 	check-obs check-history check-lint check-service check-doctor \
 	check-flight check-executors test test-fast validate validate-fast warm
 
 check: check-lint test validate check-perf check-history check-service \
-	check-doctor check-flight check-executors check-durability
+	check-doctor check-flight check-executors check-durability \
+	check-dist-obs
 	@echo "CHECK OK — safe to commit"
 
 # Static invariant gate (tools/blazelint): lock discipline, knob
@@ -176,6 +177,18 @@ check-executors:
 check-durability:
 	$(PYENV) python tools/chaos_soak.py --durability --driver \
 	  --json-out DURABILITY_r17.json
+
+# Distributed-telemetry gate (ISSUE 14): a pooled chaos round (SIGKILL
+# mid-stage) with the telemetry plane ON must answer oracle-equal AND
+# yield ONE merged Chrome trace — driver + executor spans sharing
+# query/task ids on per-executor pid rows, clock-aligned timestamps —
+# with zero executors reporting dropped span rings and the run ledger
+# carrying the workers' federated copy bytes; a telemetry on/off A/B
+# over the pooled catalogue gates the plane's overhead below 2%.
+# Emits DIST_OBS_r18.json.
+check-dist-obs:
+	$(PYENV) python tools/chaos_soak.py --dist-obs \
+	  --json-out DIST_OBS_r18.json
 
 # Pre-warm the persistent compile caches (runtime/compile_service):
 # replays the shape manifest + the TPC-DS catalogue into the XLA cache.

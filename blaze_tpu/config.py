@@ -349,6 +349,25 @@ _DECLARATIONS: Tuple[Knob, ...] = (
     Knob("executor_restart_backoff_ms", 100,
          doc="Base backoff before replacement spawn i of a seat is "
              "~backoff * 2^i."),
+    Knob("telemetry_ship_ms", 250,
+         doc="Executor -> driver telemetry ship period: buffered span/"
+             "event records and monitor counter deltas are batched into "
+             "a 'telemetry' frame on the control socket at this cadence "
+             "(a flush also rides every task result). <= 0 disables "
+             "the timer; results still carry their flush."),
+    Knob("executor_trace_events", 4096,
+         doc="Bounded ring capacity of each executor process's local "
+             "TraceLog (worker-side spans buffer here between ships; "
+             "overflow drops the OLDEST record and counts it). The "
+             "unshipped tail is also spilled crash-atomically to a "
+             "per-worker sidecar file so a SIGKILL loses nothing the "
+             "driver can't recover."),
+    Knob("clock_skew_bound_ms", 5000,
+         doc="Bound on the per-executor clock offset estimated from the "
+             "hello handshake echo (executor monotonic clocks are "
+             "rebased onto the driver's before trace federation). An "
+             "estimate outside +-bound is clamped so one bad echo "
+             "cannot scramble merged-trace ordering."),
 
     # -- durable execution (runtime/artifacts.py, runtime/journal.py) --
     Knob("artifact_checksums", True,

@@ -31,7 +31,12 @@ loop:
                   skewed_partition, straggler_dominated, spill_bound,
                   compile_storm, admission_starved, queue_contended,
                   breaker_degraded, pipeline_underlap,
-                  regression_vs_history.
+                  executor_skew, regression_vs_history. The
+                  executor_skew rule is pooled-run only: federated task
+                  spans carry the shipping worker's exec id (stamped by
+                  trace.ingest_remote), so the doctor can attribute
+                  wall time per executor process and flag one worker
+                  dominating the pool.
 
 Everything here is a PURE function of its inputs (ledger record + span
 records [+ StatisticsFeed]): no clocks, no randomness, stable sort
@@ -366,6 +371,39 @@ def diagnose(record: dict,
                 "repartition on a higher-cardinality key or raise "
                 "num_partitions to split the hot partition",
                 evidence))
+
+    # executor_skew: one pooled worker dominates federated wall time.
+    # Only federated (executor-shipped) task spans carry "exec" — on
+    # rehydrated traces it survives inside attrs — so in-process runs
+    # (no exec ids) never trigger this rule.
+    exec_ms: Dict[str, float] = {}
+    for t in _task_spans(recs):
+        ex = t.get("exec") or (t.get("attrs") or {}).get("exec")
+        if not ex:
+            continue
+        exec_ms[str(ex)] = exec_ms.get(str(ex), 0.0) + _dur_ms(t)
+    if len(exec_ms) >= 2 and total > 0:
+        evals = sorted(exec_ms.values())
+        # median of the OTHER executors, not of all: pools are small
+        # (2-4 seats), and with 2 seats a median including the dominant
+        # worker averages it in — worst/median could never reach the
+        # ratio no matter how lopsided the pool
+        emed, eworst = _median(evals[:-1]), evals[-1]
+        etop = sorted(exec_ms, key=lambda e: (-exec_ms[e], e))[0]
+        if (eworst >= _MIN_TERM_MS and emed > 0
+                and eworst / emed >= skew_ratio
+                and eworst / total >= _MIN_STAGE_SHARE):
+            findings.append(Finding(
+                "executor_skew",
+                min(0.8 * (eworst - emed) / total, 1.0),
+                f"executor {etop} dominated pooled wall time "
+                f"({eworst:.0f}ms vs {emed:.0f}ms median across "
+                f"{len(exec_ms) - 1} other executor(s))",
+                "rebalance partitions (raise num_partitions) or raise "
+                "conf.executor_slots so the pool can spread hot tasks",
+                {"exec_id": etop, "worst_ms": _r(eworst),
+                 "median_ms": _r(emed), "ratio": _r(eworst / emed),
+                 "executors": len(exec_ms)}))
 
     # spill_bound: spill I/O claims real wall time (quota pressure)
     spill_share = _share(cp, "spill")

@@ -41,6 +41,27 @@ The robustness path, not the transport, is the point:
               capacity when the replacement process (bounded by
               conf.executor_restart_max, backed off) rejoins.
 
+  telemetry   the cross-process observability plane (ISSUE 14). Each
+              worker runs its own bounded TraceLog ring
+              (conf.executor_trace_events) and monitor counters, stamps
+              records with the driver-issued correlation ids replayed
+              from the task payload, and ships batched deltas back as
+              "telemetry" frames on the control socket — every
+              conf.telemetry_ship_ms AND immediately before each result
+              frame, so counters are federated before the driver closes
+              the stage span that reads them. Before every ship the
+              batch is spilled crash-atomically to a per-worker sidecar
+              file (<token>.telemetry); on a death the driver recovers
+              the unshipped tail from the sidecar, idempotently (batch
+              seq watermark), marking the records truncated=true. A
+              clock-offset estimate from the hello echo (bounded by
+              conf.clock_skew_bound_ms, refined by the min observed
+              transit) rebases worker monotonic timestamps onto the
+              driver's, so one merged Chrome trace renders a pid row
+              per executor. Frames from a declared-dead (zombie) handle
+              are dropped — the sidecar already covered them; accepting
+              both would double-count.
+
 Worker processes are spawned as `python -m
 blaze_tpu.runtime.executor_pool --worker` with their identity and socket
 paths in the environment; the driver-side conf snapshot rides along so
@@ -70,12 +91,14 @@ _ENV_SHUFFLE = "BLAZE_EXEC_SHUFFLE_SOCK"
 _ENV_CONF = "BLAZE_TPU_WORKER_CONF"
 
 # knobs a worker must NOT inherit verbatim: a worker never spawns its own
-# pool, never serves metrics, and never exports traces/dossiers/history
-# (the driver owns observability; worker task stats ride the result msg)
+# pool, never serves metrics, and never EXPORTS traces/dossiers/history
+# (the driver owns exporting; worker-side trace records buffer in the
+# local ring and ship back over the control socket — _spawn additionally
+# sets trace_enabled/trace_buffer_events dynamically from the driver's
+# tracing state)
 _WORKER_CONF_OVERRIDES = {
     "executor_count": 0,
     "metrics_port": 0,
-    "trace_enabled": False,
     "trace_export_dir": "",
     "history_dir": "",
     "flight_dir": "",
@@ -85,6 +108,14 @@ _WORKER_CONF_OVERRIDES = {
     "journal_dir": "",
     "recovery_enabled": False,
 }
+
+
+def _clamp_offset(offset_ns: int) -> int:
+    """Bound a clock-offset estimate to ±conf.clock_skew_bound_ms: one
+    bad echo (a worker descheduled mid-handshake) must not scramble
+    merged-trace ordering by seconds."""
+    bound = max(int(conf.clock_skew_bound_ms), 0) * 1_000_000
+    return max(-bound, min(bound, int(offset_ns)))
 
 
 class PoolTaskSpec:
@@ -145,6 +176,16 @@ class ExecutorHandle:
         self.closing = False
         self.joined_at = time.monotonic()
         self.last_beat = self.joined_at
+        # telemetry federation state (guarded by pool lock):
+        # clock_offset_ns rebases this worker's monotonic timestamps
+        # onto the driver's; tel_seq is the highest batch ingested (the
+        # sidecar-recovery dedup watermark)
+        self.clock_offset_ns = 0
+        self.tel_seq = 0
+        self.tel_bytes = 0
+        self.tel_records = 0
+        self.tel_dropped = 0
+        self.tasks_done = 0
 
     @property
     def exec_id(self) -> str:
@@ -205,6 +246,8 @@ class ExecutorPool:
         self.deaths_total = 0
         self.restarts_total = 0
         self.tasks_done = 0
+        self.telemetry_bytes_total = 0
+        self.telemetry_records_total = 0
 
     # -- lifecycle -----------------------------------------------------
 
@@ -244,6 +287,11 @@ class ExecutorPool:
         env[_ENV_SHUFFLE] = self.server.sock_path
         snapshot = {name: getattr(conf, name) for name in KNOBS}
         snapshot.update(_WORKER_CONF_OVERRIDES)
+        # the worker traces exactly when the driver does — into its own
+        # SMALL bounded ring (the driver-sized ring would let a chatty
+        # worker hold megabytes of unshipped records)
+        snapshot["trace_enabled"] = bool(conf.trace_enabled)
+        snapshot["trace_buffer_events"] = int(conf.executor_trace_events)
         env[_ENV_CONF] = json.dumps(snapshot)
         # the worker resolves blaze_tpu by module name regardless of the
         # driver's cwd (pytest may chdir into a tmp dir)
@@ -291,6 +339,15 @@ class ExecutorPool:
         seat, generation, proc = pending
         handle = ExecutorHandle(seat, generation, token,
                                 int(msg.get("pid", proc.pid)), proc, conn)
+        # clock-offset estimate from the hello echo: the worker stamps
+        # its monotonic clock into the hello; (driver_now - worker_then)
+        # = true offset + one-way transit, so the estimate is inflated
+        # by transit and refined downward by later frames (_on_telemetry
+        # keeps the minimum candidate — least transit, closest to truth)
+        mono = msg.get("mono_ns")
+        if mono is not None:
+            handle.clock_offset_ns = _clamp_offset(
+                time.monotonic_ns() - int(mono))
         with self._cv:
             if self._closed:
                 handle.closing = True
@@ -323,8 +380,11 @@ class ExecutorPool:
                 break
             handle.last_beat = time.monotonic()
             self.watchdog.beat(handle.token)
-            if msg.get("type") == "result":
+            mtype = msg.get("type")
+            if mtype == "result":
                 self._on_result(handle, msg)
+            elif mtype == "telemetry":
+                self._on_telemetry(handle, msg)
         if not handle.closing:
             # EOF before shutdown: the process died (or is dying) — don't
             # wait the heartbeat staleness out
@@ -351,9 +411,69 @@ class ExecutorPool:
             if msg.get("ok"):
                 task.state, task.result = "done", msg
                 self.tasks_done += 1
+                handle.tasks_done += 1
             else:
                 self._handle_task_failure_locked(task, msg)
             self._cv.notify_all()
+
+    # -- telemetry federation ------------------------------------------
+
+    def _on_telemetry(self, handle: ExecutorHandle, msg: dict) -> None:
+        """Ingest one batched telemetry frame from a live executor.
+
+        Zombie posture mirrors _on_result: frames from a declared-dead
+        handle are DROPPED — its unshipped tail was already recovered
+        from the sidecar at death, and accepting the late socket copy
+        too would double-count it. The batch seq watermark makes the
+        sidecar recovery idempotent in the other direction (a sidecar
+        whose batch already arrived over the socket is skipped)."""
+        with self._cv:
+            if handle.dead or self._closed:
+                return
+            seq = int(msg.get("seq", 0))
+            if seq <= handle.tel_seq:
+                return  # duplicate / reordered batch
+            handle.tel_seq = seq
+            # refine the clock offset: every frame carries the worker's
+            # send-time monotonic clock; the minimum candidate has the
+            # least transit inflation
+            mono = msg.get("mono_ns")
+            if mono is not None:
+                cand = _clamp_offset(time.monotonic_ns() - int(mono))
+                if cand < handle.clock_offset_ns:
+                    handle.clock_offset_ns = cand
+        self._ingest_batch(handle, msg, truncated=False)
+
+    def _ingest_batch(self, handle: ExecutorHandle, msg: dict,
+                      truncated: bool) -> None:
+        """Federate one telemetry batch (socket frame or recovered
+        sidecar) into the driver's observability plane: trace records
+        rebased + stamped into the ring, counter deltas merged into the
+        per-query roll-ups, histogram deltas folded in."""
+        from blaze_tpu.runtime import monitor, trace
+
+        records = msg.get("records") or []
+        n = trace.ingest_remote(records, exec_id=handle.exec_id,
+                                pid=handle.pid,
+                                offset_ns=handle.clock_offset_ns,
+                                truncated=truncated)
+        monitor.merge_remote(msg.get("counters") or {})
+        trace.ingest_histograms(msg.get("histograms") or {})
+        nbytes = int(msg.get("nbytes") or 0)
+        with self._lock:
+            handle.tel_records += len(records)
+            handle.tel_bytes += nbytes
+            handle.tel_dropped = int(msg.get("dropped") or 0)
+            self.telemetry_records_total += len(records)
+            self.telemetry_bytes_total += nbytes
+        if truncated:
+            trace.event("telemetry_recovered", exec_id=handle.exec_id,
+                        records=n, seq=int(msg.get("seq", 0)),
+                        nbytes=nbytes)
+        else:
+            trace.event("telemetry_shipped", exec_id=handle.exec_id,
+                        records=n, seq=int(msg.get("seq", 0)),
+                        nbytes=nbytes)
 
     def _handle_task_failure_locked(self, task: _PoolTask,
                                     msg: dict) -> None:
@@ -434,8 +554,9 @@ class ExecutorPool:
             if recovery.get(task.spec.key) == "re-queued":
                 trace.event("executor_task_requeued", task=task.spec.key,
                             cause="executor_death", epoch=task.epoch)
+        recovered = self._recover_sidecar(handle)
         self._capture_death_dossier(handle, reason, rc, displaced,
-                                    recovery, now)
+                                    recovery, now, recovered)
         self._notify_membership()
         if will_respawn:
             threading.Thread(
@@ -446,9 +567,35 @@ class ExecutorPool:
             trace.event("degrade", what="executor_retired",
                         exec_id=handle.exec_id, restarts=restarts)
 
+    def _recover_sidecar(self, handle: ExecutorHandle) -> List[dict]:
+        """Crash recovery for the telemetry plane: a SIGKILL'd worker's
+        unshipped ring tail survives in its crash-atomic sidecar spill
+        (written tmp+rename BEFORE every ship). Ingest it exactly once —
+        the batch seq watermark skips a sidecar whose batch DID arrive
+        over the socket before death — marking every recovered record
+        truncated=true (the span stream ended mid-flight). Returns the
+        recovered records for the death dossier."""
+        path = os.path.join(self._dir, f"{handle.token}.telemetry")
+        try:
+            nbytes = os.path.getsize(path)
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return []
+        if not isinstance(doc, dict):
+            return []
+        if int(doc.get("seq", 0)) <= handle.tel_seq:
+            return []  # tail already shipped over the socket
+        handle.tel_seq = int(doc.get("seq", 0))
+        doc.setdefault("nbytes", nbytes)
+        self._ingest_batch(handle, doc, truncated=True)
+        return list(doc.get("records") or [])
+
     def _capture_death_dossier(self, handle: ExecutorHandle, reason: str,
                                rc: Optional[int], displaced, recovery,
-                               now: float) -> None:
+                               now: float,
+                               recovered: Optional[List[dict]] = None
+                               ) -> None:
         if not conf.flight_dir:
             return
         from blaze_tpu.runtime import flight_recorder
@@ -471,6 +618,13 @@ class ExecutorPool:
                 "recovery": recovery,
                 "live_executors": self.live_count(),
                 "capacity": self.capacity(),
+                # the dead worker's own last spans as spilled (raw
+                # worker-clock ts; clock_offset_ms above rebases them;
+                # the driver ring holds the rebased truncated copies) —
+                # bounded: a dossier is a summary, not a trace export
+                "clock_offset_ms": round(
+                    handle.clock_offset_ns / 1e6, 3),
+                "executor_trace": list(recovered or [])[-200:],
             })
 
 
@@ -511,10 +665,18 @@ class ExecutorPool:
         return self.live_count() * self.slots
 
     def executors(self) -> List[dict]:
+        now = time.monotonic()
         with self._lock:
             return [{"exec_id": h.exec_id, "pid": h.pid,
                      "generation": h.generation, "up": not h.dead,
-                     "inflight": len(h.inflight)}
+                     "inflight": len(h.inflight),
+                     "heartbeat_age_ms": round(
+                         (now - h.last_beat) * 1000),
+                     "tasks_done": h.tasks_done,
+                     "telemetry_bytes": h.tel_bytes,
+                     "telemetry_records": h.tel_records,
+                     "telemetry_dropped": h.tel_dropped,
+                     "clock_offset_ms": round(h.clock_offset_ns / 1e6, 3)}
                     for h in self._seats.values()]
 
     def stats(self) -> dict:
@@ -523,12 +685,16 @@ class ExecutorPool:
             inflight = sum(len(h.inflight) for h in self._seats.values())
             deaths, restarts = self.deaths_total, self.restarts_total
             done = self.tasks_done
+            tel_bytes = self.telemetry_bytes_total
+            tel_records = self.telemetry_records_total
         return {"count": self.count, "live": live,
                 "capacity": live * self.slots, "slots": self.slots,
                 "inflight": inflight, "deaths_total": deaths,
                 "restarts_total": restarts,
                 "fenced_total": self.fence.fenced_total,
-                "tasks_done": done}
+                "tasks_done": done,
+                "telemetry_bytes_total": tel_bytes,
+                "telemetry_records_total": tel_records}
 
     # -- dispatch ------------------------------------------------------
 
@@ -769,6 +935,46 @@ def pool_stats() -> Optional[dict]:
 # ---------------------------------------------------------------------------
 
 
+def _merge_counter_deltas(dst: Dict[str, dict],
+                          src: Dict[str, dict]) -> None:
+    """Fold freshly-drained monitor deltas into the worker's pending
+    (unshipped) counters — a ship failure keeps pending populated, so
+    successive drains must accumulate, not replace."""
+    for qid, d in src.items():
+        qd = dst.setdefault(qid, {})
+        for sect, vals in d.items():
+            s = qd.setdefault(sect, {})
+            if sect == "stage_time_ns":
+                for sk, cats in vals.items():
+                    sc = s.setdefault(sk, {})
+                    for cat, n in cats.items():
+                        sc[cat] = sc.get(cat, 0) + n
+            else:
+                for k, n in vals.items():
+                    s[k] = s.get(k, 0) + n
+
+
+def _merge_hist_snaps(dst: Dict[str, dict], src: Dict[str, dict]) -> None:
+    """Fold histogram snapshot deltas (bucket-count sums) into pending."""
+    for name, s in src.items():
+        cur = dst.get(name)
+        if cur is None:
+            dst[name] = dict(s)
+            continue
+        counts = list(cur.get("counts") or ())
+        for i, n in enumerate(s.get("counts") or ()):
+            if i < len(counts):
+                counts[i] += n
+            else:
+                counts.append(n)
+        cur["counts"] = counts
+        cur["count"] = int(cur.get("count") or 0) + int(s.get("count") or 0)
+        cur["total"] = int(cur.get("total") or 0) + int(s.get("total") or 0)
+        for key, pick in (("min", min), ("max", max)):
+            a, b = cur.get(key), s.get(key)
+            cur[key] = b if a is None else (a if b is None else pick(a, b))
+
+
 class _Worker:
     """Executor-process main object: control-socket loop + beat thread.
     Task handlers run on their own threads (the driver bounds concurrency
@@ -789,6 +995,17 @@ class _Worker:
         self._client_lock = threading.Lock()
         self._rid_refs: Dict[str, int] = {}
         self._rid_lock = threading.Lock()
+        # telemetry shipping state: pending holds drained-but-unshipped
+        # records/counters (a failed send keeps them; the sidecar spill
+        # already covers them on disk), seq is the batch watermark the
+        # driver dedups sidecar recovery against
+        self._tel_lock = threading.Lock()
+        self._tel_seq = 0
+        self._tel_pending: List[dict] = []
+        self._tel_counters: Dict[str, dict] = {}
+        self._tel_hists: Dict[str, dict] = {}
+        self._sidecar = os.path.join(os.path.dirname(self.ctl_path),
+                                     f"{self.token}.telemetry")
 
     # -- plumbing ------------------------------------------------------
 
@@ -812,6 +1029,70 @@ class _Worker:
                 # driver gone: a leaderless executor must not linger
                 self.stop.set()
                 os._exit(0)
+
+    # -- telemetry shipping --------------------------------------------
+
+    def _flush_telemetry(self) -> None:
+        """Stage the unshipped ring tail + counter/histogram deltas,
+        spill them crash-atomically to the sidecar, then ship ONE
+        batched "telemetry" frame. Ordering matters twice: the spill
+        lands BEFORE the send (a SIGKILL between the two loses nothing
+        the driver can't recover), and _run_task flushes BEFORE each
+        result send on the same socket (frames are processed in order,
+        so the driver merges this batch's counters before the stage
+        span that reads them closes). A failed send keeps the batch
+        pending — same seq, retried next tick — so the driver's seq
+        watermark stays exactly-once."""
+        from blaze_tpu.runtime import monitor, trace
+
+        if not (conf.trace_enabled or conf.monitor_enabled):
+            return
+        with self._tel_lock:
+            self._tel_pending.extend(trace.TRACE.drain())
+            _merge_counter_deltas(self._tel_counters,
+                                  monitor.drain_remote_deltas())
+            _merge_hist_snaps(self._tel_hists,
+                              trace.histograms_snapshot(reset=True))
+            if not (self._tel_pending or self._tel_counters
+                    or self._tel_hists):
+                return
+            seq = self._tel_seq + 1
+            doc = {"type": "telemetry", "seq": seq,
+                   "records": self._tel_pending,
+                   "counters": self._tel_counters,
+                   "histograms": self._tel_hists,
+                   "dropped": trace.TRACE.dropped,
+                   "mono_ns": time.monotonic_ns()}
+            payload = json.dumps(doc, default=str)
+            doc["nbytes"] = len(payload)
+            tmp = self._sidecar + ".tmp"
+            try:
+                with open(tmp, "w") as f:
+                    f.write(payload)
+                os.replace(tmp, self._sidecar)
+            except OSError:
+                pass  # spill is best-effort; the socket ship still runs
+            try:
+                self._send(doc)
+            except (ConnectionError, OSError):
+                return  # keep pending; beat loop notices a dead driver
+            self._tel_seq = seq
+            self._tel_pending = []
+            self._tel_counters = {}
+            self._tel_hists = {}
+
+    def _ship_loop(self) -> None:
+        period_ms = int(conf.telemetry_ship_ms)
+        if period_ms <= 0:
+            return  # timer disabled; results still carry their flush
+        period = max(period_ms, 10) / 1000.0
+        while not self.stop.wait(period):
+            if time.monotonic() < self.hang_until:
+                continue  # hung: the telemetry plane stalls with beats
+            try:
+                self._flush_telemetry()
+            except Exception:  # noqa: BLE001 — never kill the worker
+                pass
 
     def shuffle_client(self) -> ss.ShuffleClient:
         with self._client_lock:
@@ -910,26 +1191,44 @@ class _Worker:
         return {"attempts_failed": n}
 
     def _run_task(self, msg: dict, blob: bytes) -> None:
+        from blaze_tpu.runtime import monitor, trace
+
         key, epoch = msg.get("task", ""), int(msg.get("epoch", 0))
         kind = msg.get("kind", "")
         payload = msg.get("payload") or {}
+        # replay the driver-issued correlation ids: every worker-side
+        # record (the task_attempt span, nested events, counter
+        # attribution) then carries the same query/stage/task ids the
+        # driver's records do — the federation join key
+        ids = {k: payload.get(k) for k in trace.ID_KEYS
+               if payload.get(k) is not None}
+        if ids.get("query_id"):
+            monitor.ensure_query(ids["query_id"])
         try:
-            if kind == "plan":
-                result = self._run_plan(payload, blob, epoch)
-            elif kind == "echo":
-                result = {"value": payload.get("value")}
-            elif kind == "sleep":
-                end = time.monotonic() + float(payload.get("ms", 0)) / 1e3
-                while time.monotonic() < end and not self.stop.is_set():
-                    time.sleep(0.01)
-                result = {}
-            elif kind == "flaky":
-                result = self._run_flaky(payload)
-            else:
-                raise ValueError(f"unknown task kind: {kind}")
+            with trace.context(**ids):
+                with trace.span("task_attempt",
+                                attempt_id=f"{key}#e{epoch}",
+                                pool_kind=kind,
+                                what=payload.get("what", key)):
+                    if kind == "plan":
+                        result = self._run_plan(payload, blob, epoch)
+                    elif kind == "echo":
+                        result = {"value": payload.get("value")}
+                    elif kind == "sleep":
+                        end = (time.monotonic()
+                               + float(payload.get("ms", 0)) / 1e3)
+                        while (time.monotonic() < end
+                               and not self.stop.is_set()):
+                            time.sleep(0.01)
+                        result = {}
+                    elif kind == "flaky":
+                        result = self._run_flaky(payload)
+                    else:
+                        raise ValueError(f"unknown task kind: {kind}")
         except BaseException as e:  # noqa: BLE001 — classified + relayed
             from blaze_tpu.runtime import faults
 
+            self._flush_telemetry()
             try:
                 self._send({"type": "result", "task": key, "epoch": epoch,
                             "ok": False, "category": faults.classify(e),
@@ -938,6 +1237,10 @@ class _Worker:
             except (ConnectionError, OSError):
                 pass
             return
+        # flush BEFORE the result: same socket, in-order processing, so
+        # the driver has this task's spans/counters federated before the
+        # stage span that reads them closes
+        self._flush_telemetry()
         reply = {"type": "result", "task": key, "epoch": epoch,
                  "ok": True}
         reply.update(result)
@@ -953,10 +1256,17 @@ class _Worker:
         sock.connect(self.ctl_path)
         self.sock = sock
         ss.send_msg(sock, {"type": "hello", "token": self.token,
-                           "pid": os.getpid()}, lock=self.send_lock)
+                           "pid": os.getpid(),
+                           # clock echo: the driver estimates this
+                           # worker's monotonic offset from it
+                           "mono_ns": time.monotonic_ns()},
+                    lock=self.send_lock)
         beat = threading.Thread(target=self._beat_loop, name="blz-wk-beat",
                                 daemon=True)
         beat.start()
+        ship = threading.Thread(target=self._ship_loop, name="blz-wk-ship",
+                                daemon=True)
+        ship.start()
         try:
             while not self.stop.is_set():
                 try:
@@ -977,6 +1287,12 @@ class _Worker:
                 elif mtype == "shutdown":
                     break
         finally:
+            try:
+                # last chance to ship buffered telemetry on a clean
+                # shutdown (send errors are swallowed inside)
+                self._flush_telemetry()
+            except Exception:  # noqa: BLE001 — teardown must proceed
+                pass
             self.stop.set()
             with self._client_lock:
                 client, self._client = self._client, None

@@ -22,7 +22,15 @@ one file answers "what happened to query X at 3am".
              findings, the resolved knob overlay, per-stage
              StatisticsFeed expectations (and which stages violated
              them), all thread stacks (sys._current_frames) for
-             hang/deadline triggers, and the run-ledger line.
+             hang/deadline triggers, an executor-pool snapshot
+             (pool_stats) when a pool is live, and the run-ledger
+             line. Pooled queries need no special casing: the
+             trace-ring slice already contains the federated
+             executor-side spans (trace.ingest_remote appends them to
+             the driver ring), and executor_death dossiers embed the
+             worker's recovered sidecar ring slice under
+             detail["executor_trace"] (stamped by executor_pool's
+             death path).
 
   retention  the newest conf.flight_retention dossiers are kept; older
              ones are pruned after each capture.
@@ -282,6 +290,12 @@ def _capture_locked_out(trigger, query_id, tenant_id, error, run_info,
         "thread_stacks": stacks_doc,
         "ledger": ledger,
     }
+    try:
+        from blaze_tpu.runtime import executor_pool
+
+        doc["executor_pool"] = executor_pool.pool_stats()
+    except Exception:  # noqa: BLE001 — pool snapshot is optional context
+        doc["executor_pool"] = None
 
     os.makedirs(conf.flight_dir, exist_ok=True)
     qid_safe = "".join(ch if ch.isalnum() or ch in "-_" else "_"

@@ -219,6 +219,89 @@ def begin_query(qid: str, manager=None) -> None:
         _active_qid = qid
 
 
+def ensure_query(qid: str) -> None:
+    """Executor-side registration: create the per-query accumulator for
+    a driver-issued qid WITHOUT making it the active query or touching
+    the manager. Worker processes never call begin_query (the driver
+    owns the query lifecycle); they still need an accumulator so
+    count_copy/count_time attribute pooled work, which then drains into
+    telemetry ships (drain_remote_deltas) instead of a local
+    query_end."""
+    if not conf.monitor_enabled or not qid:
+        return
+    with _lock:
+        if qid not in _queries:
+            _queries[qid] = _QueryAcct(qid)
+
+
+def drain_remote_deltas() -> Dict[str, Dict[str, Any]]:
+    """Pop-and-return every query accumulator's counters as a JSON-safe
+    delta doc {qid: {copied, moved, time_ns, stage_copied, stage_moved,
+    stage_time_ns}} — the executor-side half of counter federation. The
+    accumulators stay registered (a task may still be appending); only
+    the counts move, so repeated drains ship disjoint deltas."""
+    out: Dict[str, Dict[str, Any]] = {}
+    with _lock:
+        for qid, q in _queries.items():
+            d: Dict[str, Any] = {}
+            for field in ("copied", "moved", "time_ns",
+                          "stage_copied", "stage_moved", "stage_time_ns"):
+                vals = getattr(q, field)
+                if vals:
+                    d[field] = vals
+                    setattr(q, field, {})
+            if d:
+                out[qid] = d
+    return out
+
+
+def _stage_key(k: Any) -> Any:
+    """Stage ids are ints driver-side but stringify over the JSON wire;
+    convert back so remote deltas merge into the same buckets."""
+    try:
+        return int(k)
+    except (TypeError, ValueError):
+        return k
+
+
+def merge_remote(deltas: Dict[str, Dict[str, Any]]) -> None:
+    """Driver-side ingest of executor counter deltas (telemetry frames
+    and sidecar recovery): fold into the process-lifetime totals AND the
+    per-query accumulators, so query_end roll-ups, stage span attrs,
+    /metrics and the perf-baseline gate see pooled work identically to
+    in-process work. Deltas for a query already rolled up (late/
+    recovered ship after query_end) still land in the process totals."""
+    if not deltas or not conf.monitor_enabled:
+        return
+    with _lock:
+        for qid, d in deltas.items():
+            copied = d.get("copied") or {}
+            moved = d.get("moved") or {}
+            for b, n in copied.items():
+                _copied[b] = _copied.get(b, 0) + int(n)
+            for b, n in moved.items():
+                _moved[b] = _moved.get(b, 0) + int(n)
+            q = _queries.get(qid)
+            if q is None:
+                continue
+            for b, n in copied.items():
+                q.copied[b] = q.copied.get(b, 0) + int(n)
+            for b, n in moved.items():
+                q.moved[b] = q.moved.get(b, 0) + int(n)
+            for cat, n in (d.get("time_ns") or {}).items():
+                q.time_ns[cat] = q.time_ns.get(cat, 0) + int(n)
+            for sk, n in (d.get("stage_copied") or {}).items():
+                k = _stage_key(sk)
+                q.stage_copied[k] = q.stage_copied.get(k, 0) + int(n)
+            for sk, n in (d.get("stage_moved") or {}).items():
+                k = _stage_key(sk)
+                q.stage_moved[k] = q.stage_moved.get(k, 0) + int(n)
+            for sk, cats in (d.get("stage_time_ns") or {}).items():
+                st = q.stage_time_ns.setdefault(_stage_key(sk), {})
+                for cat, n in cats.items():
+                    st[cat] = st.get(cat, 0) + int(n)
+
+
 def query_end(qid: str, manager=None) -> Dict[str, int]:
     """Pop `qid`'s accumulator; returns the flat-int roll-up merged into
     run_info (flat ints flow into the ledger's "counters" untouched)."""
@@ -498,6 +581,10 @@ GAUGE_NAMES = (
     "blaze_executor_live",
     "blaze_executor_restarts_total",
     "blaze_executor_deaths_total",
+    "blaze_executor_heartbeat_age_ms",
+    "blaze_executor_busy_slots",
+    "blaze_executor_tasks_done_total",
+    "blaze_executor_telemetry_bytes_total",
     "blaze_service_capacity",
     "blaze_artifact_corruptions_total",
     "blaze_recovered_queries_total",
@@ -624,10 +711,14 @@ def prometheus_text() -> str:
     emit("blaze_admission_rejected_total", "counter",
          "Queries load-shed at admission (queue full or deadline)",
          [({}, st["rejected"])])
+    # finished tenants (zero bytes held) drop out of the exposition —
+    # the {tenant=} cardinality tracks tenants with live usage, not
+    # every tenant the process ever served
     emit("blaze_tenant_mem_used_bytes", "gauge",
-         "MemManager bytes in use per tenant (consumers + pipeline)",
+         "MemManager bytes in use per tenant (consumers + pipeline; "
+         "zero-usage tenants are pruned from the exposition)",
          [({"tenant": t}, v)
-          for t, v in sorted(mgr.tenant_usage().items())])
+          for t, v in sorted(mgr.tenant_usage().items()) if v])
 
     # per-tenant SLO tracking (runtime/service.SloTracker over
     # conf.tenant_slo_spec): objective, rolling attainment, burn rate.
@@ -658,10 +749,29 @@ def prometheus_text() -> str:
     from blaze_tpu.runtime import executor_pool
 
     ps = executor_pool.pool_stats()
+    execs = (ps or {}).get("executors", ())
     emit("blaze_executor_up", "gauge",
          "Executor process liveness (1 = heartbeating, 0 = declared dead)",
-         [({"exec_id": e["exec_id"]}, 1 if e["up"] else 0)
-          for e in (ps or {}).get("executors", ())])
+         [({"exec_id": e["exec_id"]}, 1 if e["up"] else 0) for e in execs])
+    # telemetry-federation pane (blaze_top's executor rows): heartbeat
+    # freshness, occupancy, lifetime work and shipped-telemetry volume
+    emit("blaze_executor_heartbeat_age_ms", "gauge",
+         "Milliseconds since the executor's last control-socket frame",
+         [({"exec_id": e["exec_id"]}, e.get("heartbeat_age_ms", 0))
+          for e in execs])
+    emit("blaze_executor_busy_slots", "gauge",
+         "Tasks currently in flight on the executor",
+         [({"exec_id": e["exec_id"]}, e.get("inflight", 0))
+          for e in execs])
+    emit("blaze_executor_tasks_done_total", "counter",
+         "Tasks the executor completed successfully",
+         [({"exec_id": e["exec_id"]}, e.get("tasks_done", 0))
+          for e in execs])
+    emit("blaze_executor_telemetry_bytes_total", "counter",
+         "Telemetry payload bytes shipped by the executor (incl. "
+         "sidecar-recovered)",
+         [({"exec_id": e["exec_id"]}, e.get("telemetry_bytes", 0))
+          for e in execs])
     emit("blaze_executor_live", "gauge",
          "Live executor processes in the pool",
          [({}, ps["live"])] if ps else [])
@@ -693,10 +803,17 @@ def prometheus_text() -> str:
          "Queries that reused journaled stage commits after a driver "
          "restart",
          [({}, journal.recovered_queries_total())])
+    # bounded label cardinality: live queries plus the last-N finished
+    # ring (progress.finished_queries) — older finished series age out of
+    # the exposition instead of accumulating one {qid=} series per query
+    # for the life of the endpoint
     emit("blaze_query_progress_ratio", "gauge",
-         "Live per-query progress ratio (0-1, monotone per query)",
+         "Per-query progress ratio (0-1, monotone; finished queries "
+         "linger in a bounded last-N ring, then their series is pruned)",
          [({"qid": s["query_id"]}, s["progress_ratio"])
-          for s in progress.snapshot_queries()])
+          for s in progress.snapshot_queries()]
+         + [({"qid": s["query_id"]}, s["progress_ratio"])
+            for s in progress.finished_queries()])
     with _lock:
         reqs = dict(_endpoint_requests)
     emit("blaze_endpoint_requests_total", "counter",

@@ -30,6 +30,7 @@ from __future__ import annotations
 import threading
 import time
 
+from collections import deque
 from typing import Any, Dict, List, Optional
 
 from blaze_tpu.config import conf
@@ -37,6 +38,14 @@ from blaze_tpu.runtime import monitor, trace
 
 _lock = threading.Lock()
 _queries: Dict[str, "_QueryProgress"] = {}
+# bounded ring of final summary rows for COMPLETED queries: the metrics
+# exposition serves blaze_query_progress_ratio for live + last-N
+# finished queries, so the {qid=} label cardinality on a long-lived
+# endpoint is live+N instead of one series per query ever run. A module
+# constant, not a knob — the bound exists to cap cardinality, not to be
+# tuned per deployment.
+FINISHED_RING = 32
+_finished: deque = deque(maxlen=FINISHED_RING)
 
 
 class _StageProgress:
@@ -122,9 +131,16 @@ def begin_query(query_id: str, tenant_id: Optional[str] = None) -> None:
 
 def finish_query(query_id: str) -> None:
     """Drop the query from the live registry (endpoints list live
-    queries only; the flight recorder + ledger own the postmortem)."""
+    queries only; the flight recorder + ledger own the postmortem) and
+    stash its final summary row in the bounded finished ring for the
+    metrics exposition."""
+    now = time.time()
     with _lock:
-        _queries.pop(query_id, None)
+        q = _queries.pop(query_id, None)
+        if q is not None:
+            q.phase = "finished"
+            q.current_stage = None
+            _finished.append(_summary_locked(q, now))
 
 
 def stage_begin(query_id: str, stage_id, kind: str,
@@ -290,6 +306,14 @@ def snapshot_queries() -> List[Dict[str, Any]]:
         return [_summary_locked(q, now) for q in _queries.values()]
 
 
+def finished_queries() -> List[Dict[str, Any]]:
+    """Final summary rows of the last FINISHED_RING completed queries
+    (oldest-first) — the bounded tail the metrics exposition appends to
+    the live rows."""
+    with _lock:
+        return list(_finished)
+
+
 def snapshot_query(query_id: str) -> Optional[Dict[str, Any]]:
     """Per-stage waterfall + live critical-path-so-far for one live
     query (the /queries/<qid> payload); None when not live."""
@@ -353,3 +377,4 @@ def active() -> List[str]:
 def reset() -> None:
     with _lock:
         _queries.clear()
+        _finished.clear()

@@ -18,6 +18,11 @@ compressed(json header) | blob`. The header rides the same
 compressor family as shuffle frames (serde's zstd-or-zlib posture at
 conf.zstd_level); the blob is opaque bytes — for segment replies it is a
 concatenation of serde "BTB1" frames, handed to IpcReaderExec undecoded.
+The executor control socket carries one extra message family over the
+same framing: `{"type": "telemetry", "seq": N, ...}` batches ship a
+worker's span/counter/histogram deltas driver-ward (executor_pool's
+federation path). BCS1 framing is type-agnostic, so telemetry needed no
+wire change — only a new header "type" the driver-side reader dispatches.
 
 Kept import-light on purpose: executor worker processes import this
 before deciding whether a task needs the engine at all, so nothing here

@@ -139,6 +139,16 @@ class TraceLog:
         with self._lock:
             return list(self._buf)
 
+    def drain(self) -> List[Dict[str, Any]]:
+        """Pop and return every buffered record (oldest-first). The
+        executor-side telemetry shipper uses this so records buffer in
+        the bounded ring between ships and leave exactly once; the
+        `dropped` counter is cumulative and survives the drain."""
+        with self._lock:
+            out = list(self._buf)
+            self._buf.clear()
+            return out
+
     def reset(self) -> None:
         with self._lock:
             self._buf.clear()
@@ -204,6 +214,10 @@ EVENT_KINDS = (
     "spill_pages_flush",    # memory: spill page pool flushed
     "task_abandoned",       # supervisor: attempt abandoned post-kill
     "task_error",           # supervisor: classified attempt failure
+    "telemetry_recovered",  # executor_pool: dead worker's sidecar-spilled
+                            # ring tail ingested (records marked truncated)
+    "telemetry_shipped",    # executor_pool: batched executor telemetry
+                            # frame federated into the driver ring
     "tenant_over_quota",    # memory: tenant ceiling hit, self-spilling
     "whole_stage_attempt",  # stage_compiler: fused single-dispatch try
     "whole_stage_fallback", # stage_compiler: fused path bailed out
@@ -420,6 +434,64 @@ def query_records(query_id: str,
     return [r for r in recs if r.get("query_id") == query_id]
 
 
+# -- cross-process federation (executor telemetry -> driver ring) ------------
+
+
+def ingest_remote(records: Iterable[dict], *, exec_id: str,
+                  pid: Optional[int] = None, offset_ns: int = 0,
+                  truncated: bool = False) -> int:
+    """Federate executor-side trace records into the driver's ring.
+
+    Each record's monotonic `ts` is rebased by the executor's estimated
+    clock offset (handshake echo, runtime/executor_pool.py) so merged
+    exports order driver and executor spans on one timeline, and the
+    record is stamped with the shipping executor ("exec", "exec_pid").
+    `truncated=True` marks records recovered from a dead worker's
+    sidecar spill — the span stream ended mid-flight. Returns the count
+    ingested; malformed entries are skipped, never fatal."""
+    if not conf.trace_enabled:
+        return 0
+    n = 0
+    off = int(offset_ns)
+    for rec in records:
+        if not isinstance(rec, dict) or "kind" not in rec:
+            continue
+        r = dict(rec)
+        try:
+            r["ts"] = int(r.get("ts", 0)) + off
+        except (TypeError, ValueError):
+            continue
+        r["exec"] = exec_id
+        if pid is not None:
+            r["exec_pid"] = pid
+        if truncated:
+            r["truncated"] = True
+        TRACE.append(r)
+        n += 1
+    return n
+
+
+def ingest_histograms(snaps: Dict[str, dict]) -> None:
+    """Merge executor-shipped histogram snapshots (bucket-count deltas)
+    into the driver's named histograms — task_latency_us etc. then cover
+    pooled and in-process work in one distribution."""
+    if not conf.trace_enabled or not snaps:
+        return
+    for name, s in snaps.items():
+        if not isinstance(s, dict):
+            continue
+        tmp = Histogram(str(name))
+        counts = list(s.get("counts") or ())[:Histogram.N_BUCKETS]
+        counts += [0] * (Histogram.N_BUCKETS - len(counts))
+        tmp.counts = [int(c) for c in counts]
+        tmp.count = int(s.get("count") or 0)
+        tmp.total = int(s.get("total") or 0)
+        tmp.vmin = s.get("min")
+        tmp.vmax = s.get("max")
+        if tmp.count:
+            histogram(str(name)).merge(tmp)
+
+
 # -- exporter 1: Chrome/Perfetto trace-event JSON ----------------------------
 
 
@@ -429,24 +501,33 @@ def export_chrome_trace(path: str,
     chrome://tracing, next to the XLA profiler traces from
     conf.profiler_dir).
 
-    Row model: one process per query, one row (tid) per task — spans
-    nest by time on their row, so task-attempt spans sit under their
-    stage's span on the driver row timeline. "X" complete events carry
-    spans; instant events ("i") carry points; metadata events name the
-    rows. Returns {"events": n, "path": path}."""
+    Row model: one process per query — plus, for federated runs, one
+    process per (query, executor): executor-shipped records carry an
+    "exec" stamp (ingest_remote) and render on their own pid row named
+    "blaze_tpu <qid> [execN]", timestamps already rebased onto the
+    driver clock so the merged timeline is one trace. Within a process,
+    one row (tid) per task — spans nest by time on their row, so
+    task-attempt spans sit under their stage's span on the driver row
+    timeline. "X" complete events carry spans; instant events ("i")
+    carry points; metadata events name the rows. Returns
+    {"events": n, "path": path}."""
     recs = TRACE.snapshot() if records is None else list(records)
-    pids: Dict[str, int] = {}
+    pids: Dict[tuple, int] = {}
     tids: Dict[tuple, int] = {}
     events: List[dict] = []
 
     def pid_of(rec) -> int:
         q = str(rec.get("query_id", "-"))
-        if q not in pids:
-            pids[q] = len(pids) + 1
+        ex = rec.get("exec")
+        key = (q, ex)
+        if key not in pids:
+            pids[key] = len(pids) + 1
+            name = f"blaze_tpu {q}" if ex is None else \
+                f"blaze_tpu {q} [{ex}]"
             events.append({"ph": "M", "name": "process_name",
-                           "pid": pids[q], "tid": 0,
-                           "args": {"name": f"blaze_tpu {q}"}})
-        return pids[q]
+                           "pid": pids[key], "tid": 0,
+                           "args": {"name": name}})
+        return pids[key]
 
     def tid_of(rec, pid: int) -> int:
         row = rec.get("task_id")
@@ -466,6 +547,12 @@ def export_chrome_trace(path: str,
         args.update(rec.get("attrs") or {})
         if rec.get("error"):
             args["error"] = rec["error"]
+        if rec.get("exec"):
+            args["exec"] = rec["exec"]
+            if rec.get("exec_pid") is not None:
+                args["exec_pid"] = rec["exec_pid"]
+        if rec.get("truncated"):
+            args["truncated"] = True
         ev = {"name": rec["kind"], "cat": rec["type"],
               "ts": rec["ts"] / 1000.0, "pid": pid, "tid": tid,
               "args": args}

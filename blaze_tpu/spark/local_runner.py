@@ -727,6 +727,11 @@ def _run_shuffle_stage_pooled(stage: Stage, stages: List[Stage],
     reader_schema = decode_plan(stage.plan.shuffle_writer.input).schema
     handle = shuffle_mgr.register_shuffle(
         stage.stage_id, stage.num_partitions, reader_schema)
+    # driver-issued correlation ids ride the task payload: the worker
+    # replays them into its trace context, so executor-side spans and
+    # counter attribution share the driver's query/stage/task ids (the
+    # telemetry-federation join key)
+    ctx = trace.current_context()
     specs: List[executor_pool.PoolTaskSpec] = []
     slots = []
     for task in range(ntasks):
@@ -740,6 +745,10 @@ def _run_shuffle_stage_pooled(stage: Stage, stages: List[Stage],
             kind="plan",
             payload={"partition": task, "num_partitions": ntasks,
                      "rids": rids,
+                     "query_id": ctx.get("query_id"),
+                     "tenant_id": ctx.get("tenant_id"),
+                     "stage_id": stage.stage_id,
+                     "task_id": task,
                      "what": f"shuffle_map[{stage.stage_id}:{task}]"},
             blob=node.SerializeToString(),
             what=f"shuffle_map[{stage.stage_id}:{task}]"))

@@ -1,0 +1,432 @@
+"""Distributed telemetry plane (ISSUE 14): cross-process trace
+federation, counter aggregation, and crash recovery of executor-side
+telemetry.
+
+The headline properties under test:
+
+  * A SIGKILL'd executor's last buffered spans are recovered from its
+    crash-atomic sidecar spill, marked truncated=true, rebased onto the
+    driver clock, and the merged Chrome trace stays valid JSON with a
+    pid row per executor process.
+
+  * A zombie's (heartbeat-declared-dead, process still alive) late
+    telemetry frame over the socket is DROPPED — its unshipped tail was
+    already recovered from the sidecar, and accepting the socket copy
+    too would double-count spans and counters.
+
+Pool startup costs ~2-3s (workers import jax); the process-level tests
+each spin a dedicated pool.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from blaze_tpu.config import conf
+from blaze_tpu.runtime import executor_pool as ep
+from blaze_tpu.runtime import monitor, progress, trace
+
+
+@pytest.fixture
+def telemetry_conf(monkeypatch):
+    """Fast-death pool knobs + both telemetry planes on, isolated ring."""
+    monkeypatch.setattr(conf, "executor_death_ms", 600)
+    monkeypatch.setattr(conf, "executor_heartbeat_ms", 50)
+    monkeypatch.setattr(conf, "executor_restart_backoff_ms", 50)
+    monkeypatch.setattr(conf, "trace_enabled", True)
+    monkeypatch.setattr(conf, "monitor_enabled", True)
+    trace.reset()
+    monitor.reset()
+    yield
+    trace.reset()
+    monitor.reset()
+
+
+# ---------------------------------------------------------------------------
+# federation primitives (no pool, cheap)
+# ---------------------------------------------------------------------------
+
+
+def test_trace_drain_empties_ring(telemetry_conf):
+    trace.event("spill", nbytes=1)
+    trace.event("spill", nbytes=2)
+    out = trace.TRACE.drain()
+    assert [r["attrs"]["nbytes"] for r in out] == [1, 2]
+    assert len(trace.TRACE) == 0
+    assert trace.TRACE.drain() == []
+
+
+def test_ingest_remote_rebases_and_stamps(telemetry_conf):
+    records = [
+        {"type": "span", "kind": "task_attempt", "ts": 1000, "dur": 500,
+         "query_id": "q1", "attrs": {}},
+        {"type": "event", "kind": "spill", "ts": 2000, "attrs": {}},
+        {"no": "kind"},            # malformed: skipped, not fatal
+        "not-a-dict",
+    ]
+    n = trace.ingest_remote(records, exec_id="exec7", pid=4242,
+                            offset_ns=10_000, truncated=True)
+    assert n == 2
+    ingested = [r for r in trace.TRACE.snapshot() if r.get("exec")]
+    assert [r["ts"] for r in ingested] == [11_000, 12_000]
+    assert all(r["exec"] == "exec7" and r["exec_pid"] == 4242
+               and r["truncated"] for r in ingested)
+    # the caller's dicts are not mutated (dossiers keep the raw spill)
+    assert records[0]["ts"] == 1000 and "exec" not in records[0]
+
+
+def test_ingest_remote_gated_on_trace_enabled(telemetry_conf, monkeypatch):
+    monkeypatch.setattr(conf, "trace_enabled", False)
+    n = trace.ingest_remote(
+        [{"type": "event", "kind": "spill", "ts": 1, "attrs": {}}],
+        exec_id="exec0")
+    assert n == 0 and len(trace.TRACE) == 0
+
+
+def test_clamp_offset_bounds_skew(monkeypatch):
+    monkeypatch.setattr(conf, "clock_skew_bound_ms", 100)
+    bound = 100 * 1_000_000
+    assert ep._clamp_offset(5) == 5
+    assert ep._clamp_offset(bound * 3) == bound
+    assert ep._clamp_offset(-bound * 3) == -bound
+
+
+def test_monitor_counter_federation_roundtrip(telemetry_conf):
+    """Worker half (ensure_query + drain) through a JSON wire roundtrip
+    into the driver half (merge_remote): per-query roll-up and stage
+    attribution match what an in-process run would have recorded —
+    including stage ids surviving JSON key stringification."""
+    qid = "qfed"
+    # worker side: driver-issued qid registered without begin_query
+    monitor.ensure_query(qid)
+    with trace.context(query_id=qid, stage_id=3):
+        monitor.count_copy("shuffle", 1000, moved=700)
+        monitor.count_time("serde_encode", 2_000_000)
+    deltas = monitor.drain_remote_deltas()
+    assert qid in deltas
+    assert deltas[qid]["copied"]["shuffle"] == 1000
+    # repeated drains ship disjoint deltas
+    assert monitor.drain_remote_deltas() == {}
+    wire = json.loads(json.dumps(deltas))        # stage keys stringify
+    assert "3" in wire[qid]["stage_copied"]
+
+    # driver side: fold into a live accumulator + process totals
+    monitor.reset()
+    copied0, _ = monitor.copy_totals()
+    monitor.begin_query(qid)
+    monitor.merge_remote(wire)
+    attrs = monitor.stage_span_attrs(qid, 3)     # int key restored
+    assert attrs.get("copied_bytes") == 1000
+    roll = monitor.query_end(qid)
+    assert roll["bytes_copied_shuffle"] == 1000
+    assert roll["bytes_moved_shuffle"] == 700
+    assert roll["serde_encode_ms"] == 2.0
+    copied1, _ = monitor.copy_totals()
+    assert copied1.get("shuffle", 0) - copied0.get("shuffle", 0) == 1000
+
+
+def test_ingest_histograms_merges_snapshots(telemetry_conf):
+    trace.reset_histograms()
+    trace.record_value("task_latency_us", 10)
+    remote = trace.Histogram("task_latency_us")
+    remote.record(20)
+    remote.record(30)
+    trace.ingest_histograms({"task_latency_us": remote.snapshot()})
+    snap = trace.histograms_snapshot()
+    assert snap["task_latency_us"]["count"] == 3
+    assert snap["task_latency_us"]["max"] == 30
+
+
+def test_progress_finished_ring_bounds_cardinality(telemetry_conf):
+    """Satellite: blaze_query_progress_ratio prunes stale qid series —
+    finished queries linger in a bounded last-N ring, older ones age
+    out of the exposition entirely."""
+    progress.reset()
+    n = progress.FINISHED_RING + 5
+    for i in range(n):
+        progress.begin_query(f"qcard{i:03d}")
+        progress.finish_query(f"qcard{i:03d}")
+    rows = progress.finished_queries()
+    assert len(rows) == progress.FINISHED_RING
+    kept = {r["query_id"] for r in rows}
+    assert f"qcard{n - 1:03d}" in kept          # newest kept
+    assert "qcard000" not in kept               # oldest pruned
+    text = monitor.prometheus_text()
+    assert 'blaze_query_progress_ratio{qid="qcard000"}' not in text
+    assert f'blaze_query_progress_ratio{{qid="qcard{n - 1:03d}"}}' in text
+    progress.reset()
+
+
+def test_prometheus_per_executor_federation_gauges(telemetry_conf):
+    """The four blaze_top executor-pane families render one labeled row
+    per executor from the pool's executors() snapshot."""
+
+    class _Stub:
+        def capacity(self):
+            return 2
+
+        def live_count(self):
+            return 1
+
+        def stats(self):
+            return {"count": 1, "live": 1, "capacity": 2, "slots": 2,
+                    "inflight": 0, "deaths_total": 0, "restarts_total": 0,
+                    "fenced_total": 0, "tasks_done": 7}
+
+        def executors(self):
+            return [{"exec_id": "exec0", "pid": 1, "generation": 0,
+                     "up": True, "inflight": 1, "heartbeat_age_ms": 12,
+                     "tasks_done": 7, "telemetry_bytes": 3456,
+                     "telemetry_records": 9, "telemetry_dropped": 0}]
+
+    stub = _Stub()
+    ep.activate(stub)
+    try:
+        text = monitor.prometheus_text()
+        assert 'blaze_executor_heartbeat_age_ms{exec_id="exec0"} 12' in text
+        assert 'blaze_executor_busy_slots{exec_id="exec0"} 1' in text
+        assert 'blaze_executor_tasks_done_total{exec_id="exec0"} 7' in text
+        assert ('blaze_executor_telemetry_bytes_total{exec_id="exec0"} '
+                '3456') in text
+    finally:
+        ep.deactivate(stub)
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL mid-task: sidecar spill recovery + merged trace validity
+# ---------------------------------------------------------------------------
+
+
+def _chrome_export_checks(doc, exec_id):
+    """Shared merged-trace assertions: valid shape, a pid row per
+    executor process, driver-aligned monotone timestamps."""
+    events = doc.get("traceEvents")
+    assert isinstance(events, list) and events
+    procs = {ev["pid"]: ev["args"]["name"] for ev in events
+             if ev.get("ph") == "M" and ev.get("name") == "process_name"}
+    exec_rows = [pid for pid, name in procs.items()
+                 if f"[{exec_id}]" in name]
+    assert exec_rows, f"no pid row for {exec_id}: {sorted(procs.values())}"
+    driver_ts = [ev["ts"] for ev in events
+                 if ev.get("ph") in ("X", "i")
+                 and ev["pid"] not in exec_rows]
+    exec_ts = [ev["ts"] for ev in events
+               if ev.get("ph") in ("X", "i") and ev["pid"] in exec_rows]
+    assert driver_ts and exec_ts
+    assert all(ts >= 0 for ts in exec_ts)
+    # clock alignment: rebased executor timestamps land inside the
+    # driver's observed window (with slack for transit), not off on the
+    # worker's own epoch
+    lo, hi = min(driver_ts), max(driver_ts)
+    slack_us = 30 * 1e6
+    assert all(lo - slack_us <= ts <= hi + slack_us for ts in exec_ts)
+
+
+def test_sigkill_recovers_sidecar_spans_truncated(telemetry_conf,
+                                                  tmp_path, monkeypatch):
+    """SIGKILL the only executor mid-task. Its sidecar spill (written
+    crash-atomically before every ship — here representing the batch
+    that never reached the wire) must be recovered by the death sweep:
+    spans land in the driver ring truncated=true and clock-rebased,
+    counters merge into the process totals, the death dossier embeds
+    the ring slice, and the merged Chrome trace stays valid."""
+    import signal
+
+    from blaze_tpu.runtime import flight_recorder
+
+    monkeypatch.setattr(conf, "flight_dir", str(tmp_path / "flight"))
+    flight_recorder.reset()
+    pool = ep.ExecutorPool(count=1, slots=1)
+    pool.start()
+    try:
+        handle = pool.live_handles()[0]
+        now_ns = time.monotonic_ns()
+        spilled = [
+            {"type": "span", "kind": "task_attempt", "ts": now_ns,
+             "dur": 5_000_000, "query_id": "qkill", "stage_id": 1,
+             "task_id": 0, "attrs": {"what": "shuffle_map[1:0]"}},
+            {"type": "event", "kind": "pipeline_stats", "ts": now_ns,
+             "attrs": {}},
+            {"malformed": "no kind"},
+        ]
+        sidecar = {"type": "telemetry", "seq": handle.tel_seq + 1,
+                   "records": spilled,
+                   "counters": {"qkill": {"copied": {"shuffle": 4321},
+                                          "moved": {"shuffle": 4321}}},
+                   "histograms": {}, "dropped": 0, "mono_ns": now_ns}
+        with open(os.path.join(pool._dir,
+                               f"{handle.token}.telemetry"), "w") as f:
+            json.dump(sidecar, f)
+        copied0, _ = monitor.copy_totals()
+
+        specs = [ep.PoolTaskSpec("k:0", "sleep", {"ms": 600})]
+        box = {}
+
+        def run():
+            box["out"] = pool.run_tasks(specs, timeout=120)
+
+        t = threading.Thread(target=run)
+        t.start()
+        deadline = time.monotonic() + 10
+        while not pool.busy_pids() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert pool.busy_pids(), "no executor picked up work"
+        os.kill(handle.pid, signal.SIGKILL)
+        t.join(timeout=120)
+        assert len(box["out"]) == 1 and box["out"][0]["ok"]
+
+        # recovered spans: in the ring, truncated, exec-stamped, rebased
+        recs = trace.TRACE.snapshot()
+        rec_spans = [r for r in recs if r.get("truncated")]
+        assert len(rec_spans) == 2          # malformed entry skipped
+        assert all(r["exec"] == handle.exec_id for r in rec_spans)
+        span = next(r for r in rec_spans if r["kind"] == "task_attempt")
+        assert span["query_id"] == "qkill"
+        assert span["ts"] == now_ns + handle.clock_offset_ns
+        ev_kinds = {r["kind"] for r in recs if r["type"] == "event"}
+        assert "telemetry_recovered" in ev_kinds
+
+        # counters federated into the process totals
+        copied1, _ = monitor.copy_totals()
+        assert copied1.get("shuffle", 0) - copied0.get("shuffle", 0) == 4321
+
+        # pool bookkeeping feeds the 0-dropped-rings gate
+        rows = {e["exec_id"]: e for e in pool.executors()}
+        st = pool.stats()
+        assert st["telemetry_records_total"] >= 3
+        assert all(e["telemetry_dropped"] == 0 for e in rows.values())
+
+        # the death dossier embeds the raw spilled slice
+        dossiers = flight_recorder.list_dossiers(str(tmp_path / "flight"))
+        deaths = [d for d in dossiers
+                  if d.get("trigger") == "executor_death"]
+        assert len(deaths) == 1
+        detail = flight_recorder.load(deaths[0]["path"])["detail"]
+        assert detail["executor_trace"] == spilled
+        assert "clock_offset_ms" in detail
+
+        # merged export: one valid JSON, pid row per executor, aligned ts
+        out = str(tmp_path / "merged.json")
+        trace.export_chrome_trace(out, records=recs)
+        with open(out) as f:
+            doc = json.load(f)
+        _chrome_export_checks(doc, handle.exec_id)
+        truncated = [ev for ev in doc["traceEvents"]
+                     if (ev.get("args") or {}).get("truncated")]
+        assert truncated
+    finally:
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# zombie telemetry: dropped, not double-counted
+# ---------------------------------------------------------------------------
+
+
+def test_zombie_telemetry_dropped_not_double_counted(telemetry_conf,
+                                                     monkeypatch):
+    """Hang an executor mid-task (heartbeats stop, sends defer, process
+    survives). The driver declares death and recovers the worker's
+    sidecar — which by then holds the completed task's span (flushed,
+    spilled, but never sent). When the zombie wakes, its socket copy of
+    the SAME batch must be dropped (dead handle + seq watermark): the
+    hung attempt's span appears exactly once, the re-queued attempt's
+    span exactly once, never a third copy."""
+    monkeypatch.setattr(conf, "executor_restart_max", 0)
+    pool = ep.ExecutorPool(count=2, slots=1)
+    pool.start()
+    try:
+        specs = [ep.PoolTaskSpec(f"z:{i}", "sleep", {"ms": 400})
+                 for i in range(2)]
+        box = {}
+
+        def run():
+            box["out"] = pool.run_tasks(specs, timeout=120)
+
+        t = threading.Thread(target=run)
+        t.start()
+        deadline = time.monotonic() + 10
+        busy = {}
+        while len(busy) < 2 and time.monotonic() < deadline:
+            busy = pool.busy_pids()
+            time.sleep(0.02)
+        assert busy, "no executor picked up work"
+        seat = next(iter(busy))
+        fenced_before = pool.fence.fenced_total
+        assert pool.hang_executor(seat, 2500)
+        t.join(timeout=120)
+        assert len(box["out"]) == 2 and all(r["ok"] for r in box["out"])
+        assert pool.stats()["deaths_total"] >= 1
+        # wait for the zombie to wake: its stale result hits the fence
+        # AFTER its telemetry frame (same socket, FIFO), so once the
+        # fence count moves the frame has already been dispositioned
+        deadline = time.monotonic() + 15
+        while (pool.fence.fenced_total <= fenced_before
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert pool.fence.fenced_total > fenced_before
+        time.sleep(0.3)
+
+        attempts = [r for r in trace.TRACE.snapshot()
+                    if r.get("kind") == "task_attempt" and r.get("exec")]
+        per_key = {}
+        for r in attempts:
+            what = (r.get("attrs") or {}).get("what")
+            per_key[what] = per_key.get(what, 0) + 1
+        # 2 keys, 3 attempts total: the displaced key has its truncated
+        # sidecar copy + the rerun, the other exactly one — a third copy
+        # would mean the zombie's socket frame was double-ingested
+        assert sum(per_key.values()) == 3, per_key
+        assert sorted(per_key.values()) == [1, 2], per_key
+        displaced_key = max(per_key, key=per_key.get)
+        displaced = [r for r in attempts
+                     if (r.get("attrs") or {}).get("what") == displaced_key]
+        assert sorted(bool(r.get("truncated")) for r in displaced) \
+            == [False, True]
+        # the dead seat's handle froze its telemetry counters at death:
+        # the late frame moved neither the per-handle nor pool totals
+        dead_rows = [e for e in pool.executors() if not e["up"]]
+        assert dead_rows and all(e["telemetry_dropped"] == 0
+                                 for e in dead_rows)
+    finally:
+        pool.close()
+
+
+def test_doctor_executor_skew_fires_on_dominant_worker(telemetry_conf):
+    """executor_skew compares the worst worker against the median of the
+    OTHERS — with a 2-seat pool (the common size) an all-inclusive
+    median would average the dominant worker in and never reach the
+    ratio. In-process spans (no exec id) must never trigger it."""
+    from blaze_tpu.runtime import doctor
+
+    def task(exec_id, dur_ms, tid):
+        return {"type": "span", "kind": "task_attempt", "exec": exec_id,
+                "query_id": "qskew", "stage_id": 0, "task_id": tid,
+                "ts": 0, "dur": int(dur_ms * 1e6)}
+
+    record = {"query_id": "qskew", "duration_ms": 500.0,
+              "counters": {}, "stages": []}
+    skewed = [task("exec0", 400.0, 0), task("exec1", 10.0, 1)]
+    findings = doctor.diagnose(record, skewed,
+                               critical_path={"total_ms": 500.0})
+    skew = [f for f in findings if f.code == "executor_skew"]
+    assert skew, [f.code for f in findings]
+    assert skew[0].evidence["exec_id"] == "exec0"
+    assert skew[0].evidence["ratio"] >= conf.doctor_skew_ratio
+
+    # balanced pool: silent
+    balanced = [task("exec0", 200.0, 0), task("exec1", 180.0, 1)]
+    findings = doctor.diagnose(record, balanced,
+                               critical_path={"total_ms": 500.0})
+    assert not [f for f in findings if f.code == "executor_skew"]
+
+    # in-process run (no exec ids): silent even when one task dominates
+    local = [task(None, 400.0, 0), task(None, 10.0, 1)]
+    for t in local:
+        t.pop("exec")
+    findings = doctor.diagnose(record, local,
+                               critical_path={"total_ms": 500.0})
+    assert not [f for f in findings if f.code == "executor_skew"]

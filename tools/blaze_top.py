@@ -116,6 +116,19 @@ def render(metrics: dict, source: str) -> str:
             f"deaths={int(g('blaze_executor_deaths_total'))} "
             f"restarts={int(g('blaze_executor_restarts_total'))}  {up}"
             + ("  ** NO EXECUTORS LIVE **" if live == 0 else ""))
+        # per-executor pane, fed by the federation gauges: one row per
+        # exec_id with heartbeat freshness, occupancy and telemetry flow
+        for key, v in sorted(exec_rows):
+            ex = key.split('exec_id="', 1)[-1].rstrip('"}')
+            sel = '{exec_id="' + ex + '"}'
+            hb = g("blaze_executor_heartbeat_age_ms" + sel)
+            lines.append(
+                f"  exec   {ex:<16} "
+                f"hb={hb:6.0f}ms "
+                f"busy={int(g('blaze_executor_busy_slots' + sel))} "
+                f"done={int(g('blaze_executor_tasks_done_total' + sel))} "
+                f"tel={human_bytes(int(g('blaze_executor_telemetry_bytes_total' + sel)))}"
+                + ("" if v else "  ** DOWN **"))
     tenants = [(k, v) for k, v in metrics.items()
                if k.startswith("blaze_tenant_mem_used_bytes{")]
     for key, v in sorted(tenants):

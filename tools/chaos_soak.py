@@ -48,6 +48,17 @@ replay the journal — verified committed stages reused (map_tasks_run
 flight dossier — and still answer oracle-equal. Both emit
 `DURABILITY_r17.json`.
 
+`--dist-obs` (ISSUE 14): the distributed-telemetry acceptance run —
+a pooled chaos round (q3 under a 2-seat pool, SIGKILL mid-stage) with
+the telemetry plane ON must still answer oracle-equal AND produce ONE
+merged Chrome trace where driver and executor spans share query/task
+ids on per-executor pid rows with clock-aligned timestamps, zero
+executors report dropped span rings, and the run ledger's counters
+carry the workers' federated copy bytes (pre-federation these were
+silently zero for pooled runs). A telemetry on/off A/B over the pooled
+catalogue gates the plane's overhead below 2%. Emits
+`DIST_OBS_r18.json`.
+
 Each cell installs one deterministic fault spec (fail the first N calls
 of one KNOWN_POINTS prefix), runs a full driver-path query, and diffs
 the answer against the pandas oracle. A cell is
@@ -780,6 +791,205 @@ def _supervisor_overhead(tables):
             "catalogue_supervisor_on_s": t_on}
 
 
+def _check_merged_trace(path, qid, exec_ids):
+    """Acceptance checks on ONE merged Chrome trace: valid JSON, a pid
+    row per executor process, driver and executor spans sharing the
+    query id, executor timestamps rebased inside the driver's observed
+    window (clock alignment, 30s transit slack)."""
+    out = {"path": path}
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        out["ok"] = False
+        out["error"] = f"{type(e).__name__}: {e}"
+        return out
+    events = doc.get("traceEvents") or []
+    procs = {ev["pid"]: ev["args"]["name"] for ev in events
+             if ev.get("ph") == "M" and ev.get("name") == "process_name"}
+    exec_pids = {pid for pid, name in procs.items()
+                 if any(f"[{ex}]" in name for ex in exec_ids)}
+    spans = [ev for ev in events if ev.get("ph") == "X"]
+    drv = [ev for ev in spans if ev["pid"] not in exec_pids
+           and (ev.get("args") or {}).get("query_id") == qid]
+    exc = [ev for ev in spans if ev["pid"] in exec_pids
+           and (ev.get("args") or {}).get("query_id") == qid]
+    out["events"] = len(events)
+    out["executor_pid_rows"] = len(exec_pids)
+    out["driver_query_spans"] = len(drv)
+    out["executor_query_spans"] = len(exc)
+    out["executor_task_ids"] = sorted(
+        {str((ev.get("args") or {}).get("task_id")) for ev in exc
+         if (ev.get("args") or {}).get("task_id") is not None})
+    aligned = True
+    if drv and exc:
+        lo = min(ev["ts"] for ev in drv)
+        hi = max(ev["ts"] for ev in drv)
+        slack = 30 * 1e6  # µs
+        aligned = all(lo - slack <= ev["ts"] <= hi + slack for ev in exc)
+    out["clock_aligned"] = aligned
+    out["ok"] = bool(exec_pids and drv and exc and aligned
+                     and out["executor_task_ids"])
+    return out
+
+
+def _dist_obs_chaos_round(tables, flight_dir, trace_dir):
+    """Pooled chaos round with the telemetry plane ON: q3 under a
+    2-seat pool, SIGKILL fired at a busy executor mid-stage. Beyond the
+    ISSUE-12 recovery demands, the telemetry acceptance: ONE merged
+    Chrome trace with driver + executor spans sharing query/task ids on
+    per-executor pid rows, clock-aligned timestamps, zero dropped-span
+    rings, and ledger counters carrying executor-side bytes."""
+    import signal
+    import threading
+
+    from blaze_tpu.config import conf
+    from blaze_tpu.runtime import executor_pool as ep
+    from blaze_tpu.runtime import trace
+    from blaze_tpu.spark import validator
+    from blaze_tpu.spark.local_runner import run_plan
+
+    paths, frames = tables
+    plan, oracle = validator.QUERIES["q3_join_agg_sort"](paths, frames,
+                                                         "smj")
+    saved = {k: getattr(conf, k) for k in
+             ("flight_dir", "executor_death_ms", "executor_heartbeat_ms",
+              "trace_enabled", "monitor_enabled")}
+    conf.flight_dir = flight_dir
+    conf.executor_death_ms = 800
+    conf.executor_heartbeat_ms = 50
+    conf.trace_enabled = True
+    conf.monitor_enabled = True
+    trace.reset()
+    rec = {"round": "dist_obs_chaos_sigkill"}
+    work_dir = tempfile.mkdtemp(prefix="chaos_dobs_")
+    t0 = time.time()
+    pool = ep.ExecutorPool(count=2, slots=2)
+    try:
+        pool.start()
+        ep.activate(pool)
+        info = {}
+        box = {}
+
+        def run():
+            try:
+                box["out"] = run_plan(plan, num_partitions=4,
+                                      work_dir=work_dir,
+                                      mesh_exchange="off", run_info=info)
+            except Exception as e:  # noqa: BLE001 — recorded below
+                box["err"] = e
+
+        t = threading.Thread(target=run)
+        t.start()
+        fired = False
+        deadline = time.monotonic() + 120
+        while not fired and t.is_alive() and time.monotonic() < deadline:
+            busy = pool.busy_pids()
+            if busy:
+                _seat, pid = next(iter(busy.items()))
+                os.kill(pid, signal.SIGKILL)
+                fired = True
+            else:
+                time.sleep(0.002)
+        t.join(timeout=300)
+        rec["fired"] = fired
+        if "err" in box:
+            rec["outcome"] = "classified_fail"
+            rec["error"] = f"{type(box['err']).__name__}: {box['err']}"[:300]
+        elif not fired:
+            rec["outcome"] = "no_fire"
+        else:
+            diff = validator._compare(
+                validator._to_pandas(box["out"]).reset_index(drop=True),
+                oracle().reset_index(drop=True))
+            rec["outcome"] = "recovered" if diff is None else "wrong_answer"
+        rec["pool_stages"] = info.get("pool_stages", 0)
+        qid = info.get("query_id", "")
+        # ONE merged export over the federated ring: driver spans and
+        # every shipped/recovered executor span, one timeline
+        merged = os.path.join(trace_dir, "dist_obs_merged.json")
+        trace.export_chrome_trace(merged, records=trace.TRACE.snapshot())
+        exec_ids = [e["exec_id"] for e in pool.executors()]
+        rec["merged_trace"] = _check_merged_trace(merged, qid, exec_ids)
+        rec["stats"] = pool.stats()
+        rec["executors"] = pool.executors()
+        rec["dropped_rings"] = sum(
+            1 for e in rec["executors"] if e.get("telemetry_dropped"))
+        ledger = trace.build_run_record(qid, info,
+                                        trace.query_records(qid))
+        counters = ledger.get("counters") or {}
+        rec["ledger_counters"] = {
+            k: counters.get(k, 0)
+            for k in ("bytes_copied_total", "bytes_copied_shuffle",
+                      "bytes_copied_serde", "spill_bytes")}
+        # federation reconciliation: the pool carried map stages, so the
+        # ledger must see the workers' copy bytes (pre-federation these
+        # were silently zero for pooled runs)
+        rec["counters_reconciled"] = (
+            rec["pool_stages"] >= 1
+            and counters.get("bytes_copied_total", 0) > 0)
+    finally:
+        ep.deactivate(pool)
+        pool.close()
+        for k, v in saved.items():
+            setattr(conf, k, v)
+    rec["seconds"] = round(time.time() - t0, 3)
+    rec.update(_leaks([work_dir]))
+    shutil.rmtree(work_dir, ignore_errors=True)
+    return rec
+
+
+def _dist_obs_overhead(tables):
+    """Telemetry-plane overhead: the pooled catalogue A/B, telemetry
+    (trace + monitor, federation included) OFF vs ON. Each arm spawns
+    its own pool — workers snapshot the driver's tracing state at spawn
+    — runs the catalogue once warm, then takes the best of 3 timed laps
+    (the gate is <2%, well inside timing noise for a single lap)."""
+    from blaze_tpu.config import conf
+    from blaze_tpu.runtime import executor_pool as ep
+    from blaze_tpu.runtime import trace
+    from blaze_tpu.spark import validator
+    from blaze_tpu.spark.local_runner import run_plan
+
+    paths, frames = tables
+    saved = {k: getattr(conf, k) for k in
+             ("trace_enabled", "monitor_enabled")}
+
+    def catalogue():
+        t0 = time.time()
+        for query, mode in QUERIES:
+            plan, _ = validator.QUERIES[query](paths, frames, mode)
+            run_plan(plan, num_partitions=4, mesh_exchange="off")
+        return time.time() - t0
+
+    def arm(enabled):
+        conf.trace_enabled = enabled
+        conf.monitor_enabled = enabled
+        trace.reset()
+        pool = ep.ExecutorPool(count=2, slots=2)
+        try:
+            pool.start()
+            ep.activate(pool)
+            catalogue()  # warm: jit caches + worker imports
+            best = min(catalogue() for _ in range(3))
+        finally:
+            ep.deactivate(pool)
+            pool.close()
+        trace.reset()
+        return best
+
+    try:
+        t_off = arm(False)
+        t_on = arm(True)
+    finally:
+        for k, v in saved.items():
+            setattr(conf, k, v)
+    pct = 100.0 * (t_on - t_off) / max(t_off, 1e-9)
+    return {"catalogue_telemetry_off_s": round(t_off, 3),
+            "catalogue_telemetry_on_s": round(t_on, 3),
+            "overhead_pct": round(pct, 2)}
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", type=int, default=8000)
@@ -824,6 +1034,13 @@ def main() -> int:
                          "demand journal replay (committed stages reused, "
                          "crashed attempt billed failed) with an "
                          "oracle-equal answer")
+    ap.add_argument("--dist-obs", action="store_true",
+                    help="distributed-telemetry acceptance: pooled chaos "
+                         "round (SIGKILL) with the telemetry plane on — "
+                         "one merged Chrome trace with per-executor pid "
+                         "rows, clock-aligned spans, zero dropped rings, "
+                         "federated ledger counters — plus a telemetry "
+                         "on/off overhead A/B gated at <2%%")
     ap.add_argument("--concurrent-queries", type=int, default=8,
                     help="client sessions per --service round")
     ap.add_argument("--tenants", type=int, default=3,
@@ -836,8 +1053,9 @@ def main() -> int:
     ap.add_argument("--json-out", default=None)
     args = ap.parse_args()
     if args.json_out is None:
-        args.json_out = ("DURABILITY_r17.json" if (args.durability
-                                                   or args.driver)
+        args.json_out = ("DIST_OBS_r18.json" if args.dist_obs
+                         else "DURABILITY_r17.json" if (args.durability
+                                                        or args.driver)
                          else "EXECUTORS_r16.json" if args.executors
                          else "SERVICE_r13.json" if args.service
                          else "SUPERVISOR_r07.json" if args.supervisor
@@ -868,6 +1086,65 @@ def main() -> int:
 
     tmpdir = tempfile.mkdtemp(prefix="chaos_tables_")
     tables = validator.generate_tables(tmpdir, rows=args.rows)
+
+    if args.dist_obs:
+        flight_dir = tempfile.mkdtemp(prefix="chaos_dobs_flight_")
+        trace_dir = tempfile.mkdtemp(prefix="chaos_dobs_trace_")
+        try:
+            rounds = [_dist_obs_chaos_round(tables, flight_dir, trace_dir)]
+            overhead = _dist_obs_overhead(tables)
+        finally:
+            shutil.rmtree(tmpdir, ignore_errors=True)
+            shutil.rmtree(flight_dir, ignore_errors=True)
+            shutil.rmtree(trace_dir, ignore_errors=True)
+            for k, v in saved_conf.items():
+                setattr(conf, k, v)
+        bad = []
+        for r in rounds:
+            if r.get("outcome") != "recovered":
+                bad.append({"round": r["round"],
+                            "outcome": r.get("outcome")})
+            if not (r.get("merged_trace") or {}).get("ok"):
+                bad.append({"round": r["round"], "merged_trace_ok": False,
+                            "detail": r.get("merged_trace")})
+            if r.get("dropped_rings"):
+                bad.append({"round": r["round"],
+                            "dropped_rings": r["dropped_rings"]})
+            if not (r.get("stats") or {}).get("telemetry_records_total"):
+                bad.append({"round": r["round"], "telemetry_shipped": 0})
+            if not r.get("counters_reconciled"):
+                bad.append({"round": r["round"],
+                            "counters_reconciled": False,
+                            "ledger_counters": r.get("ledger_counters")})
+            if (r.get("orphans") or r.get("mem_leaked")
+                    or r.get("pipeline_leaked") or r.get("resource_leaked")):
+                bad.append({"round": r["round"], "leaks": True})
+            mt = r.get("merged_trace") or {}
+            print(f"[dist-obs] {r.get('outcome', '?'):10s} "
+                  f"exec_pid_rows={mt.get('executor_pid_rows')} "
+                  f"exec_spans={mt.get('executor_query_spans')} "
+                  f"aligned={mt.get('clock_aligned')} "
+                  f"dropped_rings={r.get('dropped_rings')} "
+                  f"counters={r.get('ledger_counters')} "
+                  f"{r.get('seconds', 0):.1f}s", flush=True)
+        if overhead["overhead_pct"] >= 2.0:
+            bad.append({"overhead_pct": overhead["overhead_pct"]})
+        print(f"[dist-obs] overhead "
+              f"off={overhead['catalogue_telemetry_off_s']:.2f}s "
+              f"on={overhead['catalogue_telemetry_on_s']:.2f}s "
+              f"({overhead['overhead_pct']:+.2f}%)", flush=True)
+        report = {
+            "rows": args.rows, "seed": args.seed,
+            "ok": not bad, "bad": bad,
+            "rounds": rounds, "overhead": overhead,
+        }
+        with open(args.json_out, "w") as f:
+            json.dump(report, f, indent=1)
+        print(f"\ndist-obs soak {'OK' if report['ok'] else 'FAILED'} "
+              f"-> {args.json_out}")
+        if bad:
+            print(f"bad: {bad}")
+        return 0 if report["ok"] else 1
 
     if args.durability or args.driver:
         cells = _corruption_sweep(tables, args) if args.durability else []
