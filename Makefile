@@ -8,14 +8,14 @@
 PYENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
 .PHONY: check check-fast check-faults check-supervisor check-trace \
-	check-durability check-dist-obs \
+	check-durability check-dist-obs check-network \
 	check-pipeline check-pipeline-soak check-perf check-perf-update \
 	check-obs check-history check-lint check-service check-doctor \
 	check-flight check-executors test test-fast validate validate-fast warm
 
 check: check-lint test validate check-perf check-history check-service \
 	check-doctor check-flight check-executors check-durability \
-	check-dist-obs
+	check-dist-obs check-network
 	@echo "CHECK OK — safe to commit"
 
 # Static invariant gate (tools/blazelint): lock discipline, knob
@@ -189,6 +189,22 @@ check-durability:
 check-dist-obs:
 	$(PYENV) python tools/chaos_soak.py --dist-obs \
 	  --json-out DIST_OBS_r18.json
+
+# Partition-tolerance gate (ISSUE 15): every net.* wire-fault cell
+# (delay / reset / blackhole / torn frame / duplicate delivery at the
+# control channel, shuffle fetch, and telemetry paths) armed under a
+# live 2-seat pool must answer oracle-equal with zero executor deaths
+# and zero leaks; a transient control-socket reset must reconnect +
+# resume (capacity untouched, no executor_death dossier, a
+# control_reconnect trace event); an asymmetric partition held past
+# executor_death_ms must cut exactly ONE dossier while the worker's
+# lease expires and it self-fences (exit 17); and a rolling SIGTERM
+# drain/restart of every seat under concurrent service load must lose
+# zero queries with zero drain-attributed requeues. Emits
+# NETWORK_r19.json.
+check-network:
+	$(PYENV) python tools/chaos_soak.py --network \
+	  --json-out NETWORK_r19.json
 
 # Pre-warm the persistent compile caches (runtime/compile_service):
 # replays the shape manifest + the TPC-DS catalogue into the XLA cache.

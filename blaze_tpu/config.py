@@ -368,6 +368,25 @@ _DECLARATIONS: Tuple[Knob, ...] = (
              "rebased onto the driver's before trace federation). An "
              "estimate outside +-bound is clamped so one bad echo "
              "cannot scramble merged-trace ordering."),
+    Knob("control_reconnect_max", 4,
+         doc="Bounded reconnect attempts a worker makes after a control-"
+             "socket transport error before treating the driver as "
+             "unreachable (the lease then governs self-fencing). The "
+             "driver keeps a broken-but-alive seat's tasks in flight "
+             "while it waits for the resume handshake, bounded by "
+             "executor_death_ms."),
+    Knob("control_reconnect_backoff_ms", 50,
+         doc="Base backoff before worker reconnect attempt i "
+             "(~backoff * 2^i, jittered) after a control-socket error; "
+             "the resume handshake re-delivers unacked TaskSpecs and "
+             "results, deduped by (task_id, attempt, epoch)."),
+    Knob("executor_drain_grace_ms", 5000,
+         doc="Graceful-decommission budget: a draining executor "
+             "(ExecutorPool.decommission or SIGTERM) finishes in-flight "
+             "tasks for up to this long, flushes its telemetry sidecar, "
+             "hands registered shuffle rids back, then exits. In-flight "
+             "work still unfinished at expiry is requeued without an "
+             "executor_death dossier."),
 
     # -- durable execution (runtime/artifacts.py, runtime/journal.py) --
     Knob("artifact_checksums", True,

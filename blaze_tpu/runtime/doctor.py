@@ -478,6 +478,32 @@ def diagnose(record: dict,
             "only after fixing the underlying fault",
             {"breaker_trips": trips, "degrades": degrades}))
 
+    # network_flaky: the control/shuffle transport misbehaved during the
+    # run — reconnects, suspected partitions, dropped shuffle conns or a
+    # lease-expired self-fence. Each blip was absorbed (that is the
+    # contract), but a recurring pattern means the wire, not the query,
+    # is the problem; rank by how noisy it was.
+    reconnects = resil.get("control_reconnect", 0)
+    partitions = resil.get("partition_suspected", 0)
+    conn_drops = resil.get("shuffle_conn_dropped", 0)
+    fences = resil.get("lease_expired", 0)
+    net_noise = reconnects + partitions + conn_drops + fences
+    if net_noise:
+        findings.append(Finding(
+            "network_flaky", min(0.2 + 0.1 * net_noise, 0.9),
+            f"transport flapped {net_noise} time(s): "
+            f"{reconnects} control reconnect(s), "
+            f"{partitions} suspected partition(s), "
+            f"{conn_drops} dropped shuffle conn(s), "
+            f"{fences} lease fence(s)",
+            "check the host's socket/FD pressure; raise "
+            "conf.control_reconnect_max for flakier links, or "
+            "conf.executor_death_ms if partitions out-live the lease",
+            {"control_reconnects": reconnects,
+             "partitions_suspected": partitions,
+             "shuffle_conns_dropped": conn_drops,
+             "lease_fences": fences}))
+
     # pipeline_underlap: pool-side production not hidden behind compute
     busy = wait = 0.0
     for e in recs:

@@ -73,12 +73,14 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import signal
 import socket
 import subprocess
 import sys
 import tempfile
 import threading
 import time
+from collections import OrderedDict
 from typing import Callable, Dict, List, Optional
 
 from blaze_tpu.config import KNOBS, conf
@@ -174,6 +176,25 @@ class ExecutorHandle:
         self.inflight: Dict[str, _PoolTask] = {}  # guarded by pool lock
         self.dead = False                         # guarded by pool lock
         self.closing = False
+        # partition tolerance (guarded by pool lock): conn_broken marks
+        # a transport error on a seat whose PROCESS is still alive — the
+        # seat keeps its in-flight tasks and waits for the worker's
+        # resume handshake, bounded by the watchdog's heartbeat
+        # staleness (executor_death_ms). draining marks a seat finishing
+        # in-flight work before a graceful exit; drained marks the drain
+        # completed (seat removed without a death).
+        self.conn_broken = False
+        self.draining = False
+        # drain barrier (guarded by send_lock, NOT the pool lock): set
+        # just before the drain_ack frame goes on the wire. A dispatch
+        # that acquires send_lock and finds it set must NOT send — the
+        # worker may sample idle and exit the moment it reads the ack,
+        # and the control socket is FIFO, so anything sent after the
+        # ack can be lost without a requeue signal.
+        self.drain_acked = False
+        self.drained = False
+        self.decommissioned = False
+        self.reconnects = 0
         self.joined_at = time.monotonic()
         self.last_beat = self.joined_at
         # telemetry federation state (guarded by pool lock):
@@ -237,6 +258,10 @@ class ExecutorPool:
         self._awaiting: Dict[str, tuple] = {}  # token -> (seat, gen, proc)
         self._queue: List[_PoolTask] = []
         self._running: Dict[str, _PoolTask] = {}
+        # task key -> winning attempt epoch, recorded at completion:
+        # lets _on_result tell a re-delivered duplicate of the winner
+        # (files are LIVE — keep) from a zombie's stale epoch (sweep)
+        self._done_epochs: "OrderedDict[str, int]" = OrderedDict()
         self._seat_restarts: Dict[int, int] = {}
         self._respawns_pending = 0
         self._membership_cbs: List[Callable[["ExecutorPool"], None]] = []
@@ -245,6 +270,12 @@ class ExecutorPool:
         self._threads: List[threading.Thread] = []
         self.deaths_total = 0
         self.restarts_total = 0
+        self.reconnects_total = 0
+        self.drains_total = 0
+        # tasks a drain's grace period cut off (requeued, no death
+        # budget). The rolling-restart gate demands this stays 0: a
+        # graceful drain must FINISH its in-flight work, not shed it.
+        self.drain_requeues_total = 0
         self.tasks_done = 0
         self.telemetry_bytes_total = 0
         self.telemetry_records_total = 0
@@ -331,6 +362,9 @@ class ExecutorPool:
             return
         conn.settimeout(None)
         token = msg.get("token", "")
+        if msg.get("type") == "hello" and msg.get("resume"):
+            self._resume(conn, token, msg)
+            return
         with self._cv:
             pending = self._awaiting.pop(token, None)
         if msg.get("type") != "hello" or pending is None:
@@ -358,49 +392,187 @@ class ExecutorPool:
             return
         self.watchdog.register(
             token, handle.pid,
-            lambda peer, reason, rc, h=handle: self._declare_dead(
-                h, reason, rc, emit_event=False),
+            lambda peer, reason, rc, h=handle: self._on_peer_death(
+                h, reason, rc),
             poll=proc.poll)
-        t = threading.Thread(target=self._reader, args=(handle,),
+        t = threading.Thread(target=self._reader, args=(handle, conn),
                              name=f"blz-pool-rd-{seat}", daemon=True)
         t.start()
         self._threads.append(t)
         self._notify_membership()
 
+    def _resume(self, conn: socket.socket, token: str, msg: dict) -> None:
+        """Session-resume handshake: a worker that survived a control-
+        socket transport error reconnects with its token; the driver
+        swaps the connection under the SAME handle (generation, epoch
+        fence, telemetry watermark all continue) and re-sends every
+        in-flight TaskSpec — the worker dedupes re-delivered specs by
+        (task_id, epoch) and replies from its result cache for any it
+        already finished. A blip costs a retry, not a seat."""
+        from blaze_tpu.runtime import trace
+
+        with self._cv:
+            handle = next((h for h in self._seats.values()
+                           if h.token == token and not h.dead), None)
+            if handle is None or self._closed:
+                handle = None
+            else:
+                old = handle.conn
+                handle.conn = conn
+                handle.conn_broken = False
+                handle.last_beat = time.monotonic()
+                handle.reconnects += 1
+                self.reconnects_total += 1
+                inflight = list(handle.inflight.values())
+                self._cv.notify_all()
+        if handle is None:
+            # the seat was already declared dead (or the pool closed):
+            # refusing the resume makes the worker's lease the authority
+            conn.close()
+            return
+        try:
+            old.close()
+        except OSError:
+            pass
+        self.watchdog.beat(token)
+        mono = msg.get("mono_ns")
+        if mono is not None:
+            cand = _clamp_offset(time.monotonic_ns() - int(mono))
+            if cand < handle.clock_offset_ns:
+                handle.clock_offset_ns = cand
+        trace.event("control_reconnect", exec_id=handle.exec_id,
+                    generation=handle.generation,
+                    reconnects=handle.reconnects,
+                    resent_tasks=len(inflight),
+                    worker_tel_seq=int(msg.get("tel_seq", 0)))
+        for task in inflight:
+            header = {"type": "task", "task": task.spec.key,
+                      "epoch": task.epoch, "kind": task.spec.kind,
+                      "payload": task.spec.payload}
+            try:
+                ss.send_msg(conn, header, task.spec.blob,
+                            lock=handle.send_lock)
+            except (ConnectionError, OSError):
+                self._conn_broken(handle, conn, "resume_send")
+                return
+        if handle.draining:
+            # a decommission issued while the conn was broken never
+            # reached the worker: re-deliver the drain order
+            try:
+                ss.send_msg(conn, {"type": "drain"},
+                            lock=handle.send_lock)
+            except (ConnectionError, OSError):
+                self._conn_broken(handle, conn, "resume_send")
+                return
+        t = threading.Thread(target=self._reader, args=(handle, conn),
+                             name=f"blz-pool-rd-{handle.seat}", daemon=True)
+        t.start()
+        self._threads.append(t)
+
     # -- socket reader -------------------------------------------------
 
-    def _reader(self, handle: ExecutorHandle) -> None:
-        """Per-executor inbound loop. Keeps reading a heartbeat-declared
-        zombie's socket so its late results arrive — and get fenced —
-        instead of rotting in the kernel buffer."""
+    def _reader(self, handle: ExecutorHandle, conn: socket.socket) -> None:
+        """Per-executor inbound loop (one per CONNECTION — a resume
+        starts a fresh reader on the new socket). Keeps reading a
+        heartbeat-declared zombie's socket so its late results arrive —
+        and get fenced — instead of rotting in the kernel buffer."""
         while True:
+            rule = ss.net_rule("net.control.recv")
             try:
-                msg, _blob = ss.recv_msg(handle.conn)
+                msg, _blob = ss.recv_msg(conn, net_fault=rule)
             except (ConnectionError, OSError):
                 break
             handle.last_beat = time.monotonic()
             self.watchdog.beat(handle.token)
-            mtype = msg.get("type")
-            if mtype == "result":
-                self._on_result(handle, msg)
-            elif mtype == "telemetry":
-                self._on_telemetry(handle, msg)
+            # "dup" at the recv point is a delivery property: the frame
+            # arrives once, the message is processed twice — result and
+            # telemetry dedup (epoch fence / running-map / seq
+            # watermark) must absorb the double delivery
+            for _ in range(2 if rule and rule.get("kind") == "dup" else 1):
+                mtype = msg.get("type")
+                if mtype == "result":
+                    self._on_result(handle, msg)
+                elif mtype == "telemetry":
+                    self._on_telemetry(handle, msg)
+                elif mtype == "draining":
+                    self._on_draining(handle)
+                elif mtype == "drained":
+                    self._finish_drain(handle, msg)
         if not handle.closing:
-            # EOF before shutdown: the process died (or is dying) — don't
-            # wait the heartbeat staleness out
-            self._declare_dead(handle, "exit",
-                               handle.proc.poll() if handle.proc else None)
+            self._conn_broken(handle, conn, "recv")
+
+    def _conn_broken(self, handle: ExecutorHandle, conn: socket.socket,
+                     why: str) -> None:
+        """Transport error triage: distinguish a BROKEN CONNECTION from a
+        DEAD PROCESS before burning the seat. A reaped pid (or already-
+        stale heartbeat) is a death; a draining seat's EOF is the drain
+        completing; otherwise the seat enters conn_broken limbo — tasks
+        stay in flight awaiting the worker's resume handshake, and the
+        still-registered watchdog turns unresumed limbo into a heartbeat
+        death after executor_death_ms."""
+        from blaze_tpu.runtime import trace
+
+        with self._cv:
+            if handle.dead or self._closed or handle.conn is not conn:
+                return  # already buried / resumed onto a newer socket
+            draining = handle.draining
+        rc = handle.proc.poll() if handle.proc else None
+        if draining:
+            # a draining worker exits after its "drained" frame; EOF
+            # (or a crash mid-drain, caught by rc below) ends the drain
+            if rc is None or rc == 0:
+                self._finish_drain(handle, {})
+            else:
+                self._declare_dead(handle, "exit", rc)
+            return
+        if rc is not None:
+            self._declare_dead(handle, "exit", rc)
+            return
+        stale_ms = (time.monotonic() - handle.last_beat) * 1000.0
+        if stale_ms > max(int(conf.executor_death_ms), 1):
+            self._declare_dead(handle, "heartbeat", None)
+            return
+        with self._cv:
+            if handle.dead or handle.conn is not conn:
+                return
+            handle.conn_broken = True
+            self._cv.notify_all()
+        trace.event("partition_suspected", exec_id=handle.exec_id,
+                    why=why, pid=handle.pid,
+                    heartbeat_age_ms=round(stale_ms))
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def _on_peer_death(self, handle: ExecutorHandle, reason: str,
+                       rc: Optional[int]) -> None:
+        """Watchdog callback: route a clean exit of a DRAINING worker to
+        drain completion (no dossier, no death accounting); everything
+        else is a real death."""
+        if reason == "drained" or (handle.draining and reason == "exit"
+                                   and (rc == 0 or rc is None)):
+            self._finish_drain(handle, {})
+            return
+        self._declare_dead(handle, reason, rc, emit_event=False)
 
     def _on_result(self, handle: ExecutorHandle, msg: dict) -> None:
         from blaze_tpu.runtime import artifacts
 
         key, epoch = msg.get("task", ""), int(msg.get("epoch", 0))
         if not self.fence.admit(key, epoch):
-            # the zombie's late write: reject the result and sweep its
-            # stale-named files; the ledger never sees it
-            for p in (msg.get("data_path"), msg.get("index_path")):
-                if p and artifacts.epoch_of(p) == epoch:
-                    artifacts._unlink_quiet(p)
+            # Rejected result: a ZOMBIE's stale-epoch files are losers
+            # and must be swept — but a duplicate of the WINNER's reply
+            # (the resume handshake re-delivers unacked results, and the
+            # fence forgets keys at batch teardown) names the LIVE
+            # committed artifacts a downstream read may be consuming.
+            # The done-epoch ledger tells them apart.
+            with self._cv:
+                winner = self._done_epochs.get(key)
+            if winner != epoch:
+                for p in (msg.get("data_path"), msg.get("index_path")):
+                    if p and artifacts.epoch_of(p) == epoch:
+                        artifacts._unlink_quiet(p)
             return
         with self._cv:
             task = self._running.get(key)
@@ -412,6 +584,11 @@ class ExecutorPool:
                 task.state, task.result = "done", msg
                 self.tasks_done += 1
                 handle.tasks_done += 1
+                # remember the winning epoch so late duplicates of this
+                # very result are not mistaken for zombies (bounded)
+                self._done_epochs[key] = epoch
+                while len(self._done_epochs) > 4096:
+                    self._done_epochs.popitem(last=False)
             else:
                 self._handle_task_failure_locked(task, msg)
             self._cv.notify_all()
@@ -427,6 +604,23 @@ class ExecutorPool:
         too would double-count it. The batch seq watermark makes the
         sidecar recovery idempotent in the other direction (a sidecar
         whose batch already arrived over the socket is skipped)."""
+        rule = ss.net_rule("net.telemetry")
+        if rule:
+            kind = rule.get("kind")
+            if kind == "delay":
+                time.sleep(float(rule.get("ms", 25)) / 1000.0)
+            elif kind in ("reset", "blackhole", "torn"):
+                # batch lost in transit: the worker's sidecar spill and
+                # death-time recovery cover the gap — dropping telemetry
+                # must never corrupt answers, only delay observability
+                return
+            # "dup": ingest twice below — the seq watermark must reject
+            # the second copy
+        for _ in range(2 if rule and rule.get("kind") == "dup" else 1):
+            self._on_telemetry_inner(handle, msg)
+
+    def _on_telemetry_inner(self, handle: ExecutorHandle,
+                            msg: dict) -> None:
         with self._cv:
             if handle.dead or self._closed:
                 return
@@ -639,6 +833,129 @@ class ExecutorPool:
         self.restarts_total += 1
         self._spawn(seat, generation)
 
+    # -- graceful decommission -----------------------------------------
+
+    def decommission(self, seat: int) -> bool:
+        """Driver-initiated graceful drain of one seat: the worker
+        finishes its in-flight tasks (bounded by
+        conf.executor_drain_grace_ms), flushes its telemetry sidecar and
+        exits; the seat leaves capacity immediately but fires no
+        executor_death. The seat is NOT respawned — decommission removes
+        it (SIGTERM-initiated drains respawn, for rolling restarts)."""
+        from blaze_tpu.runtime import trace
+
+        with self._cv:
+            handle = self._seats.get(seat)
+            if (handle is None or handle.dead or handle.draining
+                    or self._closed):
+                return False
+            handle.draining = True
+            handle.decommissioned = True
+            self._cv.notify_all()
+        self.watchdog.mark_draining(handle.token)
+        trace.event("executor_drain", exec_id=handle.exec_id,
+                    phase="begin", initiator="decommission",
+                    inflight=len(handle.inflight))
+        self._notify_membership()  # draining seats leave capacity now
+        try:
+            ss.send_msg(handle.conn, {"type": "drain"},
+                        lock=handle.send_lock)
+        except (ConnectionError, OSError):
+            self._conn_broken(handle, handle.conn, "drain_send")
+        return True
+
+    def _on_draining(self, handle: ExecutorHandle) -> None:
+        """Worker announced drain mode (SIGTERM delivered out-of-band,
+        or echoing the driver's own drain order): mirror the
+        decommission bookkeeping so the seat leaves capacity without a
+        death — but respawn it once drained (a rolling restart wants
+        the seat back). Then ack on the FIFO control socket: the ack
+        is the drain BARRIER. A dispatch already holding send_lock
+        lands its spec BEFORE the ack; once the flag is up no further
+        spec may follow it, and the worker only samples idleness after
+        reading the ack — so no spec can slip into a seat that is
+        about to exit and get silently requeued."""
+        from blaze_tpu.runtime import trace
+
+        with self._cv:
+            if handle.dead or self._closed:
+                return
+            first = not handle.draining
+            handle.draining = True
+            self._cv.notify_all()
+        if first:
+            self.watchdog.mark_draining(handle.token)
+            trace.event("executor_drain", exec_id=handle.exec_id,
+                        phase="begin", initiator="sigterm",
+                        inflight=len(handle.inflight))
+        with handle.send_lock:
+            acked, handle.drain_acked = handle.drain_acked, True
+            if not acked:
+                try:
+                    ss.send_msg(handle.conn, {"type": "drain_ack"})
+                except (ConnectionError, OSError):
+                    pass  # broken conn: drain completes via EOF triage
+        if first:
+            self._notify_membership()
+
+    def _finish_drain(self, handle: ExecutorHandle, msg: dict) -> None:
+        """Drain completed (the worker's "drained" frame, its clean exit
+        or its EOF): retire the seat with NO dossier and NO death
+        accounting; re-queue any in-flight leftovers the grace period
+        cut off (cause executor_drain — they consume no death budget)."""
+        from blaze_tpu.runtime import trace
+
+        now = time.monotonic()
+        with self._cv:
+            if handle.dead or self._closed:
+                return
+            handle.dead = True
+            handle.drained = True
+            self.drains_total += 1
+            self.drain_requeues_total += len(handle.inflight)
+            leftovers = list(handle.inflight.values())
+            handle.inflight.clear()
+            for task in leftovers:
+                self._running.pop(task.spec.key, None)
+                task.epoch = self.fence.advance(task.spec.key)
+                task.not_before = now
+                task.state = "queued"
+                task.executor = None
+                self._queue.append(task)
+            if self._seats.get(handle.seat) is handle:
+                del self._seats[handle.seat]
+            self._graveyard.append(handle)
+            respawn = not handle.decommissioned
+            if respawn:
+                self._respawns_pending += 1
+            self._cv.notify_all()
+        self.watchdog.unregister(handle.token)
+        for task in leftovers:
+            trace.event("executor_task_requeued", task=task.spec.key,
+                        cause="executor_drain", epoch=task.epoch)
+        self._recover_sidecar(handle)
+        trace.event("executor_drain", exec_id=handle.exec_id,
+                    phase="complete", initiator=("decommission"
+                                                 if handle.decommissioned
+                                                 else "sigterm"),
+                    requeued=len(leftovers),
+                    rids_returned=len(msg.get("rids") or []))
+        self._notify_membership()
+        if respawn:
+            threading.Thread(
+                target=self._respawn_drained,
+                args=(handle.seat, handle.generation + 1),
+                name="blz-pool-redrain", daemon=True).start()
+
+    def _respawn_drained(self, seat: int, generation: int) -> None:
+        """Replace a SIGTERM-drained seat (rolling restart): no backoff,
+        no restart-budget charge — the drain was orderly, not a death."""
+        with self._cv:
+            self._respawns_pending -= 1
+            if self._closed:
+                return
+        self._spawn(seat, generation)
+
     # -- membership / capacity -----------------------------------------
 
     def on_membership(self, cb: Callable[["ExecutorPool"], None]) -> None:
@@ -662,13 +979,23 @@ class ExecutorPool:
         return len(self.live_handles())
 
     def capacity(self) -> int:
-        return self.live_count() * self.slots
+        """Admission capacity: serving (live, non-draining) seats x
+        slots. A draining seat finishes its in-flight work but accepts
+        no new dispatch, so it leaves capacity the moment the drain
+        begins — without firing executor_death."""
+        with self._lock:
+            serving = sum(1 for h in self._seats.values()
+                          if not h.dead and not h.draining)
+        return serving * self.slots
 
     def executors(self) -> List[dict]:
         now = time.monotonic()
         with self._lock:
             return [{"exec_id": h.exec_id, "pid": h.pid,
                      "generation": h.generation, "up": not h.dead,
+                     "draining": h.draining,
+                     "conn_broken": h.conn_broken,
+                     "reconnects": h.reconnects,
                      "inflight": len(h.inflight),
                      "heartbeat_age_ms": round(
                          (now - h.last_beat) * 1000),
@@ -682,15 +1009,26 @@ class ExecutorPool:
     def stats(self) -> dict:
         with self._lock:
             live = sum(1 for h in self._seats.values() if not h.dead)
+            draining = sum(1 for h in self._seats.values()
+                           if not h.dead and h.draining)
             inflight = sum(len(h.inflight) for h in self._seats.values())
             deaths, restarts = self.deaths_total, self.restarts_total
+            reconnects, drains = self.reconnects_total, self.drains_total
+            drain_requeues = self.drain_requeues_total
             done = self.tasks_done
             tel_bytes = self.telemetry_bytes_total
             tel_records = self.telemetry_records_total
+            shuffle_dropped = self.server.conns_dropped
         return {"count": self.count, "live": live,
-                "capacity": live * self.slots, "slots": self.slots,
+                "draining": draining,
+                "capacity": (live - draining) * self.slots,
+                "slots": self.slots,
                 "inflight": inflight, "deaths_total": deaths,
                 "restarts_total": restarts,
+                "reconnects_total": reconnects,
+                "drains_total": drains,
+                "drain_requeues_total": drain_requeues,
+                "shuffle_conns_dropped": shuffle_dropped,
                 "fenced_total": self.fence.fenced_total,
                 "tasks_done": done,
                 "telemetry_bytes_total": tel_bytes,
@@ -700,8 +1038,11 @@ class ExecutorPool:
 
     def _pick_locked(self) -> Optional[tuple]:
         now = time.monotonic()
+        # conn_broken seats keep their in-flight tasks (awaiting resume)
+        # but take no NEW work; draining seats reject all new dispatch
         handles = [h for h in self._seats.values()
-                   if not h.dead and len(h.inflight) < self.slots]
+                   if not h.dead and not h.conn_broken and not h.draining
+                   and len(h.inflight) < self.slots]
         if not handles:
             return None
         for i, task in enumerate(self._queue):
@@ -730,15 +1071,35 @@ class ExecutorPool:
             header = {"type": "task", "task": task.spec.key,
                       "epoch": task.epoch, "kind": task.spec.kind,
                       "payload": task.spec.payload}
+            conn = handle.conn
             try:
-                ss.send_msg(handle.conn, header, task.spec.blob,
-                            lock=handle.send_lock)
+                with handle.send_lock:
+                    if handle.drain_acked:
+                        # the drain barrier closed between pick and
+                        # send: the ack is already on the wire, so this
+                        # spec must not follow it (the worker may
+                        # sample idle and exit any moment). Un-assign
+                        # silently — the spec was never sent, so no
+                        # epoch advance and no drain-requeue count.
+                        with self._cv:
+                            handle.inflight.pop(task.spec.key, None)
+                            self._running.pop(task.spec.key, None)
+                            task.state = "queued"
+                            task.executor = None
+                            self._queue.insert(0, task)
+                            self._cv.notify_all()
+                        continue
+                    ss.send_msg(conn, header, task.spec.blob,
+                                net_fault=ss.net_rule(
+                                    "net.control.send"))
             except (ConnectionError, OSError):
-                # broken pipe: the executor is gone; death handling
-                # re-queues this task (it is in handle.inflight)
-                self._declare_dead(handle, "send_error",
-                                   handle.proc.poll() if handle.proc
-                                   else None)
+                # broken pipe: triage connection-broken vs process-dead.
+                # Either way the task is safe — it sits in
+                # handle.inflight, re-sent on resume or re-queued on
+                # death. (If the conn was swapped by a concurrent
+                # resume, the resume already re-sent the inflight set,
+                # this task included.)
+                self._conn_broken(handle, conn, "send")
 
     # -- public task API -----------------------------------------------
 
@@ -827,6 +1188,47 @@ class ExecutorPool:
             return True
         except (ConnectionError, OSError):
             return False
+
+    def partition_executor(self, seat: int, ms: int) -> bool:
+        """Simulate an ASYMMETRIC partition for `ms`: the worker keeps
+        running but every worker->driver send fails (beats, results,
+        telemetry, reconnect attempts) while driver->worker delivery
+        still works. Past executor_death_ms the driver declares a
+        heartbeat death (fencing the epoch) and the worker's lease
+        expires (self-fence, exit code 17) — the two ends of the
+        partition-tolerance contract, exercised deterministically."""
+        with self._lock:
+            handle = self._seats.get(seat)
+        if handle is None or handle.dead:
+            return False
+        try:
+            ss.send_msg(handle.conn, {"type": "partition",
+                                      "ms": int(ms)},
+                        lock=handle.send_lock)
+            return True
+        except (ConnectionError, OSError):
+            return False
+
+    def break_conn(self, seat: int) -> bool:
+        """Sever one seat's control connection driver-side (transport
+        blip, process untouched): the reader's EOF routes through
+        _conn_broken and the worker's bounded reconnect + resume
+        handshake must restore the seat without a death."""
+        with self._lock:
+            handle = self._seats.get(seat)
+        if handle is None or handle.dead:
+            return False
+        try:
+            # shutdown wakes BOTH ends' blocked reads immediately (a
+            # bare close only errors future calls on this fd)
+            handle.conn.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            handle.conn.close()
+        except OSError:
+            return False
+        return True
 
     def pids(self) -> Dict[int, int]:
         with self._lock:
@@ -981,6 +1383,10 @@ class _Worker:
     at conf.executor_slots); heavy engine imports are deferred to the
     first plan task so protocol-only workers stay cheap."""
 
+    # self-fence exit code: dossiers/logs distinguish "lease expired,
+    # aborted my own work" from crashes and clean exits
+    _LEASE_EXIT = 17
+
     def __init__(self) -> None:
         self.token = os.environ[_ENV_TOKEN]
         self.ctl_path = os.environ[_ENV_CTL]
@@ -991,6 +1397,32 @@ class _Worker:
         # hang fault (chaos): beats stop and outbound sends stall until
         # this monotonic instant — the process neither exits nor beats
         self.hang_until = 0.0
+        # asymmetric-partition fault (chaos): every outbound send raises
+        # until this instant, while inbound delivery still works — the
+        # deterministic trigger for lease-expiry self-fencing
+        self.partition_until = 0.0
+        # the lease: monotonic time of the last send that REACHED the
+        # driver. No successful send for executor_death_ms means the
+        # driver has (or will have) declared us dead and fenced our
+        # epoch — commit nothing more, serve nothing stale, exit.
+        self._lease_at = time.monotonic()
+        # reentrant: _reconnect holds it across the retry ladder and
+        # re-enters for _lease_deadline; it also guards sock/_lease_at
+        # swaps so senders always read the freshest connection
+        self._reconn_lock = threading.RLock()
+        # resume-handshake dedupe: (task, epoch) currently executing,
+        # plus a bounded cache of finished replies so a re-delivered
+        # TaskSpec is answered from cache instead of re-executed
+        self._task_lock = threading.Lock()
+        self._task_running: set = set()
+        self._task_done: "OrderedDict" = OrderedDict()
+        self._draining = False
+        # drain barrier: set when the driver's drain_ack frame arrives.
+        # The control socket is FIFO, so once the reader has processed
+        # the ack, every spec dispatched before the driver marked this
+        # seat draining is already in _task_running — only then may
+        # the drain sample idleness and exit.
+        self._drain_ack = threading.Event()
         self._client: Optional[ss.ShuffleClient] = None
         self._client_lock = threading.Lock()
         self._rid_refs: Dict[str, int] = {}
@@ -1015,24 +1447,128 @@ class _Worker:
             # a hung executor's results arrive LATE — after the driver
             # declared it dead and fenced its epoch
             time.sleep(wait)
-        ss.send_msg(self.sock, header, blob, lock=self.send_lock)
+        if time.monotonic() < self.partition_until:
+            raise ConnectionError("partitioned (injected): driver "
+                                  "unreachable")
+        with self._reconn_lock:
+            cur = self.sock
+        ss.send_msg(cur, header, blob, lock=self.send_lock)
+        with self._reconn_lock:
+            self._lease_at = time.monotonic()
+
+    # -- lease / reconnect / self-fence --------------------------------
+
+    def _lease_deadline(self) -> float:
+        """The lease expires executor_death_ms after the last send that
+        reached the driver — mirroring the driver's heartbeat-staleness
+        clock, so both ends give up on the SAME schedule. A hang (chaos)
+        extends the lease to hang end: a truly wedged process could not
+        run lease logic either, and the late-result zombie path must
+        stay reachable for the driver-side fence to be tested."""
+        death_s = max(int(conf.executor_death_ms), 1) / 1000.0
+        with self._reconn_lock:
+            lease_at = self._lease_at
+        return max(lease_at, self.hang_until) + death_s
+
+    def _self_fence(self, why: str) -> None:
+        """Lease expired (or the control channel is unrecoverable):
+        abort in-flight attempts, stop committing/serving, and exit with
+        the fence code. The driver has fenced our epoch by now — any
+        work we finished would be rejected anyway; dying fast wastes no
+        compute and can never serve a stale read. The unshipped
+        telemetry tail is spilled (not shipped — the driver is
+        unreachable) so the death dossier recovers it."""
+        from blaze_tpu.runtime import trace
+
+        with self._reconn_lock:
+            lease_at = self._lease_at
+        try:
+            trace.event("lease_expired", exec_id=self.token, why=why,
+                        lease_age_ms=round(
+                            (time.monotonic() - lease_at) * 1000))
+        except Exception:  # noqa: BLE001 — fencing must not fail
+            pass
+        try:
+            self._flush_telemetry(ship=False)
+        except Exception:  # noqa: BLE001
+            pass
+        self.stop.set()
+        os._exit(self._LEASE_EXIT)
+
+    def _reconnect(self, broken: Optional[socket.socket]) -> bool:
+        """Bounded reconnect-and-resume after a transport error: a fast
+        exponential ladder (conf.control_reconnect_max attempts, base
+        conf.control_reconnect_backoff_ms), then slow probes until the
+        LEASE decides. Returns True with self.sock swapped to the
+        resumed connection, False when the lease expired first (the
+        caller self-fences). The resume hello carries the token, pid and
+        telemetry watermark; the driver re-sends our in-flight TaskSpecs
+        which the dedupe cache absorbs."""
+        with self._reconn_lock:
+            if self.sock is not broken:
+                return True  # another thread already resumed
+            if self.stop.is_set():
+                return False
+            base = max(int(conf.control_reconnect_backoff_ms), 1) / 1000.0
+            max_att = max(int(conf.control_reconnect_max), 1)
+            attempt = 0
+            while not self.stop.is_set():
+                left = self._lease_deadline() - time.monotonic()
+                if left <= 0:
+                    return False
+                delay = base * (2 ** min(attempt, max_att))
+                time.sleep(min(delay, max(left, 0.001), 0.5))
+                attempt += 1
+                if time.monotonic() < self.partition_until:
+                    continue  # injected partition: stay unreachable
+                s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                try:
+                    s.connect(self.ctl_path)
+                    ss.send_msg(s, {"type": "hello", "resume": True,
+                                    "token": self.token,
+                                    "pid": os.getpid(),
+                                    "tel_seq": self._tel_seq,
+                                    "mono_ns": time.monotonic_ns()})
+                except OSError:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+                    continue
+                old, self.sock = self.sock, s
+                try:
+                    old.close()
+                except OSError:
+                    pass
+                self._lease_at = time.monotonic()
+                return True
+            return False
 
     def _beat_loop(self) -> None:
         period = max(int(conf.executor_heartbeat_ms), 10) / 1000.0
         while not self.stop.wait(period):
-            if time.monotonic() < self.hang_until:
+            now = time.monotonic()
+            if now < self.hang_until:
                 continue  # hung: silence, but stay alive
+            if now < self.partition_until:
+                # asymmetric partition: outbound is gone, the lease is
+                # the only authority left on this side
+                if now > self._lease_deadline():
+                    self._self_fence("partition")
+                continue
+            with self._reconn_lock:
+                cur = self.sock
             try:
-                ss.send_msg(self.sock, {"type": "beat"},
-                            lock=self.send_lock)
+                ss.send_msg(cur, {"type": "beat"}, lock=self.send_lock)
+                with self._reconn_lock:
+                    self._lease_at = time.monotonic()
             except (ConnectionError, OSError):
-                # driver gone: a leaderless executor must not linger
-                self.stop.set()
-                os._exit(0)
+                if not self._reconnect(cur):
+                    self._self_fence("beat send failed, lease expired")
 
     # -- telemetry shipping --------------------------------------------
 
-    def _flush_telemetry(self) -> None:
+    def _flush_telemetry(self, ship: bool = True) -> None:
         """Stage the unshipped ring tail + counter/histogram deltas,
         spill them crash-atomically to the sidecar, then ship ONE
         batched "telemetry" frame. Ordering matters twice: the spill
@@ -1042,7 +1578,9 @@ class _Worker:
         so the driver merges this batch's counters before the stage
         span that reads them closes). A failed send keeps the batch
         pending — same seq, retried next tick — so the driver's seq
-        watermark stays exactly-once."""
+        watermark stays exactly-once. ship=False spills WITHOUT
+        sending (the self-fence path: the driver is unreachable, but
+        the death dossier recovers the sidecar)."""
         from blaze_tpu.runtime import monitor, trace
 
         if not (conf.trace_enabled or conf.monitor_enabled):
@@ -1072,6 +1610,8 @@ class _Worker:
                 os.replace(tmp, self._sidecar)
             except OSError:
                 pass  # spill is best-effort; the socket ship still runs
+            if not ship:
+                return  # fence path: the spill is the delivery
             try:
                 self._send(doc)
             except (ConnectionError, OSError):
@@ -1228,33 +1768,109 @@ class _Worker:
         except BaseException as e:  # noqa: BLE001 — classified + relayed
             from blaze_tpu.runtime import faults
 
-            self._flush_telemetry()
-            try:
-                self._send({"type": "result", "task": key, "epoch": epoch,
-                            "ok": False, "category": faults.classify(e),
-                            "error": type(e).__name__,
-                            "message": str(e)[:500]})
-            except (ConnectionError, OSError):
-                pass
+            reply = {"type": "result", "task": key, "epoch": epoch,
+                     "ok": False, "category": faults.classify(e),
+                     "error": type(e).__name__,
+                     "message": str(e)[:500]}
+            self._finish_task(key, epoch, reply)
             return
-        # flush BEFORE the result: same socket, in-order processing, so
-        # the driver has this task's spans/counters federated before the
-        # stage span that reads them closes
-        self._flush_telemetry()
         reply = {"type": "result", "task": key, "epoch": epoch,
                  "ok": True}
         reply.update(result)
+        self._finish_task(key, epoch, reply)
+
+    def _finish_task(self, key: str, epoch: int, reply: dict) -> None:
+        """Cache the reply (resume-handshake dedupe: a re-delivered spec
+        is answered from here instead of re-executed), flush telemetry
+        BEFORE the result — same socket, in-order processing, so the
+        driver has this task's spans/counters federated before the
+        stage span that reads them closes — then send. A send that
+        fails is NOT a loss: the reply stays cached, and the driver's
+        resume handshake re-delivers the spec, which replays it."""
+        with self._task_lock:
+            self._task_running.discard((key, epoch))
+            self._task_done[(key, epoch)] = reply
+            while len(self._task_done) > 64:
+                self._task_done.popitem(last=False)
+        self._flush_telemetry()
         try:
             self._send(reply)
         except (ConnectionError, OSError):
             pass
+
+    def _dispatch_task(self, msg: dict, blob: bytes) -> None:
+        """Dedupe-by-(task_id, epoch) in front of execution: a spec
+        re-delivered by the resume handshake (or a dup-delivery wire
+        fault) executes ONCE — finished work replies from the result
+        cache, running work stays single-flight."""
+        key = (msg.get("task", ""), int(msg.get("epoch", 0)))
+        with self._task_lock:
+            cached = self._task_done.get(key)
+            if cached is None and key in self._task_running:
+                return  # already executing: its reply will cover this
+            if cached is None:
+                self._task_running.add(key)
+        if cached is not None:
+            try:
+                self._send(cached)
+            except (ConnectionError, OSError):
+                pass  # stays cached; the next re-delivery replays it
+            return
+        threading.Thread(target=self._run_task, args=(msg, blob),
+                         name="blz-wk-task", daemon=True).start()
+
+    def _begin_drain(self, initiator: str) -> None:
+        """Enter drain mode (driver's drain order or SIGTERM): announce
+        "draining" (so the driver reassigns capacity without a death),
+        finish in-flight tasks bounded by conf.executor_drain_grace_ms,
+        flush the telemetry sidecar, hand the registered shuffle rids
+        back, send "drained", exit 0."""
+        with self._task_lock:
+            if self._draining:
+                return
+            self._draining = True
+        try:
+            self._send({"type": "draining", "initiator": initiator})
+        except (ConnectionError, OSError):
+            pass  # the driver learns from our exit instead
+        threading.Thread(target=self._drain_and_exit,
+                         name="blz-wk-drain", daemon=True).start()
+
+    def _drain_and_exit(self) -> None:
+        grace = max(int(conf.executor_drain_grace_ms), 0) / 1000.0
+        # drain barrier: wait for the driver's ack before sampling
+        # idleness, so a spec the driver sent just before it marked us
+        # draining cannot land after the idle check and die with the
+        # process. Bounded: a broken conn (or a driver that never
+        # acks) must not wedge the drain.
+        self._drain_ack.wait(min(grace, 2.0))
+        deadline = time.monotonic() + grace
+        while time.monotonic() < deadline:
+            with self._task_lock:
+                idle = not self._task_running
+            if idle:
+                break
+            time.sleep(0.01)
+        try:
+            self._flush_telemetry()
+        except Exception:  # noqa: BLE001 — the drain must complete
+            pass
+        with self._rid_lock:
+            rids = sorted(self._rid_refs)
+        try:
+            self._send({"type": "drained", "rids": rids})
+        except (ConnectionError, OSError):
+            pass  # EOF tells the driver the same thing
+        self.stop.set()
+        os._exit(0)
 
     # -- main loop -----------------------------------------------------
 
     def run(self) -> int:
         sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         sock.connect(self.ctl_path)
-        self.sock = sock
+        with self._reconn_lock:
+            self.sock = sock
         ss.send_msg(sock, {"type": "hello", "token": self.token,
                            "pid": os.getpid(),
                            # clock echo: the driver estimates this
@@ -1269,21 +1885,34 @@ class _Worker:
         ship.start()
         try:
             while not self.stop.is_set():
+                with self._reconn_lock:
+                    cur = self.sock
                 try:
-                    msg, blob = ss.recv_msg(sock)
+                    msg, blob = ss.recv_msg(cur)
                 except (ConnectionError, OSError):
-                    break  # driver gone
+                    # transport error, not an order to die: bounded
+                    # reconnect + resume, self-fence once the lease says
+                    # the driver side has already buried us
+                    if self._reconnect(cur):
+                        continue
+                    self._self_fence("control recv failed, lease "
+                                     "expired")
+                    break
                 mtype = msg.get("type")
                 if mtype == "task":
-                    threading.Thread(target=self._run_task,
-                                     args=(msg, blob),
-                                     name="blz-wk-task",
-                                     daemon=True).start()
+                    self._dispatch_task(msg, blob)
                 elif mtype == "ping":
                     self._send({"type": "pong"})
                 elif mtype == "hang":
                     self.hang_until = (time.monotonic()
                                        + int(msg.get("ms", 0)) / 1000.0)
+                elif mtype == "partition":
+                    self.partition_until = (
+                        time.monotonic() + int(msg.get("ms", 0)) / 1000.0)
+                elif mtype == "drain":
+                    self._begin_drain("drain_msg")
+                elif mtype == "drain_ack":
+                    self._drain_ack.set()
                 elif mtype == "shutdown":
                     break
         finally:
@@ -1298,8 +1927,10 @@ class _Worker:
                 client, self._client = self._client, None
             if client is not None:
                 client.close()
+            with self._reconn_lock:
+                cur = self.sock
             try:
-                sock.close()
+                cur.close()
             except OSError:
                 pass
         return 0
@@ -1311,7 +1942,12 @@ def _worker_main() -> int:
         for name, value in json.loads(overrides).items():
             if name in KNOBS:
                 setattr(conf, name, value)
-    return _Worker().run()
+    worker = _Worker()
+    # SIGTERM is a decommission order, not a kill: drain in-flight work,
+    # flush telemetry, hand shuffle rids back, then exit 0.
+    signal.signal(signal.SIGTERM,
+                  lambda signum, frame: worker._begin_drain("sigterm"))
+    return worker.run()
 
 
 if __name__ == "__main__":

@@ -258,6 +258,26 @@ KNOWN_POINTS = (
     # queue. Serial (pipelining gated off) it fires inline instead —
     # armed specs without {"concurrent": true} disable the pipeline.
     "io.prefetch",
+    # network fault points (wire-level, fired through net_rule() at the
+    # socket boundary in runtime/shuffle_server.send_msg/recv_msg and
+    # the executor control channel — NOT through inject(), so the
+    # generic io/oom sweeps arm them to no effect; tools/chaos_soak.py
+    # --network sweeps them with the NET_KINDS below):
+    "net.control.send",    # driver -> executor control-socket sends
+    "net.control.recv",    # driver <- executor control-socket reads
+    "net.shuffle.fetch",   # shuffle server segment-reply path
+    "net.telemetry",       # executor telemetry-batch ingest
+)
+
+# wire-level fault kinds (net.* points only): applied AT the socket
+# operation instead of raising a taxonomy error — the transport layer
+# must absorb them (reconnect/resume, retry ladders, CRC detection).
+NET_KINDS = (
+    "delay",       # sleep rule "ms" (default 25) before the op
+    "reset",       # ConnectionResetError at the op
+    "blackhole",   # stall rule "ms" (default 2000), then drop the conn
+    "torn",        # partial write then reset / WireError on read
+    "dup",         # duplicate delivery of the frame/message
 )
 
 # corruption points (kind "corrupt" ONLY, fired through maybe_corrupt):
@@ -294,14 +314,23 @@ def install(spec: Optional[dict]) -> None:
 
 def reset() -> None:
     """Restart the injection schedule (counters/rngs/log) for the current
-    spec; same seed => bit-identical schedule on replay."""
+    spec; same seed => bit-identical schedule on replay. Also (un)arms
+    the wire-fault seam: shuffle_server.NET_HOOK points at net_rule only
+    while the spec arms a net.* point, so the disabled-path cost at the
+    socket layer is one module-global load."""
     with _sched_lock:
         _counters.clear()
         _rngs.clear()
         injection_log.clear()
-        seed = (conf.fault_injection_spec or {}).get("seed")
+        spec = conf.fault_injection_spec or {}
+        seed = spec.get("seed")
         if seed is not None:
             _rngs["__jitter__"] = random.Random(_mix(seed, "__jitter__"))
+    from blaze_tpu.runtime import shuffle_server
+
+    armed = any(p.startswith("net.")
+                for p in (spec.get("points") or {}))
+    shuffle_server.NET_HOOK = net_rule if armed else None
 
 
 def reset_telemetry() -> None:
@@ -384,6 +413,34 @@ def inject(point: str) -> None:
     exc.injected = True
     exc.point = point
     raise exc
+
+
+def net_rule(point: str) -> Optional[dict]:
+    """Decide whether a wire-level fault fires at net.* `point`; returns
+    the armed rule dict (kind/ms/...) for the transport layer to apply
+    at the exact socket operation, else None. Shares inject()'s
+    deterministic schedule (same seed => same wire chaos) but never
+    raises itself — delay/reset/blackhole/torn/dup are properties of
+    the wire, not taxonomy errors, so the socket layer enacts them.
+    Reaches the socket call sites through shuffle_server.NET_HOOK,
+    which reset() arms only while a spec targets a net.* point."""
+    spec = conf.fault_injection_spec
+    if not spec:
+        return None
+    points = spec.get("points")
+    if not points:
+        return None
+    key, rule = _rule_for(points, point)
+    if rule is None or rule.get("kind") not in NET_KINDS:
+        return None
+    fire, n = _schedule_fire(spec, point, key, rule)
+    if not fire:
+        return None
+    TELEMETRY.add("faults_injected", 1)
+    TELEMETRY.add(f"injected.{key}", 1)
+    trace.event("fault_injected", point=point, call=n,
+                fault_kind=rule.get("kind"))
+    return dict(rule)
 
 
 def _stall(point: str, n: int, rule: dict) -> None:

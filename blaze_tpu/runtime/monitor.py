@@ -585,6 +585,10 @@ GAUGE_NAMES = (
     "blaze_executor_busy_slots",
     "blaze_executor_tasks_done_total",
     "blaze_executor_telemetry_bytes_total",
+    "blaze_executor_draining",
+    "blaze_executor_reconnects_total",
+    "blaze_executor_drains_total",
+    "blaze_shuffle_conn_dropped_total",
     "blaze_service_capacity",
     "blaze_artifact_corruptions_total",
     "blaze_recovered_queries_total",
@@ -772,6 +776,22 @@ def prometheus_text() -> str:
          "sidecar-recovered)",
          [({"exec_id": e["exec_id"]}, e.get("telemetry_bytes", 0))
           for e in execs])
+    # partition-tolerant control plane: draining seats (excluded from
+    # capacity without a death) and per-seat control-session resumes
+    emit("blaze_executor_draining", "gauge",
+         "Executor is gracefully decommissioning (1 = drain mode)",
+         [({"exec_id": e["exec_id"]}, 1 if e.get("draining") else 0)
+          for e in execs])
+    emit("blaze_executor_reconnects_total", "counter",
+         "Control-session resumes after a transport blip, per seat",
+         [({"exec_id": e["exec_id"]}, e.get("reconnects", 0))
+          for e in execs])
+    emit("blaze_executor_drains_total", "counter",
+         "Executors gracefully decommissioned (drain completed)",
+         [({}, ps.get("drains_total", 0))] if ps else [])
+    emit("blaze_shuffle_conn_dropped_total", "counter",
+         "Shuffle-server client connections dropped mid-request",
+         [({}, ps.get("shuffle_conns_dropped", 0))] if ps else [])
     emit("blaze_executor_live", "gauge",
          "Live executor processes in the pool",
          [({}, ps["live"])] if ps else [])
@@ -885,6 +905,7 @@ def health_snapshot() -> Dict[str, Any]:
     return {
         "ok": ok,
         "executors_live": ps["live"] if ps else None,
+        "executors_draining": ps.get("draining") if ps else None,
         "capacity": ps["capacity"] if ps else None,
         "ring_samples": len(ring),
         "ring_capacity": int(conf.monitor_ring_samples),

@@ -14,7 +14,9 @@ fetchable — the lineage property executor-death recovery relies on
 Wire format (shared with the executor control socket,
 runtime/executor_pool.py): the serde frame discipline applied to control
 messages — `u32 magic | u32 raw_len | u32 comp_len | u32 blob_len |
-compressed(json header) | blob`. The header rides the same
+[u32 crc32 when magic is BCS2] | compressed(json header) | blob`; the
+CRC covers compressed header + blob, and BCS1 frames (no checksum)
+still parse for version tolerance. The header rides the same
 compressor family as shuffle frames (serde's zstd-or-zlib posture at
 conf.zstd_level); the blob is opaque bytes — for segment replies it is a
 concatenation of serde "BTB1" frames, handed to IpcReaderExec undecoded.
@@ -42,32 +44,84 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 MAGIC = b"BCS1"
 _HEAD = struct.Struct("<4sIII")
+# BCS2 appends a CRC32 of the frame body (compressed header + blob) so
+# torn/corrupted frames raise a typed WireError instead of decoding
+# garbage. The first 16 bytes stay layout-compatible with BCS1: recv
+# branches on the magic, so old BCS1 frames still parse (version-
+# tolerant rolling upgrades between driver and executors).
+MAGIC2 = b"BCS2"
+_CRC_TAIL = struct.Struct("<I")
 # largest accepted frame: a poisoned/corrupt length prefix must not make
 # recv_msg attempt a multi-GiB allocation
 MAX_FRAME = 1 << 31
 
+# Network fault seam (faults.py net.* points). faults.install() points
+# this at faults.net_rule when a spec arms any net.* point, and back to
+# None on reset — a plain module global so this module stays import-
+# light (no config/faults import at module load; worker processes never
+# arm it because fault_injection_spec is stripped from their conf).
+NET_HOOK = None
+
+
+def net_rule(point: str):
+    """Fire the driver-side net fault schedule for `point`; returns the
+    armed rule dict (kind/ms/...) when this call should inject a wire
+    fault, else None. Call sites pass the rule to send_msg/recv_msg via
+    net_fault= so injection happens at the exact socket operation."""
+    hook = NET_HOOK
+    return hook(point) if hook is not None else None
+
 
 class WireError(ConnectionError):
-    """Framing violation (bad magic / oversized length): the peer is not
-    speaking the protocol — callers treat it like a lost connection."""
+    """Framing violation (bad magic / oversized length / CRC mismatch):
+    the peer is not speaking the protocol — callers treat it like a
+    lost connection."""
+
+
+def _apply_send_fault(sock: socket.socket, buf: bytes, rule: dict) -> bool:
+    """Apply a fired net.* rule to an outgoing frame. Returns True when
+    the frame was (ab)used by the fault and must not be sent again;
+    raises for connection-fatal kinds."""
+    kind = rule.get("kind")
+    if kind == "delay":
+        time.sleep(float(rule.get("ms", 25)) / 1000.0)
+        return False
+    if kind == "dup":
+        sock.sendall(buf + buf)  # duplicate delivery: same frame twice
+        return True
+    if kind == "reset":
+        raise ConnectionResetError("injected: connection reset by peer")
+    if kind == "blackhole":
+        # the peer sees nothing; the sender stalls then loses the conn
+        time.sleep(float(rule.get("ms", 2000)) / 1000.0)
+        raise ConnectionError("injected: blackhole (frame never sent)")
+    if kind == "torn":
+        sock.sendall(buf[: max(1, len(buf) // 2)])
+        raise ConnectionResetError("injected: torn frame (partial write)")
+    return False
 
 
 def send_msg(sock: socket.socket, header: dict, blob: bytes = b"",
-             lock: Optional[threading.Lock] = None) -> None:
+             lock: Optional[threading.Lock] = None,
+             net_fault: Optional[dict] = None) -> None:
     """Serialize + frame one message; `lock` serializes concurrent
-    senders sharing the socket (a torn frame is unrecoverable)."""
+    senders sharing the socket (a torn frame is unrecoverable).
+    `net_fault` is a pre-fired net.* rule (from net_rule) applied at
+    the sendall boundary — wire-level chaos without monkeypatching."""
     raw = json.dumps(header, separators=(",", ":")).encode()
     comp = zlib.compress(raw, 1)
-    buf = _HEAD.pack(MAGIC, len(raw), len(comp), len(blob)) + comp
+    crc = zlib.crc32(blob, zlib.crc32(comp)) & 0xFFFFFFFF
+    buf = (_HEAD.pack(MAGIC2, len(raw), len(comp), len(blob))
+           + _CRC_TAIL.pack(crc) + comp + blob)
     if lock is not None:
         with lock:
+            if net_fault and _apply_send_fault(sock, buf, net_fault):
+                return
             sock.sendall(buf)
-            if blob:
-                sock.sendall(blob)
     else:
+        if net_fault and _apply_send_fault(sock, buf, net_fault):
+            return
         sock.sendall(buf)
-        if blob:
-            sock.sendall(blob)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -82,19 +136,44 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return b"".join(chunks)
 
 
-def recv_msg(sock: socket.socket) -> Tuple[dict, bytes]:
+def recv_msg(sock: socket.socket,
+             net_fault: Optional[dict] = None) -> Tuple[dict, bytes]:
     """Read one framed message; raises ConnectionError on EOF/short read
-    and WireError on a malformed frame."""
+    and WireError on a malformed frame. Accepts both BCS1 (legacy, no
+    checksum) and BCS2 (CRC32 over compressed header + blob) frames."""
+    if net_fault:
+        kind = net_fault.get("kind")
+        if kind == "delay":
+            time.sleep(float(net_fault.get("ms", 25)) / 1000.0)
+        elif kind == "reset":
+            raise ConnectionResetError("injected: connection reset on recv")
+        elif kind == "blackhole":
+            time.sleep(float(net_fault.get("ms", 2000)) / 1000.0)
+            raise ConnectionError("injected: blackhole on recv")
+        elif kind == "torn":
+            raise WireError("injected: torn frame on recv")
+        # "dup" is applied by callers that own the message loop (the
+        # frame itself arrives once; duplication is a delivery property)
     head = _recv_exact(sock, _HEAD.size)
     magic, raw_len, comp_len, blob_len = _HEAD.unpack(head)
-    if magic != MAGIC:
+    if magic not in (MAGIC, MAGIC2):
         raise WireError(f"bad frame magic {magic!r}")
     if max(raw_len, comp_len, blob_len) > MAX_FRAME:
         raise WireError("frame length exceeds MAX_FRAME")
-    raw = zlib.decompress(_recv_exact(sock, comp_len))
+    want_crc = None
+    if magic == MAGIC2:
+        want_crc = _CRC_TAIL.unpack(_recv_exact(sock, _CRC_TAIL.size))[0]
+    comp = _recv_exact(sock, comp_len)
+    blob = _recv_exact(sock, blob_len) if blob_len else b""
+    if want_crc is not None:
+        got = zlib.crc32(blob, zlib.crc32(comp)) & 0xFFFFFFFF
+        if got != want_crc:
+            raise WireError(
+                f"frame CRC mismatch (want {want_crc:#010x}, "
+                f"got {got:#010x})")
+    raw = zlib.decompress(comp)
     if len(raw) != raw_len:
         raise WireError("frame raw_len mismatch")
-    blob = _recv_exact(sock, blob_len) if blob_len else b""
     return json.loads(raw.decode()), blob
 
 
@@ -130,6 +209,10 @@ class ShuffleServer:
         self._accept_thread: Optional[threading.Thread] = None
         self._closed = threading.Event()
         self.fetches = 0
+        # unclean client disconnects (mid-frame EOF, framing violation,
+        # reply send failure) — partition chaos made observable server-
+        # side; clean EOF between requests is a normal client close
+        self.conns_dropped = 0
 
     # -- registry ------------------------------------------------------
 
@@ -178,12 +261,29 @@ class ShuffleServer:
             threading.Thread(target=self._serve_conn, args=(conn,),
                              name="blz-shufsrv-conn", daemon=True).start()
 
+    def _conn_dropped(self, why: str) -> None:
+        """Count + trace one unclean client disconnect. Lazy trace
+        import (this only runs driver-side; the module must stay
+        import-light for worker processes)."""
+        with self._lock:
+            self.conns_dropped += 1
+        from blaze_tpu.runtime import trace
+
+        trace.event("shuffle_conn_dropped", why=why)
+
     def _serve_conn(self, conn: socket.socket) -> None:
         try:
             while True:
                 try:
                     msg, _blob = recv_msg(conn)
-                except ConnectionError:
+                except WireError as e:
+                    self._conn_dropped(f"wire_error: {e}")
+                    return
+                except ConnectionError as e:
+                    # clean EOF between requests is a normal client
+                    # close; a mid-frame EOF is a dropped connection
+                    if "mid-frame" in str(e):
+                        self._conn_dropped("eof_mid_frame")
                     return
                 if msg.get("type") != "fetch":
                     send_msg(conn, {"ok": False,
@@ -191,13 +291,23 @@ class ShuffleServer:
                     continue
                 rid = msg.get("rid", "")
                 partition = int(msg.get("partition", 0))
+                # echo the client's request id so it can discard stale
+                # or duplicated replies (absent on old clients — the
+                # reply then carries no "req" and is accepted as-is)
+                echo = {k: msg[k] for k in ("req",) if k in msg}
                 try:
                     blob = self._fetch(rid, partition)
                 except Exception as e:  # noqa: BLE001 — relayed to peer
                     send_msg(conn, {"ok": False, "rid": rid,
-                                    "error": f"{type(e).__name__}: {e}"})
+                                    "error": f"{type(e).__name__}: {e}",
+                                    **echo})
                     continue
-                send_msg(conn, {"ok": True, "rid": rid}, blob)
+                try:
+                    send_msg(conn, {"ok": True, "rid": rid, **echo}, blob,
+                             net_fault=net_rule("net.shuffle.fetch"))
+                except (ConnectionError, OSError) as e:
+                    self._conn_dropped(f"send_failed: {e}")
+                    return
         finally:
             conn.close()
 
@@ -237,6 +347,10 @@ class ShuffleClient:
         self.sock_path = sock_path
         self._lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
+        # monotone request id: replies echo it back so a duplicated or
+        # stale reply (net.* dup chaos, a retry racing its first answer)
+        # is discarded instead of being matched to the wrong request
+        self._req = 0
 
     @staticmethod
     def _timeout_ms() -> float:
@@ -269,9 +383,19 @@ class ShuffleClient:
     def _fetch_once_locked(self, rid: str,
                            partition: int) -> Tuple[dict, bytes]:
         sock = self._ensure_locked()
+        self._req += 1
+        req = self._req
         send_msg(sock, {"type": "fetch", "rid": rid,
-                        "partition": partition})
-        return recv_msg(sock)
+                        "partition": partition, "req": req})
+        while True:
+            msg, blob = recv_msg(sock)
+            got = msg.get("req")
+            # accept replies without a req echo (old servers); discard
+            # duplicated/stale replies for earlier request ids
+            if got is None or got == req:
+                return msg, blob
+            if got > req:
+                raise WireError(f"reply for future request {got} > {req}")
 
     def fetch(self, rid: str, partition: int) -> bytes:
         """Fetch one partition segment, retrying lost/hung connections

@@ -241,7 +241,8 @@ class ProcessPeer:
     zombie child is seen as dead even though os.kill(pid, 0) still
     succeeds on it."""
 
-    __slots__ = ("key", "pid", "last_beat", "poll", "on_death", "dead")
+    __slots__ = ("key", "pid", "last_beat", "poll", "on_death", "dead",
+                 "draining")
 
     def __init__(self, key: str, pid: int,
                  on_death: Callable[["ProcessPeer", str, Optional[int]],
@@ -253,6 +254,7 @@ class ProcessPeer:
         self.poll = poll
         self.on_death = on_death
         self.dead = False
+        self.draining = False
 
     def beat(self) -> None:
         self.last_beat = time.monotonic()
@@ -297,6 +299,16 @@ class ProcessWatchdog:
         if peer is not None:
             peer.beat()
 
+    def mark_draining(self, key: str) -> None:
+        """Flag a peer as gracefully decommissioning: its clean exit
+        (rc 0) routes to on_death(reason="drained") with NO
+        executor_death event/telemetry — an orderly drain is not a
+        death."""
+        with self._lock:
+            peer = self._peers.get(key)
+        if peer is not None:
+            peer.draining = True
+
     def _pid_gone(self, peer: ProcessPeer) -> Tuple[bool, Optional[int]]:
         if peer.poll is not None:
             rc = peer.poll()
@@ -327,12 +339,22 @@ class ProcessWatchdog:
             gone, rc = self._pid_gone(peer)
             if gone:
                 reason = "exit"
+            elif peer.draining:
+                continue  # a draining peer may idle past staleness
             elif now - peer.last_beat > stale_s:
                 reason, rc = "heartbeat", None
             else:
                 continue
             peer.dead = True
             self.unregister(peer.key)
+            if peer.draining and rc in (0, None):
+                # clean exit of a decommissioning worker: route to the
+                # owner as "drained", no dossier, no death accounting
+                try:
+                    peer.on_death(peer, "drained", rc)
+                except Exception:  # noqa: BLE001 — must not kill scan
+                    pass
+                continue
             faults.TELEMETRY.add("executor_deaths", 1)
             trace.event("executor_death", exec_id=peer.key, pid=peer.pid,
                         reason=reason, exit_code=rc,

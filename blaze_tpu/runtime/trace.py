@@ -184,12 +184,16 @@ EVENT_KINDS = (
     "compile_miss",         # compile_service: persistent-cache miss
     "capacity_changed",     # service: admission capacity recomputed on
                             # executor-pool membership change
+    "control_reconnect",    # executor_pool: worker resumed its control
+                            # session after a transport blip (no death)
     "deadline_exceeded",    # executor: task/query budget exhausted
     "deadline_kill",        # supervisor: budget exhausted mid-attempt
     "degrade",              # executor: resilience-ladder rung taken
     "driver_recovery",      # journal: recovery scan replayed a journal
     "epoch_fenced",         # artifacts.EpochFence: stale attempt rejected
     "executor_death",       # supervisor/pool: executor process declared dead
+    "executor_drain",       # executor_pool: seat gracefully decommissioned
+                            # (drain completed; not a death)
     "executor_spawn",       # executor_pool: worker process launched
     "executor_task_requeued",  # executor_pool: displaced/failed task re-queued
     "fault_injected",       # faults.inject: armed point fired
@@ -199,13 +203,19 @@ EVENT_KINDS = (
     "journal_replay",       # local_runner: committed stage reused from
                             # a recovered write-ahead journal
     "ladder_rung",          # executor: degradation ladder transition
+    "lease_expired",        # executor_pool worker: driver unreachable past
+                            # executor_death_ms; self-fenced (exit 17)
     "mem_release",          # memory: reservation released by sweep
     "orphan_sweep",         # artifacts: stale attempt files removed
+    "partition_suspected",  # executor_pool: control conn broken but the
+                            # process looks alive — reconnect window open
     "pipeline_stats",       # pipeline: per-stream close statistics
     "progress_snapshot",    # monitor endpoints: live progress scraped
     "queue_depth",          # pipeline: sampler queue-depth reading
     "resource_leak",        # monitor: leaked reservation/stream detected
     "retry",                # executor: retryable failure retried
+    "shuffle_conn_dropped", # shuffle_server: client connection dropped
+                            # mid-request (reset/torn frame/CRC mismatch)
     "slo_burn",             # service: tenant SLO budget burning hot
     "speculation_launch",   # supervisor: straggler twin launched
     "speculation_loss",     # supervisor: attempt lost the commit race
@@ -635,6 +645,10 @@ _RESILIENCE_EVENT_KINDS = (
     "speculation_win", "speculation_loss", "breaker_trip",
     "fault_injected", "task_error", "degrade", "executor_death",
     "executor_task_requeued", "epoch_fenced",
+    # partition-tolerant control plane: wire blips and their outcomes
+    # (run records count them so doctor's network_flaky rule can rank)
+    "control_reconnect", "partition_suspected", "shuffle_conn_dropped",
+    "lease_expired", "executor_drain",
 )
 
 

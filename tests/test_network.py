@@ -1,0 +1,528 @@
+"""Partition-tolerant control plane (ISSUE 15): wire-format hardening,
+reconnect-and-resume dispatch, lease-fenced executors, graceful drain.
+
+The headline property: a network blip and a process death are DIFFERENT
+events. A transient control-socket break costs a reconnect and a resume
+handshake (re-delivered specs dedupe, unacked results replay) — never a
+seat, never a capacity dip, never an executor_death dossier. Only an
+unreachable peer past executor_death_ms escalates to a death, and then
+BOTH ends converge: the driver cuts one dossier and requeues; the worker's
+lease expires and it self-fences (exit 17) so it cannot commit stale work
+into an epoch the driver already fenced.
+
+Pool startup costs ~2-3s (workers import jax); e2e tests spin dedicated
+pools so counters start from zero.
+"""
+
+import os
+import signal
+import socket
+import struct
+import threading
+import time
+import zlib
+
+import pytest
+
+from blaze_tpu.config import conf
+from blaze_tpu.runtime import executor_pool as ep
+from blaze_tpu.runtime import faults
+from blaze_tpu.runtime import shuffle_server as ss
+
+
+# ---------------------------------------------------------------------------
+# wire-format fuzz: recv_msg must classify malformed frames, not decode
+# garbage or over-allocate
+# ---------------------------------------------------------------------------
+
+
+def _frame(header_raw: bytes, blob: bytes = b"", magic: bytes = ss.MAGIC2,
+           crc: int = None) -> bytes:
+    comp = zlib.compress(header_raw, 1)
+    buf = ss._HEAD.pack(magic, len(header_raw), len(comp), len(blob))
+    if magic == ss.MAGIC2:
+        if crc is None:
+            crc = zlib.crc32(blob, zlib.crc32(comp)) & 0xFFFFFFFF
+        buf += ss._CRC_TAIL.pack(crc)
+    return buf + comp + blob
+
+
+def test_wire_crc_detects_flipped_blob_byte():
+    a, b = socket.socketpair()
+    try:
+        good = _frame(b'{"type":"x"}', b"payload-bytes")
+        bad = bytearray(good)
+        bad[-3] ^= 0xFF  # flip a blob byte; header + lengths stay valid
+        a.sendall(bytes(bad))
+        with pytest.raises(ss.WireError, match="CRC mismatch"):
+            ss.recv_msg(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_wire_legacy_bcs1_frame_still_parses():
+    """Version tolerance: a BCS1 peer (no CRC tail) must interoperate."""
+    a, b = socket.socketpair()
+    try:
+        a.sendall(_frame(b'{"type":"old"}', b"blob", magic=ss.MAGIC))
+        msg, blob = ss.recv_msg(b)
+        assert msg == {"type": "old"} and blob == b"blob"
+    finally:
+        a.close()
+        b.close()
+
+
+def test_wire_truncated_frame_is_connection_error():
+    """EOF mid-frame (peer died mid-send) is a ConnectionError — the
+    session layer treats it as a lost connection, not bad protocol."""
+    a, b = socket.socketpair()
+    try:
+        full = _frame(b'{"type":"x"}', b"0123456789" * 100)
+        a.sendall(full[: len(full) // 2])
+        a.close()
+        with pytest.raises(ConnectionError):
+            ss.recv_msg(b)
+    finally:
+        b.close()
+
+
+def test_wire_oversized_length_rejected_before_allocation():
+    """A poisoned length prefix must raise WireError, not attempt a
+    multi-GiB allocation."""
+    a, b = socket.socketpair()
+    try:
+        head = ss._HEAD.pack(ss.MAGIC2, 10, 10, ss.MAX_FRAME + 1)
+        a.sendall(head + ss._CRC_TAIL.pack(0))
+        with pytest.raises(ss.WireError, match="MAX_FRAME"):
+            ss.recv_msg(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_wire_raw_len_mismatch_rejected():
+    a, b = socket.socketpair()
+    try:
+        comp = zlib.compress(b'{"type":"x"}', 1)
+        crc = zlib.crc32(b"", zlib.crc32(comp)) & 0xFFFFFFFF
+        # claim raw_len 999: decompress succeeds but length disagrees
+        a.sendall(ss._HEAD.pack(ss.MAGIC2, 999, len(comp), 0)
+                  + ss._CRC_TAIL.pack(crc) + comp)
+        with pytest.raises(ss.WireError, match="raw_len"):
+            ss.recv_msg(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_wire_duplicated_frames_surface_twice():
+    """Duplicate DELIVERY is a transport property: both copies parse;
+    dedupe is the session layer's job (worker _dispatch_task, driver
+    telemetry seq watermark)."""
+    a, b = socket.socketpair()
+    try:
+        buf = _frame(b'{"task":"t1","epoch":3}', b"spec")
+        a.sendall(buf + buf)
+        for _ in range(2):
+            msg, blob = ss.recv_msg(b)
+            assert msg == {"task": "t1", "epoch": 3} and blob == b"spec"
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# net.* fault arming: the NET_HOOK seam
+# ---------------------------------------------------------------------------
+
+
+def test_net_rule_arms_and_disarms_hook():
+    try:
+        faults.install({"seed": 7, "points": {
+            "net.control.send": {"kind": "reset", "fail_times": 1}}})
+        assert ss.NET_HOOK is not None
+        rule = ss.net_rule("net.control.send")
+        assert rule and rule["kind"] == "reset"
+        assert ss.net_rule("net.control.send") is None  # schedule spent
+        assert ss.net_rule("net.shuffle.fetch") is None  # unarmed point
+    finally:
+        faults.install(None)
+    assert ss.NET_HOOK is None
+    assert ss.net_rule("net.control.send") is None
+
+
+def test_net_rule_ignores_non_wire_kinds():
+    """An "io" rule on a net.* point is a taxonomy fault for inject();
+    net_rule must not fire it at the socket layer."""
+    try:
+        faults.install({"seed": 7, "points": {
+            "net.control.recv": {"kind": "io", "fail_times": 9}}})
+        assert ss.net_rule("net.control.recv") is None
+    finally:
+        faults.install(None)
+
+
+# ---------------------------------------------------------------------------
+# resume-handshake dedupe (worker session layer)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def stub_worker(monkeypatch, tmp_path):
+    monkeypatch.setenv(ep._ENV_TOKEN, "wtest")
+    monkeypatch.setenv(ep._ENV_CTL, str(tmp_path / "ctl.sock"))
+    w = ep._Worker()
+    sent = []
+    monkeypatch.setattr(w, "_send", lambda h, blob=b"": sent.append(h))
+    return w, sent
+
+
+def test_worker_dedupes_redelivered_running_spec(stub_worker, monkeypatch):
+    """A spec re-delivered while the first attempt is still executing
+    must stay single-flight."""
+    w, _sent = stub_worker
+    runs = []
+    monkeypatch.setattr(w, "_run_task",
+                        lambda msg, blob: runs.append(msg["task"]))
+    spec = {"task": "t1", "epoch": 2}
+    w._dispatch_task(dict(spec), b"")
+    deadline = time.monotonic() + 5
+    while not runs and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert runs == ["t1"]
+    # simulate "still running": _run_task stub never cleared the key
+    w._dispatch_task(dict(spec), b"")
+    time.sleep(0.1)
+    assert runs == ["t1"]  # NOT re-executed
+
+
+def test_worker_replays_cached_reply_for_finished_spec(stub_worker,
+                                                       monkeypatch):
+    """A spec re-delivered after completion answers from the result
+    cache — the driver gets its lost reply without re-execution."""
+    w, sent = stub_worker
+    monkeypatch.setattr(
+        w, "_run_task",
+        lambda msg, blob: pytest.fail("finished task re-executed"))
+    reply = {"type": "result", "task": "t9", "epoch": 4, "ok": True}
+    with w._task_lock:
+        w._task_done[("t9", 4)] = reply
+    w._dispatch_task({"task": "t9", "epoch": 4}, b"")
+    assert sent == [reply]
+    # a DIFFERENT epoch of the same task is a new attempt, not a dup
+    runs = []
+    monkeypatch.setattr(w, "_run_task",
+                        lambda msg, blob: runs.append(msg["epoch"]))
+    w._dispatch_task({"task": "t9", "epoch": 5}, b"")
+    deadline = time.monotonic() + 5
+    while not runs and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert runs == [5]
+
+
+# ---------------------------------------------------------------------------
+# duplicate-result triage at the driver (the winner-vs-zombie sweep)
+# ---------------------------------------------------------------------------
+
+
+def test_duplicate_winner_result_does_not_unlink_artifacts(tmp_path):
+    """A re-delivered duplicate of the WINNING result (fence already
+    forgot the key at batch teardown) must NOT sweep the committed
+    artifact files a downstream read is consuming — only a zombie's
+    stale-epoch files are losers."""
+    from blaze_tpu.runtime import artifacts
+
+    pool = ep.ExecutorPool.__new__(ep.ExecutorPool)
+    pool.fence = artifacts.EpochFence()
+    pool._lock = threading.Lock()
+    pool._cv = threading.Condition(pool._lock)
+    pool._running = {}
+    pool._done_epochs = __import__("collections").OrderedDict()
+    pool.tasks_done = 0
+    handle = type("H", (), {"inflight": {}, "tasks_done": 0})()
+
+    data = tmp_path / "shuffle_0_0.e1.data"
+    index = tmp_path / "shuffle_0_0.e1.index"
+    data.write_bytes(b"live")
+    index.write_bytes(b"live")
+    msg = {"type": "result", "task": "shuffle_0_0", "epoch": 1, "ok": True,
+           "data_path": str(data), "index_path": str(index)}
+
+    epoch = pool.fence.advance("shuffle_0_0")
+    assert epoch == 1
+    pool._running["shuffle_0_0"] = type(
+        "T", (), {"epoch": 1, "state": "running", "result": None})()
+    pool._on_result(handle, dict(msg))       # winner lands
+    assert pool.tasks_done == 1
+    pool.fence.forget("shuffle_0_0")         # batch teardown
+    pool._on_result(handle, dict(msg))       # resume re-delivers a dup
+    assert pool.tasks_done == 1              # no double count
+    assert data.exists() and index.exists()  # live artifacts survive
+
+    # a true zombie (older epoch, never won) IS swept
+    zdata = tmp_path / "shuffle_0_1.e1.data"
+    zdata.write_bytes(b"zombie")
+    pool.fence.advance("shuffle_0_1")
+    pool.fence.advance("shuffle_0_1")        # requeue fenced epoch 1
+    pool._on_result(handle, {"type": "result", "task": "shuffle_0_1",
+                             "epoch": 1, "ok": True,
+                             "data_path": str(zdata)})
+    assert not zdata.exists()
+
+
+# ---------------------------------------------------------------------------
+# e2e: reconnect-and-resume, lease self-fence, graceful drain
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def fast_death_conf():
+    saved = {k: getattr(conf, k) for k in
+             ("executor_death_ms", "executor_heartbeat_ms",
+              "executor_restart_backoff_ms", "control_reconnect_backoff_ms")}
+    conf.executor_death_ms = 900
+    conf.executor_heartbeat_ms = 50
+    conf.executor_restart_backoff_ms = 50
+    conf.control_reconnect_backoff_ms = 25
+    yield
+    for k, v in saved.items():
+        setattr(conf, k, v)
+
+
+def _run_batch_async(pool, specs):
+    box = {}
+
+    def run():
+        try:
+            box["out"] = pool.run_tasks(specs, timeout=120)
+        except Exception as e:  # noqa: BLE001 — asserted by callers
+            box["err"] = e
+
+    t = threading.Thread(target=run)
+    t.start()
+    return t, box
+
+
+def _wait_busy(pool, timeout=10):
+    deadline = time.monotonic() + timeout
+    busy = {}
+    while not busy and time.monotonic() < deadline:
+        busy = pool.busy_pids()
+        time.sleep(0.02)
+    assert busy, "no executor picked up work"
+    return next(iter(busy.items()))
+
+
+def test_conn_break_reconnects_without_death(fast_death_conf, tmp_path,
+                                             monkeypatch):
+    """Sever a busy seat's control socket: the batch completes with each
+    task counted once, the seat keeps its capacity, no executor_death is
+    declared, and a control_reconnect event is traced."""
+    from blaze_tpu.runtime import flight_recorder, trace
+
+    monkeypatch.setattr(conf, "flight_dir", str(tmp_path / "flight"))
+    monkeypatch.setattr(conf, "trace_enabled", True)
+    trace.reset()
+    pool = ep.ExecutorPool(count=2, slots=1)
+    pool.start()
+    caps = []
+    pool.on_membership(lambda p: caps.append(p.capacity()))
+    try:
+        specs = [ep.PoolTaskSpec(f"rc:{i}", "sleep", {"ms": 400})
+                 for i in range(4)]
+        t, box = _run_batch_async(pool, specs)
+        seat, _pid = _wait_busy(pool)
+        assert pool.break_conn(seat)
+        t.join(timeout=120)
+        assert "err" not in box
+        assert len(box["out"]) == 4 and all(r["ok"] for r in box["out"])
+        st = pool.stats()
+        assert st["deaths_total"] == 0
+        assert st["reconnects_total"] >= 1
+        assert st["tasks_done"] == 4          # resume dedupe: no doubles
+        # capacity never DIPPED: no seat was declared dead or drained
+        # (a resume may ping membership, but always at full capacity)
+        assert pool.capacity() == 2 and all(c == 2 for c in caps)
+        assert flight_recorder.list_dossiers(str(tmp_path / "flight")) == []
+        kinds = {r.get("kind") for r in trace.TRACE.snapshot()
+                 if r.get("type") == "event"}
+        assert "control_reconnect" in kinds
+    finally:
+        pool.close()
+        trace.reset()
+
+
+def test_asymmetric_partition_lease_self_fence(fast_death_conf, tmp_path,
+                                               monkeypatch):
+    """Partition a busy worker's outbound path past executor_death_ms:
+    the driver declares ONE heartbeat death and requeues; the worker's
+    lease expires and it exits with the self-fence code (17)."""
+    from blaze_tpu.runtime import flight_recorder
+
+    monkeypatch.setattr(conf, "flight_dir", str(tmp_path / "flight"))
+    pool = ep.ExecutorPool(count=2, slots=1)
+    pool.start()
+    try:
+        specs = [ep.PoolTaskSpec(f"pt:{i}", "sleep", {"ms": 400})
+                 for i in range(4)]
+        t, box = _run_batch_async(pool, specs)
+        seat, _pid = _wait_busy(pool)
+        with pool._lock:
+            proc = pool._seats[seat].proc
+        assert pool.partition_executor(seat, 4000)
+        t.join(timeout=120)
+        assert "err" not in box
+        assert len(box["out"]) == 4 and all(r["ok"] for r in box["out"])
+        assert pool.stats()["deaths_total"] == 1
+        deadline = time.monotonic() + 30
+        while proc.poll() is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert proc.poll() == 17, "worker must self-fence at lease expiry"
+        deaths = [d for d in
+                  flight_recorder.list_dossiers(str(tmp_path / "flight"))
+                  if d.get("trigger") == "executor_death"]
+        assert len(deaths) == 1
+    finally:
+        pool.close()
+
+
+def test_decommission_drains_seat_without_death(fast_death_conf):
+    """decommission(): the seat leaves capacity immediately, finishes
+    its in-flight work, exits clean (drain, not death), and is NOT
+    respawned."""
+    pool = ep.ExecutorPool(count=2, slots=2)
+    pool.start()
+    try:
+        assert pool.capacity() == 4
+        seat = sorted(pool.pids())[0]
+        assert pool.decommission(seat)
+        assert pool.capacity() == 2  # draining seat excluded at once
+        st = pool.stats()
+        assert st["draining"] == 1
+        execs = {e["exec_id"]: e for e in pool.executors()}
+        assert any(e.get("draining") for e in execs.values())
+        # the idle worker drains fast: retired with a drain, not a death
+        deadline = time.monotonic() + 30
+        while pool.stats()["drains_total"] < 1 \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        st = pool.stats()
+        assert st["drains_total"] == 1
+        assert st["deaths_total"] == 0
+        assert st["drain_requeues_total"] == 0
+        time.sleep(0.3)  # no respawn may race in after retirement
+        assert pool.live_count() == 1  # decommission is permanent
+        assert pool.capacity() == 2
+    finally:
+        pool.close()
+
+
+def test_sigterm_drains_then_respawns(fast_death_conf):
+    """SIGTERM under load = rolling-restart building block: the worker
+    announces draining, finishes in-flight work (no requeues), exits
+    clean (no death/dossier), and the seat respawns."""
+    pool = ep.ExecutorPool(count=2, slots=1)
+    pool.start()
+    try:
+        specs = [ep.PoolTaskSpec(f"dr:{i}", "sleep", {"ms": 300})
+                 for i in range(4)]
+        t, box = _run_batch_async(pool, specs)
+        seat, pid = _wait_busy(pool)
+        os.kill(pid, signal.SIGTERM)
+        t.join(timeout=120)
+        assert "err" not in box
+        assert len(box["out"]) == 4 and all(r["ok"] for r in box["out"])
+        st = pool.stats()
+        assert st["deaths_total"] == 0
+        assert st["drains_total"] == 1
+        assert st["drain_requeues_total"] == 0
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if pool.live_count() == 2 and pool.pids().get(seat) != pid:
+                break
+            time.sleep(0.05)
+        assert pool.live_count() == 2 and pool.capacity() == 2
+    finally:
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# observability: /healthz + prometheus surface the draining state
+# ---------------------------------------------------------------------------
+
+
+class _StubPool:
+    def __init__(self, live=2, slots=2, draining=1):
+        self.live, self.slots, self.draining = live, slots, draining
+        self.deaths_total = self.restarts_total = self.tasks_done = 0
+
+    def capacity(self):
+        return (self.live - self.draining) * self.slots
+
+    def live_count(self):
+        return self.live
+
+    def on_membership(self, cb):
+        pass
+
+    def stats(self):
+        return {"count": 2, "live": self.live, "capacity": self.capacity(),
+                "slots": self.slots, "inflight": 0, "draining": self.draining,
+                "deaths_total": 0, "restarts_total": 0, "reconnects_total": 2,
+                "drains_total": 1, "drain_requeues_total": 0,
+                "fenced_total": 0, "tasks_done": 0,
+                "shuffle_conns_dropped": 3}
+
+    def executors(self):
+        return [{"exec_id": f"exec{i}", "pid": 1000 + i, "generation": 0,
+                 "up": True, "inflight": 0, "draining": i == 0,
+                 "conn_broken": False, "reconnects": 2 * i}
+                for i in range(2)]
+
+
+def test_healthz_and_prometheus_report_draining():
+    from blaze_tpu.runtime import monitor
+
+    stub = _StubPool()
+    ep.activate(stub)
+    try:
+        snap = monitor.health_snapshot()
+        assert snap["executors_draining"] == 1
+        assert snap["ok"]  # draining degrades capacity, not health
+        text = monitor.prometheus_text()
+        assert 'blaze_executor_draining{exec_id="exec0"} 1' in text
+        assert 'blaze_executor_draining{exec_id="exec1"} 0' in text
+        assert 'blaze_executor_reconnects_total{exec_id="exec1"} 2' in text
+        assert "blaze_executor_drains_total 1" in text
+        assert "blaze_shuffle_conn_dropped_total 3" in text
+    finally:
+        ep.deactivate(stub)
+
+
+def test_shuffle_server_counts_dropped_conns(tmp_path):
+    """An unclean client disconnect (mid-frame EOF) increments the
+    server's conns_dropped; a clean close between requests does not."""
+    server = ss.ShuffleServer(str(tmp_path / "shf.sock"))
+    server.start()
+    try:
+        server.register_frames("b:1", [b"x"])
+        # clean client: fetch then close between requests
+        client = ss.ShuffleClient(server.sock_path)
+        assert client.fetch("b:1", 0) == b"x"
+        client.close()
+        time.sleep(0.1)
+        assert server.conns_dropped == 0
+        # unclean client: die mid-frame (head promises a 100-byte
+        # compressed header; deliver a fragment of it, then vanish)
+        raw = socket.socket(socket.AF_UNIX)
+        raw.connect(server.sock_path)
+        raw.sendall(ss._HEAD.pack(ss.MAGIC2, 100, 100, 0)
+                    + ss._CRC_TAIL.pack(0) + b"\x00" * 40)
+        raw.close()
+        deadline = time.monotonic() + 5
+        while server.conns_dropped == 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert server.conns_dropped == 1
+    finally:
+        server.close()

@@ -106,16 +106,31 @@ def render(metrics: dict, source: str) -> str:
                  if k.startswith("blaze_executor_up{")]
     if exec_rows:
         live = int(g("blaze_executor_live"))
+        draining = sum(
+            1 for k, dv in metrics.items()
+            if k.startswith("blaze_executor_draining{") and dv)
+
+        def _state(key, up):
+            if not up:
+                return "=DOWN"
+            sel = key[len("blaze_executor_up"):]
+            if g("blaze_executor_draining" + sel):
+                return "=draining"
+            return "=up"
+
         up = " ".join(
-            k.split('exec_id="', 1)[-1].rstrip('"}')
-            + ("=up" if v else "=DOWN")
+            k.split('exec_id="', 1)[-1].rstrip('"}') + _state(k, v)
             for k, v in sorted(exec_rows))
         lines.append(
             f"execs    live={live} "
             f"capacity={int(g('blaze_service_capacity'))} "
             f"deaths={int(g('blaze_executor_deaths_total'))} "
-            f"restarts={int(g('blaze_executor_restarts_total'))}  {up}"
-            + ("  ** NO EXECUTORS LIVE **" if live == 0 else ""))
+            f"restarts={int(g('blaze_executor_restarts_total'))} "
+            f"reconnects="
+            f"{int(sum(v for k, v in metrics.items() if k.startswith('blaze_executor_reconnects_total{')))} "
+            f"drains={int(g('blaze_executor_drains_total'))}  {up}"
+            + ("  ** NO EXECUTORS LIVE **" if live == 0 else "")
+            + (f"  ** {draining} DRAINING **" if draining else ""))
         # per-executor pane, fed by the federation gauges: one row per
         # exec_id with heartbeat freshness, occupancy and telemetry flow
         for key, v in sorted(exec_rows):
@@ -128,6 +143,10 @@ def render(metrics: dict, source: str) -> str:
                 f"busy={int(g('blaze_executor_busy_slots' + sel))} "
                 f"done={int(g('blaze_executor_tasks_done_total' + sel))} "
                 f"tel={human_bytes(int(g('blaze_executor_telemetry_bytes_total' + sel)))}"
+                + (f" rc={int(g('blaze_executor_reconnects_total' + sel))}"
+                   if g("blaze_executor_reconnects_total" + sel) else "")
+                + (" ** DRAINING **"
+                   if g("blaze_executor_draining" + sel) else "")
                 + ("" if v else "  ** DOWN **"))
     tenants = [(k, v) for k, v in metrics.items()
                if k.startswith("blaze_tenant_mem_used_bytes{")]

@@ -110,6 +110,10 @@ class RegistrySync(Checker):
             if fname == "inject" and (qual == "faults" or
                                       mod.rel == FAULTS_REL):
                 self._deferred.append(("point", mod, node))
+            elif fname == "net_rule":
+                # wire-fault lookups (faults.net_rule / the re-exported
+                # shuffle_server.net_rule passthrough) use points too
+                self._deferred.append(("point", mod, node))
             elif qual == "trace" and fname == "event":
                 self._deferred.append(("event", mod, node))
             elif qual == "trace" and fname == "span":

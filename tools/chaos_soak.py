@@ -332,8 +332,10 @@ def _executor_kill_round(tables, kind, flight_dir, seed_tag):
     (c) the admission capacity timeline shrinks then recovers, and
     (d) zero leaked resources or orphan artifacts.
 
-    kinds: sigkill | sigterm (process dies) | hung (stops heartbeating
-    without dying — the zombie; its late results must be epoch-fenced)."""
+    kinds: sigkill (process dies — one dossier) | sigterm (graceful
+    drain: in-flight work finishes, NO dossier, seat respawns) | hung
+    (stops heartbeating without dying — the zombie; its late results
+    must be epoch-fenced)."""
     import signal
     import threading
 
@@ -424,7 +426,17 @@ def _executor_kill_round(tables, kind, flight_dir, seed_tag):
         caps = [c for _t, c in timeline]
         rec["capacity_shrank"] = fired and min(caps) < caps[0]
         rec["capacity_recovered"] = pool.capacity() == caps[0]
-        rec["dossier_ok"] = (not fired) or len(deaths) == 1
+        if kind == "sigterm":
+            # SIGTERM is a graceful decommission now: the worker drains
+            # (finishes in-flight, flushes telemetry, exits 0) and the
+            # seat respawns — NO executor_death dossier, no requeues
+            # attributed to the drain
+            rec["dossier_ok"] = (not fired) or (
+                len(deaths) == 0
+                and rec["stats"].get("drains_total", 0) >= 1
+                and rec["stats"].get("drain_requeues_total", 0) == 0)
+        else:
+            rec["dossier_ok"] = (not fired) or len(deaths) == 1
     finally:
         ep.deactivate(pool)
         pool.close()
@@ -542,6 +554,514 @@ def _executor_soak(tables, args):
               f"capacity={r['capacity_timeline']} {r['seconds']:.1f}s",
               flush=True)
     shutil.rmtree(flight_root, ignore_errors=True)
+    return rounds
+
+
+# wire-fault cells for the --network sweep: every net.* point crossed
+# with the kinds its transport layer must absorb. blackhole cells carry
+# a short ms so a cell costs a stall, not the 2s default.
+NET_CELLS = (
+    ("net.control.send", ("delay", "reset", "torn", "dup", "blackhole")),
+    ("net.control.recv", ("delay", "reset", "torn", "dup", "blackhole")),
+    ("net.shuffle.fetch", ("delay", "reset", "torn", "dup", "blackhole")),
+    ("net.telemetry", ("delay", "reset", "dup")),
+)
+
+
+def _net_cell(tables, pool, point, kind, seed):
+    """One armed wire-fault cell against the SHARED warm pool: run the
+    q3 catalogue query with {point: kind} armed driver-side and demand
+    an oracle-equal answer, zero leaks, and zero executor deaths — a
+    transient wire fault costs a retry/reconnect, never a seat."""
+    from blaze_tpu.runtime import artifacts, faults, pipeline
+    from blaze_tpu.runtime import memory as M
+    from blaze_tpu.spark import validator
+    from blaze_tpu.spark.local_runner import run_plan
+
+    paths, frames = tables
+    plan, oracle = validator.QUERIES["q3_join_agg_sort"](paths, frames,
+                                                         "smj")
+    rule = {"kind": kind, "fail_times": 2}
+    if kind == "blackhole":
+        rule["ms"] = 400
+    spec = {"seed": seed, "points": {point: rule}, "concurrent": True}
+    deaths0 = pool.stats()["deaths_total"]
+    faults.install(spec)
+    cell = {"point": point, "kind": kind, "query": "q3_join_agg_sort"}
+    info = {}
+    work_dir = tempfile.mkdtemp(prefix="chaos_net_")
+    t0 = time.time()
+    try:
+        out = run_plan(plan, num_partitions=4, work_dir=work_dir,
+                       mesh_exchange="off", run_info=info)
+        diff = validator._compare(
+            validator._to_pandas(out).reset_index(drop=True),
+            oracle().reset_index(drop=True))
+        # fired = the schedule actually injected (the control points also
+        # fire on beat frames, which run_info's per-query counter misses)
+        fired = len(faults.injection_log)
+        if fired == 0:
+            cell["outcome"] = "no_fire" if diff is None else "wrong_answer"
+        else:
+            cell["outcome"] = ("recovered" if diff is None
+                               else "wrong_answer")
+        cell["fired"] = fired
+        if diff is not None:
+            cell["diff"] = diff
+    except Exception as e:  # noqa: BLE001 — the soak records, not raises
+        cell["outcome"] = "classified_fail"
+        cell["fired"] = len(faults.injection_log)
+        cell["error"] = f"{type(e).__name__}: {e}"[:300]
+    finally:
+        faults.install(None)
+    cell["seconds"] = round(time.time() - t0, 3)
+    cell["pool_stages"] = info.get("pool_stages", 0)
+    cell["deaths"] = pool.stats()["deaths_total"] - deaths0
+    cell["orphans"] = artifacts.find_orphans([work_dir])
+    cell["mem_leaked"] = int(M.get_manager().mem_used())
+    cell["pipeline_leaked"] = pipeline.live_streams()
+    shutil.rmtree(work_dir, ignore_errors=True)
+    return cell
+
+
+def _net_shuffle_cell(kind, seed):
+    """net.shuffle.fetch cells exercise the fetch protocol DIRECTLY
+    (server + client in-process): the pooled catalogue's reduce reads
+    run driver-side, so worker-side socket fetches don't occur on every
+    plan shape — but the client's bounded retry ladder must still
+    survive every wire-fault kind and return byte-exact segments."""
+    import tempfile as _tf
+
+    from blaze_tpu.runtime import faults
+    from blaze_tpu.runtime import shuffle_server as ss
+
+    rule = {"kind": kind, "fail_times": 2}
+    if kind == "blackhole":
+        rule["ms"] = 300
+    spec = {"seed": seed, "points": {"net.shuffle.fetch": rule},
+            "concurrent": True}
+    cell = {"point": "net.shuffle.fetch", "kind": kind,
+            "query": "fetch_protocol", "deaths": 0, "orphans": [],
+            "mem_leaked": 0, "pipeline_leaked": 0}
+    t0 = time.time()
+    sock_dir = _tf.mkdtemp(prefix="chaos_net_shf_")
+    server = ss.ShuffleServer(os.path.join(sock_dir, "shf.sock"))
+    server.start()
+    try:
+        payloads = [os.urandom(1 << 14) for _ in range(3)]
+        for i, p in enumerate(payloads):
+            server.register_frames(f"cell:{i}", [p])
+        faults.install(spec)
+        try:
+            client = ss.ShuffleClient(server.sock_path)
+            try:
+                ok = all(client.fetch(f"cell:{i % 3}", 0)
+                         == payloads[i % 3] for i in range(6))
+            finally:
+                client.close()
+            fired = len(faults.injection_log)
+            cell["fired"] = fired
+            if not ok:
+                cell["outcome"] = "wrong_answer"
+            elif fired == 0:
+                cell["outcome"] = "no_fire"
+            else:
+                cell["outcome"] = "recovered"
+        except Exception as e:  # noqa: BLE001 — the soak records
+            cell["outcome"] = "classified_fail"
+            cell["fired"] = len(faults.injection_log)
+            cell["error"] = f"{type(e).__name__}: {e}"[:300]
+        finally:
+            faults.install(None)
+        cell["conns_dropped"] = server.conns_dropped
+    finally:
+        server.close()
+        shutil.rmtree(sock_dir, ignore_errors=True)
+    cell["seconds"] = round(time.time() - t0, 3)
+    return cell
+
+
+def _net_reconnect_round(tables, flight_dir):
+    """Transient control-socket reset: sever a busy seat's control
+    connection driver-side mid-query. The contract: reconnect + resume
+    — the answer stays oracle-equal, capacity NEVER dips, no
+    executor_death dossier is cut, and a control_reconnect event lands
+    in the trace."""
+    import threading
+
+    from blaze_tpu.config import conf
+    from blaze_tpu.runtime import executor_pool as ep
+    from blaze_tpu.runtime import flight_recorder, trace
+    from blaze_tpu.spark import validator
+    from blaze_tpu.spark.local_runner import run_plan
+
+    paths, frames = tables
+    plan, oracle = validator.QUERIES["q3_join_agg_sort"](paths, frames,
+                                                         "smj")
+    saved = {k: getattr(conf, k) for k in ("flight_dir", "trace_enabled")}
+    conf.flight_dir = flight_dir
+    conf.trace_enabled = True
+    rec = {"round": "control_reset_reconnect"}
+    timeline = []
+    work_dir = tempfile.mkdtemp(prefix="chaos_net_")
+    t0 = time.time()
+    pool = ep.ExecutorPool(count=2, slots=2)
+    try:
+        pool.start()
+        t_start = time.monotonic()
+        timeline.append((0.0, pool.capacity()))
+        pool.on_membership(lambda p: timeline.append(
+            (round(time.monotonic() - t_start, 3), p.capacity())))
+        ep.activate(pool)
+        info, box = {}, {}
+
+        def run():
+            try:
+                box["out"] = run_plan(plan, num_partitions=4,
+                                      work_dir=work_dir,
+                                      mesh_exchange="off", run_info=info)
+            except Exception as e:  # noqa: BLE001 — recorded below
+                box["err"] = e
+
+        t = threading.Thread(target=run)
+        t.start()
+        fired = False
+        deadline = time.monotonic() + 120
+        while not fired and t.is_alive() and time.monotonic() < deadline:
+            busy = pool.busy_pids()
+            if busy:
+                seat = next(iter(busy))
+                fired = pool.break_conn(seat)
+            else:
+                time.sleep(0.002)
+        t.join(timeout=300)
+        rec["fired"] = fired
+        if "err" in box:
+            rec["outcome"] = "classified_fail"
+            rec["error"] = f"{type(box['err']).__name__}: {box['err']}"[:300]
+        elif not fired:
+            rec["outcome"] = "no_fire"
+        else:
+            diff = validator._compare(
+                validator._to_pandas(box["out"]).reset_index(drop=True),
+                oracle().reset_index(drop=True))
+            rec["outcome"] = ("recovered" if diff is None
+                              else "wrong_answer")
+            if diff is not None:
+                rec["diff"] = diff
+        # let the resume settle before reading the counters
+        deadline = time.monotonic() + 10
+        while (fired and pool.stats()["reconnects_total"] == 0
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        rec["stats"] = pool.stats()
+        rec["capacity_timeline"] = timeline
+        caps = [c for _t, c in timeline]
+        rec["capacity_stable"] = min(caps) == caps[0]
+        deaths = [d for d in flight_recorder.list_dossiers(flight_dir)
+                  if d.get("trigger") == "executor_death"]
+        rec["death_dossiers"] = len(deaths)
+        kinds = {r.get("kind") for r in trace.TRACE.snapshot()
+                 if r.get("type") == "event"}
+        rec["control_reconnect_event"] = "control_reconnect" in kinds
+        rec["reconnect_ok"] = (not fired) or (
+            rec["stats"]["reconnects_total"] >= 1
+            and rec["stats"]["deaths_total"] == 0
+            and len(deaths) == 0
+            and rec["capacity_stable"]
+            and rec["control_reconnect_event"])
+    finally:
+        ep.deactivate(pool)
+        pool.close()
+        for k, v in saved.items():
+            setattr(conf, k, v)
+    rec["seconds"] = round(time.time() - t0, 3)
+    rec.update(_leaks([work_dir]))
+    shutil.rmtree(work_dir, ignore_errors=True)
+    return rec
+
+
+def _net_partition_round(tables, flight_dir):
+    """Asymmetric partition PAST the lease: a busy worker keeps
+    receiving but none of its sends reach the driver for longer than
+    executor_death_ms. Both ends must give up on the same schedule —
+    the driver cuts exactly ONE executor_death dossier (heartbeat) and
+    requeues, the worker's lease expires and it self-fences with exit
+    code 17, and the query still answers oracle-equal off the surviving
+    seat with no double-counted results."""
+    import threading
+
+    from blaze_tpu.config import conf
+    from blaze_tpu.runtime import executor_pool as ep
+    from blaze_tpu.runtime import flight_recorder
+    from blaze_tpu.spark import validator
+    from blaze_tpu.spark.local_runner import run_plan
+
+    paths, frames = tables
+    plan, oracle = validator.QUERIES["q3_join_agg_sort"](paths, frames,
+                                                         "smj")
+    saved = {k: getattr(conf, k) for k in
+             ("flight_dir", "executor_death_ms", "executor_heartbeat_ms")}
+    conf.flight_dir = flight_dir
+    conf.executor_death_ms = 800
+    conf.executor_heartbeat_ms = 50
+    rec = {"round": "asymmetric_partition"}
+    work_dir = tempfile.mkdtemp(prefix="chaos_net_")
+    t0 = time.time()
+    pool = ep.ExecutorPool(count=2, slots=2)
+    try:
+        pool.start()
+        ep.activate(pool)
+        info, box = {}, {}
+
+        def run():
+            try:
+                box["out"] = run_plan(plan, num_partitions=4,
+                                      work_dir=work_dir,
+                                      mesh_exchange="off", run_info=info)
+            except Exception as e:  # noqa: BLE001 — recorded below
+                box["err"] = e
+
+        t = threading.Thread(target=run)
+        t.start()
+        fired, proc = False, None
+        deadline = time.monotonic() + 120
+        while not fired and t.is_alive() and time.monotonic() < deadline:
+            busy = pool.busy_pids()
+            if busy:
+                seat = next(iter(busy))
+                # the chaos harness holds the child Popen to read the
+                # self-fence exit code after the seat is buried
+                with pool._lock:
+                    handle = pool._seats.get(seat)
+                    proc = handle.proc if handle else None
+                fired = pool.partition_executor(seat, 3000)
+            else:
+                time.sleep(0.002)
+        t.join(timeout=300)
+        rec["fired"] = fired
+        if "err" in box:
+            rec["outcome"] = "classified_fail"
+            rec["error"] = f"{type(box['err']).__name__}: {box['err']}"[:300]
+        elif not fired:
+            rec["outcome"] = "no_fire"
+        else:
+            diff = validator._compare(
+                validator._to_pandas(box["out"]).reset_index(drop=True),
+                oracle().reset_index(drop=True))
+            rec["outcome"] = ("recovered" if diff is None
+                              else "wrong_answer")
+            if diff is not None:
+                rec["diff"] = diff
+        # the partitioned worker self-fences at lease expiry (~800ms in)
+        exit_code = None
+        if proc is not None:
+            deadline = time.monotonic() + 30
+            while proc.poll() is None and time.monotonic() < deadline:
+                time.sleep(0.05)
+            exit_code = proc.poll()
+        # let the respawn land before reading recovery state
+        deadline = time.monotonic() + 30
+        while pool.live_count() < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        rec["stats"] = pool.stats()
+        rec["worker_exit_code"] = exit_code
+        rec["self_fenced"] = exit_code == 17
+        deaths = [d for d in flight_recorder.list_dossiers(flight_dir)
+                  if d.get("trigger") == "executor_death"]
+        rec["death_dossiers"] = len(deaths)
+        rec["partition_ok"] = (not fired) or (
+            len(deaths) == 1 and rec["self_fenced"])
+    finally:
+        ep.deactivate(pool)
+        pool.close()
+        for k, v in saved.items():
+            setattr(conf, k, v)
+    rec["seconds"] = round(time.time() - t0, 3)
+    rec.update(_leaks([work_dir]))
+    shutil.rmtree(work_dir, ignore_errors=True)
+    return rec
+
+
+def _net_rolling_drain_round(tables):
+    """Rolling restart of EVERY seat under concurrent service load:
+    SIGTERM each executor in turn (graceful drain -> respawn) while
+    client threads keep pushing the catalogue through QueryService.
+    The gate: 0 failed queries, 0 task requeues attributed to drained
+    seats, 0 executor deaths."""
+    import signal
+    import threading
+
+    from blaze_tpu.config import conf
+    from blaze_tpu.runtime import executor_pool as ep
+    from blaze_tpu.runtime import faults
+    from blaze_tpu.runtime.service import QueryService
+    from blaze_tpu.spark import validator
+    from blaze_tpu.spark.local_runner import run_plan
+
+    paths, frames = tables
+    saved = {"executor_drain_grace_ms": conf.executor_drain_grace_ms}
+    # a cold respawned worker pays the jax import on its first task;
+    # the drain must wait for that, not shed it
+    conf.executor_drain_grace_ms = 30_000
+    rec = {"round": "rolling_drain_restart"}
+    work_dirs = []
+    t0 = time.time()
+    pool = ep.ExecutorPool(count=2, slots=2)
+    try:
+        pool.start()
+        ep.activate(pool)
+        # warm both seats so drains race real work, not jax imports
+        plan, _oracle = validator.QUERIES["q1_scan_filter_project"](
+            paths, frames, "bhj")
+        wd = tempfile.mkdtemp(prefix="chaos_net_")
+        work_dirs.append(wd)
+        run_plan(plan, num_partitions=4, work_dir=wd, mesh_exchange="off")
+
+        n_queries = 6
+        results = [None] * n_queries
+        with QueryService() as svc:
+
+            def client(i, query, mode, plan, oracle, wd):
+                q = {"query": query}
+                try:
+                    out = svc.run(plan, f"tenant{i % 2}", num_partitions=4,
+                                  work_dir=wd, mesh_exchange="off")
+                    diff = validator._compare(
+                        validator._to_pandas(out).reset_index(drop=True),
+                        oracle().reset_index(drop=True))
+                    q["outcome"] = ("clean_ok" if diff is None
+                                    else "wrong_answer")
+                except faults.AdmissionRejected:
+                    q["outcome"] = "rejected_at_admission"
+                except Exception as e:  # noqa: BLE001 — recorded
+                    q["outcome"] = "classified_fail"
+                    q["error"] = f"{type(e).__name__}: {e}"[:300]
+                results[i] = q
+
+            threads = []
+            for i in range(n_queries):
+                query, mode = QUERIES[i % len(QUERIES)]
+                plan, oracle = validator.QUERIES[query](paths, frames,
+                                                        mode)
+                wd = tempfile.mkdtemp(prefix="chaos_net_")
+                work_dirs.append(wd)
+                threads.append(threading.Thread(
+                    target=client,
+                    args=(i, query, mode, plan, oracle, wd)))
+            for t in threads:
+                t.start()
+            # rolling restart: SIGTERM every seat, one at a time,
+            # waiting for each drain -> respawn cycle to complete
+            restarted = []
+            for seat, pid in sorted(pool.pids().items()):
+                os.kill(pid, signal.SIGTERM)
+                deadline = time.monotonic() + 120
+                while time.monotonic() < deadline:
+                    now_pids = pool.pids()
+                    if (pool.live_count() == 2
+                            and now_pids.get(seat) not in (None, pid)):
+                        break
+                    time.sleep(0.05)
+                restarted.append(seat)
+            rec["seats_restarted"] = restarted
+            for t in threads:
+                t.join(timeout=600)
+        rec["queries"] = [q for q in results if q is not None]
+        rec["stats"] = pool.stats()
+        failed = [q for q in rec["queries"]
+                  if q["outcome"] != "clean_ok"]
+        rec["failed_queries"] = len(failed)
+        rec["rolling_ok"] = (
+            len(restarted) == 2
+            and not failed
+            and rec["stats"]["drains_total"] >= 2
+            and rec["stats"]["drain_requeues_total"] == 0
+            and rec["stats"]["deaths_total"] == 0)
+    finally:
+        ep.deactivate(pool)
+        pool.close()
+        for k, v in saved.items():
+            setattr(conf, k, v)
+    rec["seconds"] = round(time.time() - t0, 3)
+    rec.update(_leaks(work_dirs))
+    for wd in work_dirs:
+        shutil.rmtree(wd, ignore_errors=True)
+    return rec
+
+
+def _network_soak(tables, args):
+    """The --network sweep (NETWORK_r19.json): (1) every net.* point x
+    wire-fault kind armed under a live 2-seat pool, oracle-equal + no
+    deaths; (2) transient control reset -> reconnect+resume, capacity
+    untouched, no dossier; (3) asymmetric partition past the lease ->
+    exactly one dossier + worker self-fence; (4) rolling drain/restart
+    of every seat under concurrent service load, zero failed queries."""
+    from blaze_tpu.config import conf
+    from blaze_tpu.runtime import executor_pool as ep
+
+    rounds = []
+    cells = []
+    # one SHARED warm pool for the cell sweep: wire faults are transient
+    # by contract, so the pool must survive every cell; per-cell pools
+    # would also re-pay the worker jax import 20x
+    saved_monitor = conf.monitor_enabled
+    conf.monitor_enabled = True  # telemetry must flow for net.telemetry
+    pool = ep.ExecutorPool(count=2, slots=2)
+    try:
+        pool.start()
+        ep.activate(pool)
+        warm = _net_cell(tables, pool, "net.control.send", "delay",
+                         args.seed)  # first cell doubles as the warm-up
+        warm["warmup"] = True
+        cells.append(warm)
+        print(f"[net]  warmup {warm['outcome']:15s} "
+              f"{warm['seconds']:.1f}s", flush=True)
+        for point, kinds in NET_CELLS:
+            for kind in kinds:
+                if point == "net.shuffle.fetch":
+                    cell = _net_shuffle_cell(kind, args.seed)
+                else:
+                    cell = _net_cell(tables, pool, point, kind, args.seed)
+                cells.append(cell)
+                print(f"[net]  {point:18s} {kind:9s} "
+                      f"{cell['outcome']:15s} fired={cell['fired']} "
+                      f"deaths={cell['deaths']} {cell['seconds']:.1f}s",
+                      flush=True)
+    finally:
+        ep.deactivate(pool)
+        pool.close()
+        conf.monitor_enabled = saved_monitor
+    rounds.append({"round": "net_cell_sweep", "cells": cells})
+
+    flight_root = tempfile.mkdtemp(prefix="chaos_net_flight_")
+    try:
+        r = _net_reconnect_round(tables,
+                                 os.path.join(flight_root, "reconnect"))
+        rounds.append(r)
+        print(f"[net]  control_reset {r['outcome']:15s} "
+              f"reconnects={r['stats']['reconnects_total']} "
+              f"dossiers={r['death_dossiers']} "
+              f"capacity_stable={r['capacity_stable']} "
+              f"event={r['control_reconnect_event']} "
+              f"{r['seconds']:.1f}s", flush=True)
+        r = _net_partition_round(tables,
+                                 os.path.join(flight_root, "partition"))
+        rounds.append(r)
+        print(f"[net]  partition     {r['outcome']:15s} "
+              f"dossiers={r['death_dossiers']} "
+              f"exit={r['worker_exit_code']} "
+              f"self_fenced={r['self_fenced']} {r['seconds']:.1f}s",
+              flush=True)
+    finally:
+        shutil.rmtree(flight_root, ignore_errors=True)
+    r = _net_rolling_drain_round(tables)
+    rounds.append(r)
+    print(f"[net]  rolling_drain restarted={r.get('seats_restarted')} "
+          f"failed={r.get('failed_queries')} "
+          f"drains={r['stats']['drains_total']} "
+          f"drain_requeues={r['stats']['drain_requeues_total']} "
+          f"{r['seconds']:.1f}s", flush=True)
     return rounds
 
 
@@ -1041,6 +1561,14 @@ def main() -> int:
                          "rows, clock-aligned spans, zero dropped rings, "
                          "federated ledger counters — plus a telemetry "
                          "on/off overhead A/B gated at <2%%")
+    ap.add_argument("--network", action="store_true",
+                    help="partition-tolerance acceptance: every net.* "
+                         "wire-fault cell (delay/reset/blackhole/torn/dup) "
+                         "under a live pool, a transient control reset "
+                         "(reconnect+resume, capacity untouched), an "
+                         "asymmetric partition past the lease (one "
+                         "dossier + worker self-fence), and a rolling "
+                         "drain/restart of every seat under service load")
     ap.add_argument("--concurrent-queries", type=int, default=8,
                     help="client sessions per --service round")
     ap.add_argument("--tenants", type=int, default=3,
@@ -1053,7 +1581,8 @@ def main() -> int:
     ap.add_argument("--json-out", default=None)
     args = ap.parse_args()
     if args.json_out is None:
-        args.json_out = ("DIST_OBS_r18.json" if args.dist_obs
+        args.json_out = ("NETWORK_r19.json" if args.network
+                         else "DIST_OBS_r18.json" if args.dist_obs
                          else "DURABILITY_r17.json" if (args.durability
                                                         or args.driver)
                          else "EXECUTORS_r16.json" if args.executors
@@ -1086,6 +1615,57 @@ def main() -> int:
 
     tmpdir = tempfile.mkdtemp(prefix="chaos_tables_")
     tables = validator.generate_tables(tmpdir, rows=args.rows)
+
+    if args.network:
+        try:
+            rounds = _network_soak(tables, args)
+        finally:
+            shutil.rmtree(tmpdir, ignore_errors=True)
+            for k, v in saved_conf.items():
+                setattr(conf, k, v)
+        bad = []
+        for r in rounds:
+            if r["round"] == "net_cell_sweep":
+                for c in r["cells"]:
+                    tag = f"{c['point']}/{c['kind']}"
+                    if c["outcome"] not in ("recovered", "no_fire"):
+                        bad.append({"cell": tag,
+                                    "outcome": c["outcome"]})
+                    if c.get("deaths"):
+                        bad.append({"cell": tag, "deaths": c["deaths"]})
+                    if (c.get("orphans") or c.get("mem_leaked")
+                            or c.get("pipeline_leaked")):
+                        bad.append({"cell": tag, "leaks": True})
+                continue
+            gate = {"control_reset_reconnect": "reconnect_ok",
+                    "asymmetric_partition": "partition_ok",
+                    "rolling_drain_restart": "rolling_ok"}[r["round"]]
+            if r.get("outcome") not in ("recovered", None):
+                bad.append({"round": r["round"],
+                            "outcome": r.get("outcome")})
+            if not r.get(gate):
+                bad.append({"round": r["round"], gate: False})
+            if (r.get("orphans") or r.get("mem_leaked")
+                    or r.get("pipeline_leaked")
+                    or r.get("resource_leaked")):
+                bad.append({"round": r["round"], "leaks": True})
+        cells = next(r["cells"] for r in rounds
+                     if r["round"] == "net_cell_sweep")
+        outcomes = {}
+        for c in cells:
+            outcomes[c["outcome"]] = outcomes.get(c["outcome"], 0) + 1
+        report = {
+            "rows": args.rows, "seed": args.seed,
+            "ok": not bad, "bad": bad,
+            "cell_outcomes": outcomes, "rounds": rounds,
+        }
+        with open(args.json_out, "w") as f:
+            json.dump(report, f, indent=1)
+        print(f"\nnetwork soak {'OK' if report['ok'] else 'FAILED'} "
+              f"{outcomes} -> {args.json_out}")
+        if bad:
+            print(f"bad: {bad}")
+        return 0 if report["ok"] else 1
 
     if args.dist_obs:
         flight_dir = tempfile.mkdtemp(prefix="chaos_dobs_flight_")
