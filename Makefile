@@ -8,14 +8,14 @@
 PYENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
 .PHONY: check check-fast check-faults check-supervisor check-trace \
-	check-durability check-dist-obs check-network \
+	check-durability check-dist-obs check-network check-elastic \
 	check-pipeline check-pipeline-soak check-perf check-perf-update \
 	check-obs check-history check-lint check-service check-doctor \
 	check-flight check-executors test test-fast validate validate-fast warm
 
 check: check-lint test validate check-perf check-history check-service \
 	check-doctor check-flight check-executors check-durability \
-	check-dist-obs check-network
+	check-dist-obs check-network check-elastic
 	@echo "CHECK OK — safe to commit"
 
 # Static invariant gate (tools/blazelint): lock discipline, knob
@@ -205,6 +205,20 @@ check-dist-obs:
 check-network:
 	$(PYENV) python tools/chaos_soak.py --network \
 	  --json-out NETWORK_r19.json
+
+# Elastic fleet & driver-HA gate (ISSUE 16): an 8-client catalogue
+# burst against a 1-seat pool must autoscale UP on parked arrivals
+# (typed scale_up decisions, ceiling respected) and drain back DOWN to
+# the floor after quiesce through the decommission barrier (zero drain
+# requeues, every answer oracle-equal); then a warm-standby subprocess
+# must survive SIGKILL of the primary driver AND two of its four
+# executors mid-query — epoch-bumped lease fencing, control-plane
+# rebind with the two survivors ADOPTED, dead-writer journal replay,
+# every query oracle-equal, exactly one driver_failover dossier, zero
+# orphans. Emits ELASTIC_r20.json.
+check-elastic:
+	$(PYENV) python tools/chaos_soak.py --elastic \
+	  --json-out ELASTIC_r20.json
 
 # Pre-warm the persistent compile caches (runtime/compile_service):
 # replays the shape manifest + the TPC-DS catalogue into the XLA cache.

@@ -317,7 +317,8 @@ _DECLARATIONS: Tuple[Knob, ...] = (
     Knob("flight_triggers", "all",
          doc="Comma list selecting which incident classes capture "
              "(failure, shed, deadline, hang, slo_breach, breaker_trip, "
-             "resource_leak, driver_restart); 'all' arms every class."),
+             "resource_leak, driver_restart, driver_failover); 'all' "
+             "arms every class."),
     Knob("progress_enabled", False,
          doc="Live per-query progress tracking (runtime/progress.py): "
              "per-stage rows/attempts/ETA served at /queries and "
@@ -417,6 +418,40 @@ _DECLARATIONS: Tuple[Knob, ...] = (
              "budget: fetches retry with exponential backoff within this "
              "window instead of blocking forever on a hung shuffle "
              "server. 0 = legacy blocking socket with one reconnect."),
+
+    # -- elastic fleet & driver HA (runtime/autoscaler.py,
+    # -- runtime/standby.py) --
+    Knob("autoscale_enabled", False,
+         doc="SLO-driven fleet autoscaler: a driver-side policy loop "
+             "reads admission parked arrivals, SLO burn rate and per-"
+             "seat busy-slot utilization, then actuates pool.spawn() / "
+             "pool.decommission() within [autoscale_min, autoscale_max] "
+             "seats. Scale-down drains the idlest seat through the "
+             "drain-ack barrier so in-flight queries never notice."),
+    Knob("autoscale_min", 1,
+         doc="Autoscaler floor: the fleet never drains below this many "
+             "serving seats, regardless of how idle they are."),
+    Knob("autoscale_max", 4,
+         doc="Autoscaler ceiling: scale-up stops here even while parked "
+             "arrivals persist (doctor's fleet_underprovisioned finding "
+             "suggests raising it when the policy pins at the ceiling)."),
+    Knob("autoscale_cooldown_ms", 5000,
+         doc="Hysteresis between autoscaler actuations: after a "
+             "scale_up/scale_down decision the policy observes without "
+             "acting for this long, so a burst cannot thrash spawn/"
+             "drain cycles."),
+    Knob("standby_enabled", False,
+         doc="Warm-standby driver (runtime/standby.py): a second "
+             "process tails journal_dir + the leader lease, detects "
+             "primary death by pid-liveness and takes over — rebinding "
+             "the executor control socket, replaying dead-writer "
+             "journals into resumable queries and resuming admission."),
+    Knob("leader_lease_ms", 2000,
+         doc="Leader lease freshness window: a lease whose holder pid "
+             "is dead, or unrenewed for longer than this, is up for "
+             "grabs. Takeover bumps the lease epoch so a paused-then-"
+             "resumed old primary self-fences on its next renew — the "
+             "same epoch posture PR 15 gave executors."),
 
     # -- per-operator enable flags (tier b, spark.blaze.enable.<op>) --
     Knob("enable_ops", default_factory=dict,

@@ -30,8 +30,9 @@ loop:
                   and one suggested knob. Rules: serde_bound,
                   skewed_partition, straggler_dominated, spill_bound,
                   compile_storm, admission_starved, queue_contended,
-                  breaker_degraded, pipeline_underlap,
-                  executor_skew, regression_vs_history. The
+                  breaker_degraded, network_flaky, pipeline_underlap,
+                  executor_skew, fleet_underprovisioned,
+                  fleet_overprovisioned, regression_vs_history. The
                   executor_skew rule is pooled-run only: federated task
                   spans carry the shipping worker's exec id (stamped by
                   trace.ingest_remote), so the doctor can attribute
@@ -524,6 +525,55 @@ def diagnose(record: dict,
                 "conf.enable_pipeline is on for I/O-bound stages",
                 {"overlap_pct": overlap, "producer_busy_ms": _r(busy),
                  "consumer_wait_ms": _r(wait)}))
+
+    # fleet_under/overprovisioned: the autoscaler's fleet snapshot
+    # (stamped into run records while the policy loop is active) says
+    # the seat count, not the query, was the bottleneck. Underprovision
+    # needs real pressure (parked arrivals / a non-empty queue / this
+    # query's own admission wait) with high per-seat utilization AND
+    # the policy pinned at autoscale_max — below the ceiling the
+    # autoscaler itself is the fix and needs no operator.
+    fleet = record.get("fleet") or {}
+    if fleet:
+        util = float(fleet.get("utilization", 0.0))
+        pressured = (int(fleet.get("parked_delta", 0)) > 0
+                     or int(fleet.get("queue_depth", 0)) > 0
+                     or adm_ms >= _MIN_ADMISSION_MS)
+        if fleet.get("at_max") and util >= 0.75 and pressured:
+            findings.append(Finding(
+                "fleet_underprovisioned",
+                min(0.3 + 0.5 * util, 0.9),
+                f"fleet pinned at autoscale_max="
+                f"{fleet.get('autoscale_max')} with "
+                f"{100 * util:.0f}% busy slots and arrivals still "
+                f"parking — the ceiling, not the query, bounds latency",
+                "raise conf.autoscale_max (the policy loop is already "
+                "asking for more seats)",
+                {"serving": fleet.get("serving"),
+                 "target_seats": fleet.get("target_seats"),
+                 "autoscale_max": fleet.get("autoscale_max"),
+                 "utilization": _r(util),
+                 "queue_depth": fleet.get("queue_depth", 0),
+                 "parked_delta": fleet.get("parked_delta", 0),
+                 "admission_wait_ms": _r(adm_ms)}))
+        serving = int(fleet.get("serving", 0))
+        floor = int(fleet.get("autoscale_min", 1))
+        if (serving > floor and util < 0.25
+                and int(fleet.get("queue_depth", 0)) == 0
+                and int(fleet.get("parked_delta", 0)) == 0):
+            findings.append(Finding(
+                "fleet_overprovisioned",
+                min(0.2 + 0.3 * (1.0 - util), 0.5),
+                f"{serving} seats serving at {100 * util:.0f}% busy "
+                f"slots with an empty queue — capacity above "
+                f"autoscale_min={floor} is idling",
+                "lower conf.autoscale_min (or enable "
+                "conf.autoscale_enabled so the policy drains idle "
+                "seats itself)",
+                {"serving": serving, "autoscale_min": floor,
+                 "utilization": _r(util),
+                 "busy_slots": fleet.get("busy_slots", 0),
+                 "target_seats": fleet.get("target_seats")}))
 
     # regression_vs_history: stages slower than their fingerprint's past
     if feed is not None:

@@ -264,6 +264,19 @@ class ExecutorPool:
         self._done_epochs: "OrderedDict[str, int]" = OrderedDict()
         self._seat_restarts: Dict[int, int] = {}
         self._respawns_pending = 0
+        # seat indexes with a replacement in flight (the count above
+        # can't answer "is THIS seat coming back" — spawn() must not
+        # hand an autoscaler a seat the respawn path is about to fill)
+        self._respawn_seats: set = set()
+        # next free generation per seat: tokens must never repeat (the
+        # watchdog registry and the flight recorder's exactly-once
+        # dedup key on them), even across decommission + re-spawn
+        self._next_gen: Dict[int, int] = {}
+        # standby takeover (rebind): manifest seats whose process was
+        # alive at takeover — token -> (seat, generation, pid); their
+        # resume hello adopts them instead of being refused
+        self._adoptable: Dict[str, tuple] = {}
+        self.adopted_total = 0
         self._membership_cbs: List[Callable[["ExecutorPool"], None]] = []
         self._closed = False
         self._listener: Optional[socket.socket] = None
@@ -283,11 +296,13 @@ class ExecutorPool:
     # -- lifecycle -----------------------------------------------------
 
     def start(self) -> "ExecutorPool":
-        if self.count <= 0:
+        with self._lock:
+            count = self.count          # spawn() grows it under _lock
+        if count <= 0:
             raise ValueError("executor pool needs count >= 1")
         listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         listener.bind(self._ctl_path)
-        listener.listen(self.count * 2 + 4)
+        listener.listen(count * 2 + 4)
         self._listener = listener
         self.server.start()
         for name, target in (("blz-pool-accept", self._accept_loop),
@@ -295,7 +310,7 @@ class ExecutorPool:
             t = threading.Thread(target=target, name=name, daemon=True)
             t.start()
             self._threads.append(t)
-        for seat in range(self.count):
+        for seat in range(count):
             self._spawn(seat, 0)
         deadline = time.monotonic() + self._READY_TIMEOUT
         with self._cv:
@@ -309,7 +324,128 @@ class ExecutorPool:
                 self._cv.wait(min(left, 0.25))
         return self
 
+    # -- elastic fleet & driver HA -------------------------------------
+
+    def spawn(self) -> Optional[int]:
+        """Scale-up actuator (runtime/autoscaler.py): start one NEW
+        worker on the lowest seat index that is neither occupied, nor
+        awaiting its hello, nor about to be refilled by a respawn.
+        Returns the seat (None when the pool is closed); the seat joins
+        capacity when its handshake lands — callers watch membership
+        callbacks rather than blocking here."""
+        with self._cv:
+            if self._closed:
+                return None
+            taken = set(self._seats)
+            taken.update(s for s, _g, _p in self._awaiting.values())
+            taken.update(self._respawn_seats)
+            seat = 0
+            while seat in taken:
+                seat += 1
+            self.count = max(self.count, seat + 1)
+        self._spawn(seat, 0)
+        return seat
+
+    def manifest(self) -> dict:
+        """Fleet manifest for the warm standby (runtime/standby.py):
+        enough topology to rebind the control plane after a driver
+        death. The socket DIRECTORY outlives the driver process, and
+        surviving workers keep re-dialing ctl_path until their lease
+        expires — so a standby that binds the same path inside the
+        lease window inherits the fleet."""
+        with self._lock:
+            seats = [{"seat": h.seat, "generation": h.generation,
+                      "token": h.token, "pid": h.pid}
+                     for h in self._seats.values() if not h.dead]
+            count = self.count
+        return {"pool_id": self._pool_id, "dir": self._dir,
+                "ctl_path": self._ctl_path,
+                "shuffle_path": self.server.sock_path,
+                "count": count, "slots": self.slots,
+                "pid": os.getpid(), "seats": seats}
+
+    @classmethod
+    def rebind(cls, manifest: dict) -> "ExecutorPool":
+        """Standby takeover, step 1: construct a pool wired to the DEAD
+        primary's socket topology instead of a fresh temp dir. Call
+        start_rebound() (not start()) to bind and adopt."""
+        pool = cls(count=max(int(manifest.get("count", 1)), 1),
+                   slots=int(manifest.get("slots", conf.executor_slots)))
+        shutil.rmtree(pool._dir, ignore_errors=True)  # unused fresh dir
+        pool._dir = manifest["dir"]
+        pool._pool_id = (manifest.get("pool_id")
+                         or os.path.basename(pool._dir))
+        pool._ctl_path = manifest["ctl_path"]
+        pool.server = ss.ShuffleServer(manifest["shuffle_path"])
+        for s in manifest.get("seats") or []:
+            pool._adoptable[s["token"]] = (int(s["seat"]),
+                                           int(s["generation"]),
+                                           int(s["pid"]))
+            pool._next_gen[int(s["seat"])] = int(s["generation"]) + 1
+        return pool
+
+    def start_rebound(self, adopt_window_s: float = 5.0
+                      ) -> "ExecutorPool":
+        """Standby takeover, step 2: bind listener + shuffle server at
+        the dead primary's socket paths (unlinking its stale socket
+        FILES — the fds died with it) and re-own the fleet. Manifest
+        seats whose pid is already gone are respawned fresh under a
+        bumped generation; live ones are adopted as their bounded
+        reconnect loop re-dials ctl_path (_resume). Seats still
+        unclaimed after the adoption window get fresh workers too — a
+        hung or partitioned survivor will self-fence on its own lease
+        and must not hold a seat hostage."""
+        from blaze_tpu.runtime import artifacts
+
+        with self._lock:
+            count = self.count
+        if count <= 0:
+            raise ValueError("executor pool needs count >= 1")
+        for path in (self._ctl_path, self.server.sock_path):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        listener.bind(self._ctl_path)
+        listener.listen(count * 2 + 4)
+        self._listener = listener
+        self.server.start()
+        for name, target in (("blz-pool-accept", self._accept_loop),
+                             ("blz-pool-dispatch", self._dispatch_loop)):
+            t = threading.Thread(target=target, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+        with self._cv:
+            adoptable = dict(self._adoptable)
+        for token, (seat, generation, pid) in sorted(adoptable.items()):
+            if not artifacts._pid_alive(pid):
+                with self._cv:
+                    self._adoptable.pop(token, None)
+                self._spawn(seat, generation + 1)
+        deadline = time.monotonic() + max(adopt_window_s, 0.0)
+        with self._cv:
+            while self._adoptable and time.monotonic() < deadline:
+                self._cv.wait(0.1)
+            unclaimed, self._adoptable = dict(self._adoptable), {}
+        for token, (seat, generation, _pid) in sorted(unclaimed.items()):
+            self._spawn(seat, generation + 1)
+        deadline = time.monotonic() + self._READY_TIMEOUT
+        with self._cv:
+            while (len([h for h in self._seats.values() if not h.dead])
+                   < self.count):
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise TimeoutError(
+                        f"rebound pool: {len(self._seats)}/{self.count} "
+                        f"workers joined within {self._READY_TIMEOUT}s")
+                self._cv.wait(min(left, 0.25))
+        return self
+
     def _spawn(self, seat: int, generation: int) -> None:
+        with self._lock:
+            generation = max(generation, self._next_gen.get(seat, 0))
+            self._next_gen[seat] = generation + 1
         token = f"exec{seat}g{generation}.{self._pool_id}"
         env = dict(os.environ)
         env[_ENV_TOKEN] = token
@@ -426,6 +562,8 @@ class ExecutorPool:
                 inflight = list(handle.inflight.values())
                 self._cv.notify_all()
         if handle is None:
+            if self._adopt(conn, token, msg):
+                return
             # the seat was already declared dead (or the pool closed):
             # refusing the resume makes the worker's lease the authority
             conn.close()
@@ -468,6 +606,57 @@ class ExecutorPool:
                              name=f"blz-pool-rd-{handle.seat}", daemon=True)
         t.start()
         self._threads.append(t)
+
+    def _adopt(self, conn: socket.socket, token: str,
+               msg: dict) -> bool:
+        """Standby takeover: a surviving worker of the DEAD primary
+        re-dialed the rebound listener with its resume hello. Its token
+        matches no live handle here — but it does match the fleet
+        manifest, so instead of refusing (which would self-fence a
+        perfectly healthy process mid-task) the rebound pool adopts it:
+        a fresh handle with proc=None (no child to reap — the watchdog
+        falls back to pid-liveness), the worker's telemetry watermark
+        carried over so sidecar recovery stays exactly-once."""
+        from blaze_tpu.runtime import trace
+
+        with self._cv:
+            pending = self._adoptable.pop(token, None)
+            if pending is None or self._closed:
+                return False
+            seat, generation, pid = pending
+            cur = self._seats.get(seat)
+            if cur is not None and not cur.dead:
+                return False  # seat already refilled; lease buries it
+        handle = ExecutorHandle(seat, generation, token,
+                                int(msg.get("pid", pid)), None, conn)
+        handle.tel_seq = int(msg.get("tel_seq", 0))
+        mono = msg.get("mono_ns")
+        if mono is not None:
+            handle.clock_offset_ns = _clamp_offset(
+                time.monotonic_ns() - int(mono))
+        with self._cv:
+            if self._closed:
+                handle.closing = True
+            self._seats[seat] = handle
+            self._cv.notify_all()
+        if handle.closing:
+            conn.close()
+            return True
+        self.watchdog.register(
+            token, handle.pid,
+            lambda peer, reason, rc, h=handle: self._on_peer_death(
+                h, reason, rc))
+        t = threading.Thread(target=self._reader, args=(handle, conn),
+                             name=f"blz-pool-rd-{seat}", daemon=True)
+        t.start()
+        self._threads.append(t)
+        self.adopted_total += 1
+        trace.event("executor_adopted", exec_id=handle.exec_id,
+                    token=token, pid=handle.pid,
+                    generation=generation,
+                    worker_tel_seq=handle.tel_seq)
+        self._notify_membership()
+        return True
 
     # -- socket reader -------------------------------------------------
 
@@ -738,6 +927,7 @@ class ExecutorPool:
             if will_respawn:
                 self._seat_restarts[handle.seat] = restarts + 1
                 self._respawns_pending += 1
+                self._respawn_seats.add(handle.seat)
             self._cv.notify_all()
         self.watchdog.unregister(handle.token)
         if emit_event:
@@ -829,9 +1019,12 @@ class ExecutorPool:
         with self._cv:
             self._respawns_pending -= 1
             if self._closed:
+                self._respawn_seats.discard(seat)
                 return
         self.restarts_total += 1
         self._spawn(seat, generation)
+        with self._cv:
+            self._respawn_seats.discard(seat)
 
     # -- graceful decommission -----------------------------------------
 
@@ -928,6 +1121,7 @@ class ExecutorPool:
             respawn = not handle.decommissioned
             if respawn:
                 self._respawns_pending += 1
+                self._respawn_seats.add(handle.seat)
             self._cv.notify_all()
         self.watchdog.unregister(handle.token)
         for task in leftovers:
@@ -953,8 +1147,11 @@ class ExecutorPool:
         with self._cv:
             self._respawns_pending -= 1
             if self._closed:
+                self._respawn_seats.discard(seat)
                 return
         self._spawn(seat, generation)
+        with self._cv:
+            self._respawn_seats.discard(seat)
 
     # -- membership / capacity -----------------------------------------
 
@@ -1019,7 +1216,8 @@ class ExecutorPool:
             tel_bytes = self.telemetry_bytes_total
             tel_records = self.telemetry_records_total
             shuffle_dropped = self.server.conns_dropped
-        return {"count": self.count, "live": live,
+            count = self.count
+        return {"count": count, "live": live,
                 "draining": draining,
                 "capacity": (live - draining) * self.slots,
                 "slots": self.slots,

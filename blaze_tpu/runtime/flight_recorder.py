@@ -11,10 +11,13 @@ temp + fsync + os.replace) as one JSON *dossier* under conf.flight_dir —
 one file answers "what happened to query X at 3am".
 
   triggers   failure / shed / deadline / hang / slo_breach /
-             breaker_trip / resource_leak — each (query, trigger) pair
-             captures at most ONCE (a retry storm must not write a
-             dossier per retry). conf.flight_triggers ("all" or a
-             comma list) selects which classes arm.
+             breaker_trip / resource_leak / executor_death /
+             driver_restart / driver_failover — each (query, trigger)
+             pair captures at most ONCE (a retry storm must not write
+             a dossier per retry; a standby takeover writes exactly one
+             driver_failover dossier, keyed on its lease epoch).
+             conf.flight_triggers ("all" or a comma list) selects
+             which classes arm.
 
   contents   schema-versioned: the query's trace-ring slice, the
              monitor ring's gauge samples over the query's lifetime,
@@ -61,7 +64,7 @@ SCHEMA_VERSION = 1
 
 TRIGGERS = ("failure", "shed", "deadline", "hang", "slo_breach",
             "breaker_trip", "resource_leak", "executor_death",
-            "driver_restart")
+            "driver_restart", "driver_failover")
 
 _lock = threading.Lock()
 _captured: set = set()            # (query_id, trigger): exactly-once

@@ -592,6 +592,9 @@ GAUGE_NAMES = (
     "blaze_service_capacity",
     "blaze_artifact_corruptions_total",
     "blaze_recovered_queries_total",
+    "blaze_autoscale_target_seats",
+    "blaze_autoscale_decisions_total",
+    "blaze_driver_role",
 )
 GAUGE_PREFIXES = (
     "blaze_pipeline_",  # pipeline.TELEMETRY counters
@@ -823,6 +826,24 @@ def prometheus_text() -> str:
          "Queries that reused journaled stage commits after a driver "
          "restart",
          [({}, journal.recovered_queries_total())])
+
+    # elastic fleet & driver HA (runtime/autoscaler.py, standby.py):
+    # the policy's seat target + decision counters, and which role this
+    # process holds — a standby scrapes role=standby until takeover
+    from blaze_tpu.runtime import autoscaler, standby
+
+    asc = autoscaler.state()
+    emit("blaze_autoscale_target_seats", "gauge",
+         "Autoscaler's desired serving seat count (absent with the "
+         "policy loop off)",
+         [({}, asc["target_seats"])] if asc else [])
+    emit("blaze_autoscale_decisions_total", "counter",
+         "Autoscaler actuations, by direction",
+         [({"direction": d}, n)
+          for d, n in sorted((asc or {}).get("decisions", {}).items())])
+    emit("blaze_driver_role", "gauge",
+         "Driver role of this process (1 for the held role)",
+         [({"role": standby.role()}, 1)])
     # bounded label cardinality: live queries plus the last-N finished
     # ring (progress.finished_queries) — older finished series age out of
     # the exposition instead of accumulating one {qid=} series per query
@@ -892,8 +913,11 @@ def health_snapshot() -> Dict[str, Any]:
     staleness for container probes, without the full exposition. With an
     executor pool attached, ok flips False ONLY at zero live executors
     (degraded-but-serving capacity is healthy — the probe must not
-    restart a pod that is recovering one seat)."""
-    from blaze_tpu.runtime import executor_pool
+    restart a pod that is recovering one seat). Reports this process's
+    driver `role` and the autoscaler's policy state: a warm standby has
+    no pool attached, so it serves 200 with role=standby — load
+    balancers probe both drivers with the same check."""
+    from blaze_tpu.runtime import autoscaler, executor_pool, standby
 
     s = sampler()
     ring = s.ring() if s is not None else []
@@ -902,8 +926,16 @@ def health_snapshot() -> Dict[str, Any]:
     ok = True
     if ps is not None:
         ok = ps["live"] > 0
+    asc = autoscaler.state()
     return {
         "ok": ok,
+        "role": standby.role(),
+        "standby_enabled": bool(conf.standby_enabled),
+        "autoscaler": (None if asc is None else {
+            "target_seats": asc["target_seats"],
+            "last_decision": asc["last_decision"],
+            "cooldown_remaining_ms": asc["cooldown_remaining_ms"],
+        }),
         "executors_live": ps["live"] if ps else None,
         "executors_draining": ps.get("draining") if ps else None,
         "capacity": ps["capacity"] if ps else None,

@@ -242,12 +242,13 @@ class ProcessPeer:
     succeeds on it."""
 
     __slots__ = ("key", "pid", "last_beat", "poll", "on_death", "dead",
-                 "draining")
+                 "draining", "stale_ms")
 
     def __init__(self, key: str, pid: int,
                  on_death: Callable[["ProcessPeer", str, Optional[int]],
                                     None],
-                 poll: Optional[Callable[[], Optional[int]]] = None) -> None:
+                 poll: Optional[Callable[[], Optional[int]]] = None,
+                 stale_ms: Optional[int] = None) -> None:
         self.key = key
         self.pid = pid
         self.last_beat = time.monotonic()
@@ -255,6 +256,11 @@ class ProcessPeer:
         self.on_death = on_death
         self.dead = False
         self.draining = False
+        # per-peer staleness override: None -> conf.executor_death_ms;
+        # 0 -> pid-liveness ONLY (a peer that never beats this watchdog
+        # — the standby watching its primary — must not be declared
+        # heartbeat-dead for silence that is perfectly healthy)
+        self.stale_ms = stale_ms
 
     def beat(self) -> None:
         self.last_beat = time.monotonic()
@@ -279,8 +285,9 @@ class ProcessWatchdog:
         self._thread: Optional[threading.Thread] = None
 
     def register(self, key: str, pid: int, on_death,
-                 poll=None) -> ProcessPeer:
-        peer = ProcessPeer(key, pid, on_death, poll=poll)
+                 poll=None, stale_ms=None) -> ProcessPeer:
+        peer = ProcessPeer(key, pid, on_death, poll=poll,
+                           stale_ms=stale_ms)
         with self._lock:
             self._peers[key] = peer
             if self._thread is None:
@@ -337,16 +344,28 @@ class ProcessWatchdog:
             if peer.dead:
                 continue
             gone, rc = self._pid_gone(peer)
+            peer_stale_s = (stale_s if peer.stale_ms is None
+                            else max(int(peer.stale_ms), 0) / 1000.0)
             if gone:
                 reason = "exit"
             elif peer.draining:
                 continue  # a draining peer may idle past staleness
-            elif now - peer.last_beat > stale_s:
+            elif peer_stale_s > 0 and now - peer.last_beat > peer_stale_s:
                 reason, rc = "heartbeat", None
             else:
                 continue
             peer.dead = True
             self.unregister(peer.key)
+            if peer.stale_ms == 0:
+                # a pid-liveness-only peer is a SILENT watch on a
+                # non-heartbeating process (the standby watching its
+                # primary, standby.StandbyDriver) — route the death to
+                # the owner but do not account it as an executor death
+                try:
+                    peer.on_death(peer, reason, rc)
+                except Exception:  # noqa: BLE001 — must not kill scan
+                    pass
+                continue
             if peer.draining and rc in (0, None):
                 # clean exit of a decommissioning worker: route to the
                 # owner as "drained", no dossier, no death accounting

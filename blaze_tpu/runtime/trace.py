@@ -189,8 +189,12 @@ EVENT_KINDS = (
     "deadline_exceeded",    # executor: task/query budget exhausted
     "deadline_kill",        # supervisor: budget exhausted mid-attempt
     "degrade",              # executor: resilience-ladder rung taken
+    "driver_failover",      # standby: warm standby fenced the dead
+                            # primary's lease and took over the fleet
     "driver_recovery",      # journal: recovery scan replayed a journal
     "epoch_fenced",         # artifacts.EpochFence: stale attempt rejected
+    "executor_adopted",     # executor_pool: rebound listener adopted a
+                            # surviving worker via its resume handshake
     "executor_death",       # supervisor/pool: executor process declared dead
     "executor_drain",       # executor_pool: seat gracefully decommissioned
                             # (drain completed; not a death)
@@ -205,6 +209,8 @@ EVENT_KINDS = (
     "ladder_rung",          # executor: degradation ladder transition
     "lease_expired",        # executor_pool worker: driver unreachable past
                             # executor_death_ms; self-fenced (exit 17)
+    "lease_fenced",         # standby: a stale primary saw a higher lease
+                            # epoch on renew and stood down
     "mem_release",          # memory: reservation released by sweep
     "orphan_sweep",         # artifacts: stale attempt files removed
     "partition_suspected",  # executor_pool: control conn broken but the
@@ -214,6 +220,10 @@ EVENT_KINDS = (
     "queue_depth",          # pipeline: sampler queue-depth reading
     "resource_leak",        # monitor: leaked reservation/stream detected
     "retry",                # executor: retryable failure retried
+    "scale_down",           # autoscaler: idlest seat drained out
+                            # (evidence: utilization, idle ticks)
+    "scale_up",             # autoscaler: seat spawned (evidence: parked
+                            # arrivals / SLO burn / utilization)
     "shuffle_conn_dropped", # shuffle_server: client connection dropped
                             # mid-request (reset/torn frame/CRC mismatch)
     "slo_burn",             # service: tenant SLO budget burning hot
@@ -868,6 +878,15 @@ def build_run_record(query_id: str, run_info: Optional[dict] = None,
             for name, s in histograms_snapshot().items()},
         "dropped_events": TRACE.dropped,
     }
+    # elastic-fleet evidence (runtime/autoscaler.py): while the policy
+    # loop is active, every ledger line carries the fleet posture at
+    # query end so doctor's fleet_under/overprovisioned rules can rank
+    # offline, from the record alone
+    from blaze_tpu.runtime import autoscaler
+
+    fleet = autoscaler.fleet_snapshot()
+    if fleet:
+        rec["fleet"] = fleet
     if conf.doctor_enabled:
         from blaze_tpu.runtime import doctor
 

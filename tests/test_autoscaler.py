@@ -1,0 +1,351 @@
+"""SLO-driven fleet autoscaler (ISSUE 16): the policy loop over
+ExecutorPool.spawn()/decommission().
+
+Policy-level tests drive Autoscaler.tick() directly against fake
+pool/service objects (no processes, no jax): evidence must be
+SUSTAINED (UP_TICKS / DOWN_TICKS consecutive ticks) before the fleet
+resizes, actuations respect [autoscale_min, autoscale_max], cooldown
+hysteresis blocks back-to-back resizes, and scale-down always picks
+the idlest seat. One real-pool test proves the drain barrier: a
+scale-down fired while every seat holds in-flight work must let the
+chosen seat FINISH (zero drain requeues) and remove it without a
+death.
+
+The full burst round (8 clients through QueryService, scale-up on
+parked arrivals, quiesce back to the floor) and the warm-standby
+failover are `tools/chaos_soak.py --elastic` / `make check-elastic`.
+"""
+
+import threading
+import time
+
+import pytest
+
+from blaze_tpu.config import conf
+from blaze_tpu.runtime import autoscaler as asc
+
+
+@pytest.fixture(autouse=True)
+def _autoscale_conf():
+    saved = {k: getattr(conf, k) for k in
+             ("autoscale_enabled", "autoscale_min", "autoscale_max",
+              "autoscale_cooldown_ms")}
+    conf.autoscale_enabled = True
+    conf.autoscale_min = 1
+    conf.autoscale_max = 4
+    conf.autoscale_cooldown_ms = 0
+    yield
+    asc.deactivate()
+    for k, v in saved.items():
+        setattr(conf, k, v)
+
+
+class FakePool:
+    """executors()/spawn()/decommission() with recorded actuations."""
+
+    def __init__(self, seats=1, slots=2, inflight=None):
+        self.slots = slots
+        self._seats = {}
+        for i in range(seats):
+            self._seats[i] = {"exec_id": f"exec{i}", "up": True,
+                              "draining": False,
+                              "inflight": (inflight or {}).get(i, 0)}
+        self.spawned = []
+        self.decommissioned = []
+
+    def executors(self):
+        return [dict(e) for e in self._seats.values()]
+
+    def spawn(self):
+        seat = max(self._seats) + 1 if self._seats else 0
+        self._seats[seat] = {"exec_id": f"exec{seat}", "up": True,
+                             "draining": False, "inflight": 0}
+        self.spawned.append(seat)
+        return seat
+
+    def decommission(self, seat):
+        if seat not in self._seats:
+            return False
+        del self._seats[seat]
+        self.decommissioned.append(seat)
+        return True
+
+
+class FakeService:
+    def __init__(self):
+        self.queue_depth = 0
+        self.parked_total = 0
+
+    def stats(self):
+        return {"queue_depth": self.queue_depth,
+                "parked": self.parked_total}
+
+
+def _scaler(pool, svc=None, burn=0.0):
+    return asc.Autoscaler(pool, service=svc,
+                          slo_stats=lambda: {"t0": {"burn_rate": burn}})
+
+
+# ---------------------------------------------------------------------------
+# scale-up policy
+# ---------------------------------------------------------------------------
+
+
+def test_one_noisy_tick_never_scales():
+    pool, svc = FakePool(seats=1), FakeService()
+    scaler = _scaler(pool, svc)
+    scaler.tick()                      # baseline (parked watermark)
+    svc.parked_total += 1
+    assert scaler.tick() is None       # streak 1 < UP_TICKS
+    assert pool.spawned == []
+
+
+def test_sustained_parked_arrivals_scale_up():
+    pool, svc = FakePool(seats=1), FakeService()
+    scaler = _scaler(pool, svc)
+    scaler.tick()
+    for _ in range(asc.UP_TICKS - 1):
+        svc.parked_total += 1
+        assert scaler.tick() is None
+    svc.parked_total += 1
+    assert scaler.tick() == "up"
+    assert pool.spawned == [1]
+    assert scaler.decisions == {"up": 1, "down": 0}
+    assert scaler.last_decision["direction"] == "up"
+    assert scaler.last_decision["evidence"]["parked_delta"] == 1
+    assert scaler.target_seats == 2
+
+
+def test_sustained_queue_depth_scales_up():
+    pool, svc = FakePool(seats=1), FakeService()
+    svc.queue_depth = 3
+    scaler = _scaler(pool, svc)
+    for _ in range(asc.UP_TICKS):
+        scaler.tick()
+    assert pool.spawned == [1]
+
+
+def test_slo_burn_scales_up():
+    pool = FakePool(seats=1)
+    scaler = _scaler(pool, burn=2.0)
+    for _ in range(asc.UP_TICKS):
+        scaler.tick()
+    assert pool.spawned == [1]
+    assert scaler.last_decision["evidence"]["max_burn"] == 2.0
+
+
+def test_scale_up_pinned_at_autoscale_max():
+    conf.autoscale_max = 1
+    pool, svc = FakePool(seats=1), FakeService()
+    svc.queue_depth = 5
+    scaler = _scaler(pool, svc)
+    for _ in range(10):
+        assert scaler.tick() is None
+    assert pool.spawned == []
+
+
+# ---------------------------------------------------------------------------
+# scale-down policy
+# ---------------------------------------------------------------------------
+
+
+def test_idle_fleet_drains_idlest_seat():
+    # util = 1/(3*2) < IDLE_FLOOR; seats 1 and 2 are tied idle — the
+    # HIGHEST index drains (lowest seats are the stable core)
+    pool = FakePool(seats=3, inflight={0: 1})
+    scaler = _scaler(pool)
+    for _ in range(asc.DOWN_TICKS - 1):
+        assert scaler.tick() is None
+    assert scaler.tick() == "down"
+    assert pool.decommissioned == [2]
+    assert scaler.decisions["down"] == 1
+    assert scaler.target_seats == 2
+
+
+def test_scale_down_pinned_at_autoscale_min():
+    pool = FakePool(seats=1)
+    scaler = _scaler(pool)
+    for _ in range(3 * asc.DOWN_TICKS):
+        assert scaler.tick() is None
+    assert pool.decommissioned == []
+
+
+def test_queue_pressure_blocks_scale_down():
+    pool, svc = FakePool(seats=2), FakeService()
+    svc.queue_depth = 1                # pressured AND 0% utilization
+    scaler = _scaler(pool, svc)
+    for _ in range(2 * asc.DOWN_TICKS):
+        scaler.tick()
+    assert pool.decommissioned == []
+
+
+def test_busy_fleet_blocks_scale_down():
+    pool = FakePool(seats=2, inflight={0: 2, 1: 2})  # 100% utilization
+    scaler = _scaler(pool)
+    for _ in range(2 * asc.DOWN_TICKS):
+        assert scaler.tick() is None
+    assert pool.decommissioned == []
+
+
+# ---------------------------------------------------------------------------
+# hysteresis
+# ---------------------------------------------------------------------------
+
+
+def test_cooldown_blocks_back_to_back_resizes():
+    conf.autoscale_cooldown_ms = 60_000
+    pool, svc = FakePool(seats=1), FakeService()
+    svc.queue_depth = 5
+    scaler = _scaler(pool, svc)
+    for _ in range(asc.UP_TICKS):
+        scaler.tick()
+    assert pool.spawned == [1]
+    for _ in range(10):                # still pressured, still cooling
+        assert scaler.tick() is None
+    assert pool.spawned == [1]
+    assert scaler.cooldown_remaining_ms() > 0
+
+
+def test_actuation_resets_streaks():
+    pool, svc = FakePool(seats=1), FakeService()
+    svc.queue_depth = 5
+    scaler = _scaler(pool, svc)
+    for _ in range(asc.UP_TICKS):
+        scaler.tick()
+    assert scaler._up_streak == 0      # evidence must re-accumulate
+    assert scaler.tick() is None       # streak 1 after the decision
+    assert pool.spawned == [1]
+
+
+# ---------------------------------------------------------------------------
+# introspection & module registry
+# ---------------------------------------------------------------------------
+
+
+def test_state_and_fleet_snapshot_shape():
+    pool = FakePool(seats=2)
+    scaler = _scaler(pool)
+    st = scaler.state()
+    assert st["seats"] == 2 and st["target_seats"] == 2
+    assert st["min"] == 1 and st["max"] == 4
+    assert st["decisions"] == {"up": 0, "down": 0}
+    snap = scaler.fleet_snapshot()
+    assert snap["serving"] == 2 and snap["at_max"] is False
+    assert snap["autoscale_max"] == 4
+    conf.autoscale_max = 2
+    assert scaler.fleet_snapshot()["at_max"] is True
+
+
+def test_module_registry_activate_and_none_safety():
+    assert asc.active() is None
+    assert asc.state() is None
+    assert asc.fleet_snapshot() is None
+    scaler = _scaler(FakePool(seats=1))
+    asc.activate(scaler)
+    assert asc.active() is scaler
+    assert asc.state()["seats"] == 1
+    asc.deactivate(scaler)
+    assert asc.active() is None
+
+
+def test_background_loop_scales_up(tmp_path):
+    conf.autoscale_cooldown_ms = 10
+    pool, svc = FakePool(seats=1), FakeService()
+    svc.queue_depth = 4
+    scaler = asc.Autoscaler(pool, service=svc, slo_stats=lambda: {},
+                            tick_s=0.01)
+    scaler.start()
+    try:
+        deadline = time.monotonic() + 5
+        while not pool.spawned and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert pool.spawned
+        assert asc.active() is scaler   # start() activates the registry
+    finally:
+        scaler.close()
+    assert asc.active() is None
+
+
+# ---------------------------------------------------------------------------
+# real pool: the drain barrier under load
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def pool_conf():
+    saved = {k: getattr(conf, k) for k in
+             ("executor_death_ms", "executor_heartbeat_ms",
+              "executor_drain_grace_ms")}
+    conf.executor_death_ms = 8000
+    conf.executor_heartbeat_ms = 50
+    conf.executor_drain_grace_ms = 30_000
+    yield
+    for k, v in saved.items():
+        setattr(conf, k, v)
+
+
+def test_scale_down_drains_busy_seat_without_requeue(pool_conf):
+    """Fire a scale-down while BOTH seats hold in-flight sleeps: the
+    drain-ack barrier must let the decommissioned seat finish its work
+    (all results delivered, zero requeues), then remove it — no death,
+    no respawn."""
+    from blaze_tpu.runtime import executor_pool as ep
+
+    pool = ep.ExecutorPool(count=2, slots=2)
+    try:
+        pool.start()
+        scaler = asc.Autoscaler(pool)
+        box = {}
+
+        def run():
+            specs = [ep.PoolTaskSpec(f"s:{i}", "sleep", {"ms": 1500})
+                     for i in range(4)]
+            box["out"] = pool.run_tasks(specs, timeout=120)
+
+        t = threading.Thread(target=run)
+        t.start()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if sum(e["inflight"] for e in pool.executors()) >= 4:
+                break
+            time.sleep(0.005)
+        assert scaler._scale_down(scaler._observe()) == "down"
+        t.join(timeout=120)
+        assert len(box.get("out", [])) == 4
+        # drains_total counts COMPLETED drains (the worker's exit, not
+        # the decommission order) — wait for the seat to retire
+        deadline = time.monotonic() + 30
+        while pool.live_count() > 1 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert pool.live_count() == 1   # decommission does NOT respawn
+        st = pool.stats()
+        assert st["drains_total"] == 1
+        assert st["drain_requeues_total"] == 0
+        assert st["deaths_total"] == 0
+        assert scaler.decisions["down"] == 1
+    finally:
+        pool.close()
+
+
+def test_spawn_grows_fleet_and_skips_taken_seats(pool_conf):
+    """pool.spawn() (the scale-up actuator) must hand back a live new
+    seat at the lowest free index and grow capacity."""
+    from blaze_tpu.runtime import executor_pool as ep
+
+    pool = ep.ExecutorPool(count=1, slots=2)
+    try:
+        pool.start()
+        assert pool.capacity() == 2
+        seat = pool.spawn()
+        assert seat == 1
+        deadline = time.monotonic() + 30
+        while pool.live_count() < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert pool.live_count() == 2
+        assert pool.capacity() == 4
+        specs = [ep.PoolTaskSpec(f"e:{i}", "echo", {"value": i})
+                 for i in range(4)]
+        out = pool.run_tasks(specs, timeout=60)
+        assert [r["value"] for r in out] == [0, 1, 2, 3]
+    finally:
+        pool.close()

@@ -102,6 +102,19 @@ def render(metrics: dict, source: str) -> str:
         f"parked={int(g('blaze_admission_parked_total'))} "
         f"rejected={rejected}"
         + ("  ** LOAD SHEDDING **" if rejected else ""))
+    role_rows = [(k, v) for k, v in metrics.items()
+                 if k.startswith("blaze_driver_role{") and v]
+    if role_rows or g("blaze_autoscale_target_seats"):
+        role = (role_rows[0][0].split('role="', 1)[-1].rstrip('"}')
+                if role_rows else "primary")
+        ups = int(g('blaze_autoscale_decisions_total{direction="up"}'))
+        downs = int(
+            g('blaze_autoscale_decisions_total{direction="down"}'))
+        lines.append(
+            f"fleet    role={role} "
+            f"target_seats={int(g('blaze_autoscale_target_seats'))} "
+            f"scale_ups={ups} scale_downs={downs}"
+            + ("  ** STANDBY **" if role == "standby" else ""))
     exec_rows = [(k, v) for k, v in metrics.items()
                  if k.startswith("blaze_executor_up{")]
     if exec_rows:

@@ -59,6 +59,21 @@ silently zero for pooled runs). A telemetry on/off A/B over the pooled
 catalogue gates the plane's overhead below 2%. Emits
 `DIST_OBS_r18.json`.
 
+`--elastic` (ISSUE 16): the elastic-fleet & driver-HA acceptance run,
+two rounds emitting `ELASTIC_r20.json`. (1) autoscale: a 1-seat pool
+under an 8-client burst must scale UP on parked arrivals (typed
+scale_up decisions, fleet pinned by autoscale_max), then scale DOWN to
+the floor after quiesce through the drain barrier — both directions
+recorded, ZERO drain requeues, every answer oracle-equal. (2) failover:
+a subprocess primary (4-seat pool, journaling, fleet manifest + fenced
+leader lease beside the journals) is SIGKILLed while holding 8 queries
+mid-flight, then TWO of its executors are SIGKILLed too; a warm-standby
+subprocess must detect the death, acquire the lease under a bumped
+epoch, rebind the control plane (ADOPTING the two surviving workers,
+respawning the dead ones), replay the dead primary's journals, and
+answer every query oracle-equal — with exactly ONE driver_failover
+dossier and zero orphans.
+
 Each cell installs one deterministic fault spec (fail the first N calls
 of one KNOWN_POINTS prefix), runs a full driver-path query, and diffs
 the answer against the pandas oracle. A cell is
@@ -1248,6 +1263,382 @@ def _driver_kill_round(args):
     return rec
 
 
+def _elastic_scale_round(tables):
+    """--elastic round 1: SLO-driven autoscaling through a real burst.
+
+    A 1-seat pool (autoscale_min=1, autoscale_max=3) takes an 8-client
+    catalogue burst through QueryService: admission parks the overflow,
+    the autoscaler must read the parked arrivals and spawn seats up to
+    the ceiling (typed scale_up decisions), and — once the burst drains
+    — walk the fleet back down to the floor through the decommission
+    drain barrier (typed scale_down decisions). The gate: decisions in
+    BOTH directions, the fleet back at autoscale_min, ZERO drain
+    requeues (a scale-down must never shed in-flight work), every
+    answer oracle-equal, nothing leaked."""
+    import threading
+
+    from blaze_tpu.config import conf
+    from blaze_tpu.runtime import autoscaler as asc
+    from blaze_tpu.runtime import executor_pool as ep
+    from blaze_tpu.runtime import faults
+    from blaze_tpu.runtime.service import QueryService
+    from blaze_tpu.spark import validator
+
+    paths, frames = tables
+    saved = {k: getattr(conf, k) for k in (
+        "autoscale_enabled", "autoscale_min", "autoscale_max",
+        "autoscale_cooldown_ms")}
+    conf.autoscale_enabled = True
+    conf.autoscale_min = 1
+    conf.autoscale_max = 3
+    conf.autoscale_cooldown_ms = 400
+    rec = {"round": "autoscale_burst"}
+    work_dirs = []
+    timeline = []
+    t0 = time.time()
+    pool = ep.ExecutorPool(count=1, slots=2)
+    scaler = None
+    try:
+        pool.start()
+        ep.activate(pool)
+        t_start = time.monotonic()
+        timeline.append((0.0, pool.capacity()))
+        pool.on_membership(lambda p: timeline.append(
+            (round(time.monotonic() - t_start, 3), p.capacity())))
+        n_queries = 8
+        results = [None] * n_queries
+        with QueryService(queue_depth=16) as svc:
+            scaler = asc.Autoscaler(pool, service=svc, tick_s=0.05)
+            scaler.start()
+
+            def client(i, query, plan, oracle, wd):
+                q = {"query": query}
+                try:
+                    out = svc.run(plan, f"tenant{i % 2}",
+                                  num_partitions=4, work_dir=wd,
+                                  mesh_exchange="off")
+                    diff = validator._compare(
+                        validator._to_pandas(out).reset_index(drop=True),
+                        oracle().reset_index(drop=True))
+                    q["outcome"] = ("clean_ok" if diff is None
+                                    else "wrong_answer")
+                except faults.AdmissionRejected:
+                    q["outcome"] = "rejected_at_admission"
+                except Exception as e:  # noqa: BLE001 — recorded
+                    q["outcome"] = "classified_fail"
+                    q["error"] = f"{type(e).__name__}: {e}"[:300]
+                results[i] = q
+
+            threads = []
+            for i in range(n_queries):
+                query, mode = QUERIES[i % len(QUERIES)]
+                plan, oracle = validator.QUERIES[query](paths, frames,
+                                                        mode)
+                wd = tempfile.mkdtemp(prefix="chaos_elastic_")
+                work_dirs.append(wd)
+                threads.append(threading.Thread(
+                    target=client, args=(i, query, plan, oracle, wd)))
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=600)
+            # quiesce: idle utilization below the floor must drain the
+            # fleet back to autoscale_min through the decommission
+            # barrier (the service stays open so the policy keeps its
+            # queue/parked signals)
+            deadline = time.monotonic() + 90
+            while time.monotonic() < deadline:
+                if (scaler.decisions["down"] >= 1
+                        and pool.capacity() <= conf.autoscale_min
+                        * pool.slots
+                        and pool.stats()["draining"] == 0):
+                    break
+                time.sleep(0.05)
+            rec["scaler"] = scaler.state()
+        rec["queries"] = [q for q in results if q is not None]
+        rec["stats"] = pool.stats()
+        rec["capacity_timeline"] = timeline
+        caps = [c for _t, c in timeline]
+        failed = [q for q in rec["queries"]
+                  if q["outcome"] != "clean_ok"]
+        rec["failed_queries"] = len(failed)
+        rec["elastic_ok"] = (
+            scaler.decisions["up"] >= 1
+            and scaler.decisions["down"] >= 1
+            and max(caps) > caps[0]
+            and pool.capacity() == conf.autoscale_min * pool.slots
+            and rec["stats"]["drain_requeues_total"] == 0
+            and rec["stats"]["deaths_total"] == 0
+            and not failed)
+    finally:
+        if scaler is not None:
+            scaler.close()
+        ep.deactivate(pool)
+        pool.close()
+        for k, v in saved.items():
+            setattr(conf, k, v)
+    rec["seconds"] = round(time.time() - t0, 3)
+    rec.update(_leaks(work_dirs))
+    for wd in work_dirs:
+        shutil.rmtree(wd, ignore_errors=True)
+    return rec
+
+
+# the --elastic primary child: a real subprocess driver owning a 4-seat
+# pool with journaling on, a fenced leader lease and a published fleet
+# manifest beside the journals. It parks all BLZ_CLIENTS queries in
+# their result stage (maps committed + journaled), touches BLZ_READY,
+# and sleeps — the parent SIGKILLs it there, then SIGKILLs two of its
+# executors from the manifest pids.
+_ELASTIC_PRIMARY = '''\
+import json, os, sys, threading, time
+sys.path.insert(0, os.environ["BLZ_REPO"])
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from blaze_tpu.config import conf
+conf.journal_dir = os.environ["BLZ_JDIR"]
+conf.flight_dir = os.environ["BLZ_FDIR"]
+conf.trace_enabled = False
+conf.executor_death_ms = 20000   # workers must outlive the driver gap
+conf.executor_heartbeat_ms = 100
+conf.leader_lease_ms = 1000
+from blaze_tpu.runtime import executor_pool as ep
+from blaze_tpu.runtime import standby
+from blaze_tpu.spark import validator
+from blaze_tpu.spark import local_runner
+
+paths, frames = validator.generate_tables(
+    os.environ["BLZ_TDIR"], rows=int(os.environ["BLZ_ROWS"]), seed=7)
+pool = ep.ExecutorPool(count=4, slots=2)
+pool.start()
+ep.activate(pool)
+lease = standby.LeaderLease(os.environ["BLZ_JDIR"])
+lease.acquire()
+lease.start_renewing()
+standby.wire_manifest(pool, os.environ["BLZ_JDIR"])
+# warm every seat before arming the hold: adoption must race real
+# work, not jax imports
+warm, _ = validator.QUERIES["q1_scan_filter_project"](paths, frames, "bhj")
+local_runner.run_plan(warm, num_partitions=4,
+                      work_dir=os.path.join(os.environ["BLZ_WDIR"], "warm"),
+                      mesh_exchange="off")
+parked = threading.Semaphore(0)
+real = local_runner._run_result_stage
+
+def hold(*a, **k):
+    parked.release()
+    time.sleep(600)  # the parent SIGKILLs inside this window
+    return real(*a, **k)
+
+local_runner._run_result_stage = hold
+QUERIES = [("q1_scan_filter_project", "bhj"), ("q2_q06_core_agg", "bhj"),
+           ("q3_join_agg_sort", "smj")]
+
+def client(i):
+    query, mode = QUERIES[i % len(QUERIES)]
+    plan, _ = validator.QUERIES[query](paths, frames, mode)
+    local_runner.run_plan(
+        plan, num_partitions=4,
+        work_dir=os.path.join(os.environ["BLZ_WDIR"], "q%d" % i),
+        mesh_exchange="off")
+
+n = int(os.environ["BLZ_CLIENTS"])
+for i in range(n):
+    threading.Thread(target=client, args=(i,), daemon=True).start()
+for _ in range(n):
+    parked.acquire()
+with open(os.environ["BLZ_READY"], "w") as f:
+    f.write("ready")
+time.sleep(600)
+'''
+
+# the --elastic standby child: a warm StandbyDriver on the same journal
+# dir. It must detect the primary's death, fence it behind a bumped
+# lease epoch, rebind the pool (adopting the two surviving workers,
+# respawning the two SIGKILLed ones), replay the dead primary's
+# journals, then re-run every query oracle-equal on the adopted fleet.
+_ELASTIC_STANDBY = '''\
+import json, os, sys, threading, time
+sys.path.insert(0, os.environ["BLZ_REPO"])
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from blaze_tpu.config import conf
+conf.journal_dir = os.environ["BLZ_JDIR"]
+conf.flight_dir = os.environ["BLZ_FDIR"]
+conf.trace_enabled = False
+conf.executor_death_ms = 20000
+conf.executor_heartbeat_ms = 100
+conf.leader_lease_ms = 1000
+from blaze_tpu.runtime import artifacts, standby
+from blaze_tpu.spark import validator
+from blaze_tpu.spark import local_runner
+
+paths, frames = validator.generate_tables(
+    os.environ["BLZ_TDIR"], rows=int(os.environ["BLZ_ROWS"]), seed=7)
+sb = standby.StandbyDriver(os.environ["BLZ_JDIR"]).start()
+with open(os.environ["BLZ_SREADY"], "w") as f:
+    f.write("watching")
+if not sb.wait_takeover(120):
+    print("STANDBY_RESULT " + json.dumps({"took_over": False}))
+    sys.exit(1)
+QUERIES = [("q1_scan_filter_project", "bhj"), ("q2_q06_core_agg", "bhj"),
+           ("q3_join_agg_sort", "smj")]
+n = int(os.environ["BLZ_CLIENTS"])
+results = [None] * n
+
+def client(i):
+    query, mode = QUERIES[i % len(QUERIES)]
+    plan, oracle = validator.QUERIES[query](paths, frames, mode)
+    info = {}
+    q = {"query": query}
+    try:
+        out = local_runner.run_plan(
+            plan, num_partitions=4,
+            work_dir=os.path.join(os.environ["BLZ_WDIR"], "q%d" % i),
+            mesh_exchange="off", run_info=info)
+        q["diff"] = validator._compare(
+            validator._to_pandas(out).reset_index(drop=True),
+            oracle().reset_index(drop=True))
+        q["recovered_stages"] = info.get("recovered_stages", 0)
+    except Exception as e:
+        q["diff"] = "%s: %s" % (type(e).__name__, e)
+        q["recovered_stages"] = 0
+    results[i] = q
+
+threads = [threading.Thread(target=client, args=(i,)) for i in range(n)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join(timeout=600)
+pool = sb.pool
+wdirs = [os.path.join(os.environ["BLZ_WDIR"], "q%d" % i)
+         for i in range(n)]
+print("STANDBY_RESULT " + json.dumps({
+    "took_over": True,
+    "takeover": sb.takeover_info,
+    "role": standby.role(),
+    "queries": results,
+    "wrong": sum(1 for r in results if r and r["diff"] is not None),
+    "incomplete": sum(1 for r in results if r is None),
+    "recovered_stages": sum(r["recovered_stages"] for r in results if r),
+    "adopted": getattr(pool, "adopted_total", 0) if pool else 0,
+    "live_seats": pool.live_count() if pool else 0,
+    "orphans": artifacts.find_orphans(wdirs),
+}))
+sb.close()
+'''
+
+
+def _elastic_failover_round(args):
+    """--elastic round 2: warm-standby driver failover under compound
+    loss. SIGKILL the primary driver while it holds 8 journaled queries
+    mid-flight, then SIGKILL two of its four executors. The pre-started
+    standby must take over (bumped lease epoch, control-plane rebind,
+    two workers ADOPTED, two respawned, journals replayed) and answer
+    every query oracle-equal — exactly one driver_failover dossier,
+    zero orphans."""
+    import signal
+    import subprocess
+
+    from blaze_tpu.runtime import flight_recorder
+
+    n_clients = 8
+    root = tempfile.mkdtemp(prefix="chaos_elastic_ha_")
+    jdir = os.path.join(root, "journal")
+    fdir = os.path.join(root, "flight")
+    ready = os.path.join(root, "ready")
+    sready = os.path.join(root, "standby_ready")
+    primary = os.path.join(root, "primary_child.py")
+    standby_py = os.path.join(root, "standby_child.py")
+    with open(primary, "w") as f:
+        f.write(_ELASTIC_PRIMARY)
+    with open(standby_py, "w") as f:
+        f.write(_ELASTIC_STANDBY)
+    tdir = os.path.join(root, "tables")
+    os.makedirs(tdir, exist_ok=True)
+    env = dict(os.environ, BLZ_REPO=REPO, BLZ_JDIR=jdir, BLZ_FDIR=fdir,
+               BLZ_TDIR=tdir, BLZ_WDIR=os.path.join(root, "work"),
+               BLZ_READY=ready, BLZ_SREADY=sready,
+               BLZ_ROWS=str(args.rows), BLZ_CLIENTS=str(n_clients),
+               JAX_PLATFORMS="cpu")
+    rec = {"round": "driver_failover", "clients": n_clients}
+    t0 = time.time()
+    log1 = open(os.path.join(root, "primary.log"), "w")
+    p1 = subprocess.Popen([sys.executable, primary], env=env,
+                          stdout=log1, stderr=subprocess.STDOUT)
+    p2 = None
+    try:
+        deadline = time.monotonic() + 300
+        while (not os.path.exists(ready) and p1.poll() is None
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        rec["held"] = os.path.exists(ready)
+        # warm standby: started while the primary is still healthy (it
+        # waits on the lease), so takeover latency excludes its imports
+        p2 = subprocess.Popen([sys.executable, standby_py], env=env,
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True)
+        deadline = time.monotonic() + 120
+        while (not os.path.exists(sready) and p2.poll() is None
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        rec["standby_watching"] = os.path.exists(sready)
+        manifest = {}
+        try:
+            with open(os.path.join(jdir, "fleet.manifest.json")) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError):
+            pass
+        exec_pids = [int(s["pid"]) for s in manifest.get("seats", [])]
+        if p1.poll() is None:
+            p1.send_signal(signal.SIGKILL)
+        p1.wait(timeout=30)
+        rec["killed_primary"] = p1.returncode == -signal.SIGKILL
+        killed_execs = []
+        for pid in exec_pids[:2]:  # two of the four seats die with it
+            try:
+                os.kill(pid, signal.SIGKILL)
+                killed_execs.append(pid)
+            except ProcessLookupError:
+                pass
+        rec["killed_executors"] = len(killed_execs)
+        try:
+            out, err = p2.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            p2.kill()
+            out, err = p2.communicate()
+        res = None
+        for line in out.splitlines():
+            if line.startswith("STANDBY_RESULT "):
+                res = json.loads(line[len("STANDBY_RESULT "):])
+        rec["standby"] = res
+        if res is None:
+            rec["standby_output"] = (out + err)[-2000:]
+        rec["failover_dossiers"] = len(
+            [d for d in flight_recorder.list_dossiers(fdir)
+             if d.get("trigger") == "driver_failover"])
+        takeover = (res or {}).get("takeover") or {}
+        ok = (rec["held"] and rec["standby_watching"]
+              and rec["killed_primary"] and len(killed_execs) == 2
+              and res is not None and res.get("took_over")
+              and res.get("wrong") == 0 and res.get("incomplete") == 0
+              and res.get("adopted") == 2
+              and res.get("live_seats") == 4
+              and not res.get("orphans")
+              and res.get("recovered_stages", 0) >= 1
+              and takeover.get("lease_epoch", 0) >= 2
+              and takeover.get("journals_replayed", 0) >= 1
+              and takeover.get("queries_resumed", 0) >= 1
+              and rec["failover_dossiers"] == 1)
+        rec["outcome"] = "recovered" if ok else "failed"
+    finally:
+        log1.close()
+        for p in (p1, p2):
+            if p is not None and p.poll() is None:
+                p.kill()
+    rec["seconds"] = round(time.time() - t0, 3)
+    shutil.rmtree(root, ignore_errors=True)
+    return rec
+
+
 def _overhead(tables):
     """Disabled-path cost: the microbench backs the <=1%-claim at the
     per-call level; the catalogue A/B shows end-to-end parity with an
@@ -1569,6 +1960,15 @@ def main() -> int:
                          "asymmetric partition past the lease (one "
                          "dossier + worker self-fence), and a rolling "
                          "drain/restart of every seat under service load")
+    ap.add_argument("--elastic", action="store_true",
+                    help="elastic fleet & driver-HA acceptance: an "
+                         "8-client burst against a 1-seat pool must "
+                         "autoscale up on parked arrivals and drain back "
+                         "to the floor (0 requeues), and a warm-standby "
+                         "subprocess must survive SIGKILL of the primary "
+                         "driver plus two executors — lease-fenced "
+                         "takeover, worker adoption, journal replay, "
+                         "every answer oracle-equal")
     ap.add_argument("--concurrent-queries", type=int, default=8,
                     help="client sessions per --service round")
     ap.add_argument("--tenants", type=int, default=3,
@@ -1581,7 +1981,8 @@ def main() -> int:
     ap.add_argument("--json-out", default=None)
     args = ap.parse_args()
     if args.json_out is None:
-        args.json_out = ("NETWORK_r19.json" if args.network
+        args.json_out = ("ELASTIC_r20.json" if args.elastic
+                         else "NETWORK_r19.json" if args.network
                          else "DIST_OBS_r18.json" if args.dist_obs
                          else "DURABILITY_r17.json" if (args.durability
                                                         or args.driver)
@@ -1615,6 +2016,41 @@ def main() -> int:
 
     tmpdir = tempfile.mkdtemp(prefix="chaos_tables_")
     tables = validator.generate_tables(tmpdir, rows=args.rows)
+
+    if args.elastic:
+        try:
+            rounds = [_elastic_scale_round(tables),
+                      _elastic_failover_round(args)]
+        finally:
+            shutil.rmtree(tmpdir, ignore_errors=True)
+            for k, v in saved_conf.items():
+                setattr(conf, k, v)
+        bad = []
+        scale, failover = rounds
+        if not scale.get("elastic_ok"):
+            bad.append({"round": scale["round"], "elastic_ok": False,
+                        "scaler": scale.get("scaler"),
+                        "failed_queries": scale.get("failed_queries")})
+        if (scale.get("orphans") or scale.get("mem_leaked")
+                or scale.get("pipeline_leaked")
+                or scale.get("resource_leaked")):
+            bad.append({"round": scale["round"], "leaks": True})
+        if failover.get("outcome") != "recovered":
+            bad.append({"round": failover["round"],
+                        "outcome": failover.get("outcome"),
+                        "standby": failover.get("standby"),
+                        "dossiers": failover.get("failover_dossiers")})
+        report = {
+            "rows": args.rows, "seed": args.seed,
+            "ok": not bad, "bad": bad, "rounds": rounds,
+        }
+        with open(args.json_out, "w") as f:
+            json.dump(report, f, indent=1)
+        print(f"\nelastic soak {'OK' if report['ok'] else 'FAILED'} "
+              f"-> {args.json_out}")
+        if bad:
+            print(f"bad: {bad}")
+        return 0 if report["ok"] else 1
 
     if args.network:
         try:
