@@ -9,13 +9,14 @@ PYENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
 .PHONY: check check-fast check-faults check-supervisor check-trace \
 	check-durability check-dist-obs check-network check-elastic \
-	check-pipeline check-pipeline-soak check-perf check-perf-update \
-	check-obs check-history check-lint check-service check-doctor \
-	check-flight check-executors test test-fast validate validate-fast warm
+	check-streaming check-pipeline check-pipeline-soak check-perf \
+	check-perf-update check-obs check-history check-lint check-service \
+	check-doctor check-flight check-executors test test-fast validate \
+	validate-fast warm
 
 check: check-lint test validate check-perf check-history check-service \
 	check-doctor check-flight check-executors check-durability \
-	check-dist-obs check-network check-elastic
+	check-dist-obs check-network check-elastic check-streaming
 	@echo "CHECK OK — safe to commit"
 
 # Static invariant gate (tools/blazelint): lock discipline, knob
@@ -219,6 +220,22 @@ check-network:
 check-elastic:
 	$(PYENV) python tools/chaos_soak.py --elastic \
 	  --json-out ELASTIC_r20.json
+
+# Durable exactly-once streaming gate (ISSUE 17): a checkpointed
+# micro-batch stream over a growing parquet directory (QueryService
+# session, 4-seat subprocess primary with fenced lease + manifest)
+# must survive an executor SIGKILL mid-batch (checkpoints keep
+# committing) AND a primary-driver SIGKILL with warm-standby takeover
+# — the stream ADOPTED from its journal (streams_adoptable >= 1,
+# never billed driver_restart), resumed from the last committed
+# checkpoint (resumed_batches >= 1), final aggregation state
+# pandas-oracle equal over EVERY published file (0 dropped, 0
+# double-counted rows), checkpoint epochs strictly monotone across
+# both drivers, exactly one driver_failover dossier. Emits
+# STREAMING_r21.json.
+check-streaming:
+	$(PYENV) python tools/chaos_soak.py --streaming \
+	  --json-out STREAMING_r21.json
 
 # Pre-warm the persistent compile caches (runtime/compile_service):
 # replays the shape manifest + the TPC-DS catalogue into the XLA cache.

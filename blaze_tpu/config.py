@@ -317,8 +317,8 @@ _DECLARATIONS: Tuple[Knob, ...] = (
     Knob("flight_triggers", "all",
          doc="Comma list selecting which incident classes capture "
              "(failure, shed, deadline, hang, slo_breach, breaker_trip, "
-             "resource_leak, driver_restart, driver_failover); 'all' "
-             "arms every class."),
+             "resource_leak, driver_restart, driver_failover, "
+             "stream_stall); 'all' arms every class."),
     Knob("progress_enabled", False,
          doc="Live per-query progress tracking (runtime/progress.py): "
              "per-stage rows/attempts/ETA served at /queries and "
@@ -452,6 +452,26 @@ _DECLARATIONS: Tuple[Knob, ...] = (
              "grabs. Takeover bumps the lease epoch so a paused-then-"
              "resumed old primary self-fences on its next renew — the "
              "same epoch posture PR 15 gave executors."),
+
+    # -- durable micro-batch streaming (runtime/streaming.py) --
+    Knob("stream_poll_ms", 200,
+         doc="Micro-batch tick cadence: a StreamingQuery sleeps this "
+             "long between TailSource discovery passes when the source "
+             "is caught up (a tick that found new files immediately "
+             "polls again, so a backlog drains at full speed)."),
+    Knob("stream_checkpoint_interval", 1,
+         doc="Micro-batches between durable checkpoints. 1 (default) "
+             "checkpoints after every committed batch — exactly-once "
+             "resume never re-processes more than the in-flight batch. "
+             "N>1 amortizes the fsync over N batches; a crash then "
+             "re-processes up to N batches into the last checkpointed "
+             "state (still exactly-once externally: offsets and state "
+             "travel in the same atomic record)."),
+    Knob("stream_max_lag_ms", 10000,
+         doc="End-to-end lag objective for a stream (oldest undiscovered-"
+             "or-unprocessed input age). Sustained lag past this cuts a "
+             "stream_stall flight dossier (once per stream) and a doctor "
+             "stream_lag finding suggesting the knob to turn."),
 
     # -- per-operator enable flags (tier b, spark.blaze.enable.<op>) --
     Knob("enable_ops", default_factory=dict,

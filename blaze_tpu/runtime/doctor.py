@@ -32,7 +32,8 @@ loop:
                   compile_storm, admission_starved, queue_contended,
                   breaker_degraded, network_flaky, pipeline_underlap,
                   executor_skew, fleet_underprovisioned,
-                  fleet_overprovisioned, regression_vs_history. The
+                  fleet_overprovisioned, stream_lag,
+                  regression_vs_history. The
                   executor_skew rule is pooled-run only: federated task
                   spans carry the shipping worker's exec id (stamped by
                   trace.ingest_remote), so the doctor can attribute
@@ -574,6 +575,34 @@ def diagnose(record: dict,
                  "utilization": _r(util),
                  "busy_slots": fleet.get("busy_slots", 0),
                  "target_seats": fleet.get("target_seats")}))
+
+    # stream_lag: this record is a streaming micro-batch (stamped by
+    # runtime/streaming.py) whose end-to-end lag is past the stream's
+    # objective AND not shrinking — the stream is falling behind its
+    # source, sustained, and a knob (not this batch) is the fix.
+    stream = record.get("stream") or {}
+    if stream:
+        lag = float(stream.get("lag_ms", 0.0) or 0.0)
+        objective = float(stream.get("max_lag_ms", 0.0) or 0.0)
+        sustained = lag >= float(stream.get("prev_lag_ms", 0.0) or 0.0)
+        if objective > 0 and lag > objective and sustained:
+            findings.append(Finding(
+                "stream_lag",
+                min(0.3 + 0.15 * (lag / objective), 0.95),
+                f"stream {stream.get('stream_id')} lag {lag:.0f}ms "
+                f"exceeds its {objective:.0f}ms objective and is still "
+                f"growing (epoch {stream.get('epoch')}, "
+                f"{stream.get('files', 0)} file(s) this batch)",
+                "lower conf.stream_poll_ms so ticks keep up with "
+                "arrivals, add seats (conf.autoscale_max) if batches "
+                "are compute-bound, or raise conf.stream_max_lag_ms "
+                "if the objective is wrong",
+                {"stream_id": stream.get("stream_id"),
+                 "epoch": stream.get("epoch"),
+                 "lag_ms": _r(lag), "max_lag_ms": _r(objective),
+                 "prev_lag_ms": _r(float(
+                     stream.get("prev_lag_ms", 0.0) or 0.0)),
+                 "files": stream.get("files", 0)}))
 
     # regression_vs_history: stages slower than their fingerprint's past
     if feed is not None:

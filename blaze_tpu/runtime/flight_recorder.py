@@ -64,7 +64,7 @@ SCHEMA_VERSION = 1
 
 TRIGGERS = ("failure", "shed", "deadline", "hang", "slo_breach",
             "breaker_trip", "resource_leak", "executor_death",
-            "driver_restart", "driver_failover")
+            "driver_restart", "driver_failover", "stream_stall")
 
 _lock = threading.Lock()
 _captured: set = set()            # (query_id, trigger): exactly-once

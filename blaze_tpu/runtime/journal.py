@@ -60,8 +60,17 @@ _resume: Dict[str, Dict[str, Any]] = {}
 _scanned_dirs: set = set()          # recovery scan runs once per dir
 _stats = {"journals_scanned": 0, "journals_resumable": 0,
           "journals_failed": 0, "stages_recovered": 0,
-          "recovered_queries": 0}
+          "recovered_queries": 0, "streams_adoptable": 0}
 _recovered_qids: set = set()        # exactly-once recovered_queries bump
+# stream_id -> journal path of a dead-writer streaming journal found by
+# the recovery scan: ADOPTED (streaming.resume_stream) rather than billed
+_adoptable_streams: Dict[str, str] = {}
+
+# record kinds that mark a journal as a durable STREAM journal
+# (runtime/streaming.py): its checkpoints are the resume input for an
+# unbounded query, so retention and the recovery scan treat it as live
+# until the stream is settled by a graceful stop
+STREAM_KINDS = ("stream_open", "stream_checkpoint")
 
 
 def journal_path(qid: str, directory: Optional[str] = None) -> str:
@@ -170,6 +179,29 @@ def is_complete(records: List[Dict[str, Any]]) -> bool:
     return any(r.get("kind") == "complete" for r in records)
 
 
+def is_stream(records: List[Dict[str, Any]]) -> bool:
+    """True when the journal belongs to a streaming query
+    (runtime/streaming.py writes stream_open/stream_checkpoint records)."""
+    return any(r.get("kind") in STREAM_KINDS for r in records)
+
+
+def _stream_settled(records: List[Dict[str, Any]]) -> bool:
+    """A stream journal is settled only by a GRACEFUL stop (complete
+    status ok) with no stream activity after it — re-opening a stopped
+    stream appends fresh stream records and un-settles the journal. A
+    complete{failed} record (e.g. billed by a pre-streaming recovery
+    scan) never settles it: the checkpoints are still the only resume
+    input the stream has."""
+    settled = False
+    for r in records:
+        kind = r.get("kind")
+        if kind == "complete" and r.get("status") == "ok":
+            settled = True
+        elif kind in STREAM_KINDS:
+            settled = False
+    return settled
+
+
 def prune(directory: Optional[str] = None) -> int:
     """Drop the oldest COMPLETE journals beyond conf.journal_retention.
     Incomplete journals are never pruned — until the recovery scan
@@ -185,11 +217,19 @@ def prune(directory: Optional[str] = None) -> int:
     complete: List[tuple] = []
     for name in names:
         path = os.path.join(d, name)
-        if is_complete(load_records(path)):
-            try:
-                complete.append((os.path.getmtime(path), path))
-            except OSError:
-                continue
+        records = load_records(path)
+        if not is_complete(records):
+            continue
+        if is_stream(records) and not _stream_settled(records):
+            # a long-lived stream's journal is its ONLY resume input:
+            # never let retention pressure from a busy batch workload
+            # drop it while the stream is live or adoptable, no matter
+            # how old the file is or what billed it complete
+            continue
+        try:
+            complete.append((os.path.getmtime(path), path))
+        except OSError:
+            continue
     complete.sort()
     removed = 0
     for _mtime, path in complete[:max(0, len(complete) - keep)]:
@@ -220,6 +260,7 @@ def reset() -> None:
         _resume.clear()
         _scanned_dirs.clear()
         _recovered_qids.clear()
+        _adoptable_streams.clear()
         for k in _stats:
             _stats[k] = 0
 
@@ -234,7 +275,7 @@ def ensure_recovery_scan(force: bool = False) -> Dict[str, int]:
     and a `driver_restart` flight-recorder dossier preserves the
     forensics. Never raises — recovery must not block a healthy start."""
     summary = {"scanned": 0, "resumable": 0, "billed_failed": 0,
-               "stages_recovered": 0}
+               "stages_recovered": 0, "streams_adoptable": 0}
     d = conf.journal_dir
     if not d or not conf.recovery_enabled:
         return summary
@@ -253,6 +294,16 @@ def ensure_recovery_scan(force: bool = False) -> Dict[str, int]:
             continue
         if _writer_alive(records):
             continue  # a LIVE driver's in-flight query, not a crash
+        if is_stream(records):
+            # a dead-writer STREAM journal is not billed failed — its
+            # checkpoints are the resume input. Register it for adoption
+            # (standby takeover / streaming.resume_stream) instead.
+            qid = records[0].get("query_id", "")
+            if qid and not _stream_settled(records):
+                summary["streams_adoptable"] += 1
+                with _lock:
+                    _adoptable_streams[qid] = path
+            continue
         try:
             summary["scanned"] += 1
             _replay_one(path, records, summary)
@@ -263,6 +314,7 @@ def ensure_recovery_scan(force: bool = False) -> Dict[str, int]:
         _stats["journals_resumable"] += summary["resumable"]
         _stats["journals_failed"] += summary["billed_failed"]
         _stats["stages_recovered"] += summary["stages_recovered"]
+        _stats["streams_adoptable"] += summary["streams_adoptable"]
     prune(d)
     return summary
 
@@ -270,9 +322,11 @@ def ensure_recovery_scan(force: bool = False) -> Dict[str, int]:
 def _writer_alive(records: List[Dict[str, Any]]) -> bool:
     """True when the journal's admitted record names a pid that is still
     running (this process included). No admitted record (the crash tore
-    the very first line) means no liveness claim — replay it."""
-    pid = next((r.get("pid") for r in records
-                if r.get("kind") == "admitted"), None)
+    the very first line) means no liveness claim — replay it. The LAST
+    admitted pid wins: a resumed stream re-stamps its adopter's pid onto
+    the same journal, and liveness must track the current writer."""
+    pid = next((r.get("pid") for r in reversed(records)
+                if r.get("kind") == "admitted" and r.get("pid")), None)
     if not pid:
         return False
     try:
@@ -354,6 +408,21 @@ def _flight_dossier(qid: str, tenant: str, recovered: int,
         detail={"stages_recovered": recovered,
                 "stages_discarded": discarded,
                 "plan_fingerprint": plan_fp})
+
+
+def adoptable_streams() -> Dict[str, str]:
+    """{stream_id: journal path} of dead-writer streaming journals the
+    recovery scan registered for adoption (consume via
+    streaming.resume_stream, which re-stamps the journal's writer pid)."""
+    with _lock:
+        return dict(_adoptable_streams)
+
+
+def claim_adoptable_stream(stream_id: str) -> Optional[str]:
+    """Pop one adoptable stream registration (consume-once, so two
+    adopters can't both resume the same checkpoint chain)."""
+    with _lock:
+        return _adoptable_streams.pop(stream_id, None)
 
 
 # -- resume map ---------------------------------------------------------

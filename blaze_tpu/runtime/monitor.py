@@ -595,6 +595,9 @@ GAUGE_NAMES = (
     "blaze_autoscale_target_seats",
     "blaze_autoscale_decisions_total",
     "blaze_driver_role",
+    "blaze_stream_lag_ms",
+    "blaze_stream_batches_total",
+    "blaze_stream_checkpoint_bytes",
 )
 GAUGE_PREFIXES = (
     "blaze_pipeline_",  # pipeline.TELEMETRY counters
@@ -844,6 +847,25 @@ def prometheus_text() -> str:
     emit("blaze_driver_role", "gauge",
          "Driver role of this process (1 for the held role)",
          [({"role": standby.role()}, 1)])
+
+    # durable streaming (runtime/streaming.py): one series per LIVE
+    # stream — a stopped stream's series disappears from the exposition
+    # (same bounded-cardinality posture as the progress ring)
+    from blaze_tpu.runtime import streaming
+
+    ss = streaming.stream_stats()
+    emit("blaze_stream_lag_ms", "gauge",
+         "Per-stream end-to-end lag (age of the oldest unconsumed "
+         "source file; 0 when caught up)",
+         [({"qid": sid}, s["lag_ms"]) for sid, s in sorted(ss.items())])
+    emit("blaze_stream_batches_total", "counter",
+         "Micro-batches committed per stream (resumed batches included)",
+         [({"qid": sid}, s["batches_total"])
+          for sid, s in sorted(ss.items())])
+    emit("blaze_stream_checkpoint_bytes", "gauge",
+         "Serialized size of each stream's last durable checkpoint",
+         [({"qid": sid}, s["checkpoint_bytes"])
+          for sid, s in sorted(ss.items())])
     # bounded label cardinality: live queries plus the last-N finished
     # ring (progress.finished_queries) — older finished series age out of
     # the exposition instead of accumulating one {qid=} series per query
@@ -852,9 +874,11 @@ def prometheus_text() -> str:
          "Per-query progress ratio (0-1, monotone; finished queries "
          "linger in a bounded last-N ring, then their series is pruned)",
          [({"qid": s["query_id"]}, s["progress_ratio"])
-          for s in progress.snapshot_queries()]
+          for s in progress.snapshot_queries()
+          if s.get("progress_ratio") is not None]
          + [({"qid": s["query_id"]}, s["progress_ratio"])
-            for s in progress.finished_queries()])
+            for s in progress.finished_queries()
+            if s.get("progress_ratio") is not None])
     with _lock:
         reqs = dict(_endpoint_requests)
     emit("blaze_endpoint_requests_total", "counter",

@@ -78,7 +78,9 @@ class _StageProgress:
 
 class _QueryProgress:
     __slots__ = ("query_id", "tenant_id", "started_at", "stages", "order",
-                 "current_stage", "last_ratio", "slo_ms", "rows", "phase")
+                 "current_stage", "last_ratio", "slo_ms", "rows", "phase",
+                 "streaming", "batch_epoch", "batches", "lag_ms",
+                 "batch_ms_ewma", "resumed_batches")
 
     def __init__(self, query_id: str, tenant_id: Optional[str],
                  slo_ms: Optional[float]) -> None:
@@ -92,6 +94,15 @@ class _QueryProgress:
         self.slo_ms = slo_ms
         self.rows = 0
         self.phase = "running"
+        # unbounded (streaming) sessions: a 0..1 ratio is meaningless
+        # over an infinite plan, so the summary reports per-batch
+        # progress + a lag/watermark ETA instead
+        self.streaming = False
+        self.batch_epoch = 0
+        self.batches = 0
+        self.lag_ms = 0.0
+        self.batch_ms_ewma: Optional[float] = None
+        self.resumed_batches = 0
 
 
 def _slo_objective_ms(tenant_id: Optional[str]) -> Optional[float]:
@@ -141,6 +152,46 @@ def finish_query(query_id: str) -> None:
             q.phase = "finished"
             q.current_stage = None
             _finished.append(_summary_locked(q, now))
+
+
+def begin_stream(stream_id: str, tenant_id: Optional[str] = None) -> None:
+    """Register a long-lived streaming session (runtime/streaming.py).
+    Unlike bounded queries it never reports a completion-fraction ratio;
+    batches/epoch/lag carry its progress until finish_query drops it."""
+    if not stream_id:
+        return
+    q = _QueryProgress(stream_id, tenant_id, _slo_objective_ms(tenant_id))
+    q.streaming = True
+    q.phase = "streaming"
+    with _lock:
+        _queries[stream_id] = q
+
+
+def stream_batch(stream_id: str, epoch: int, rows: int, lag_ms: float,
+                 batch_ms: float, resumed: bool = False) -> None:
+    """One committed micro-batch: advances the epoch, feeds the lag-ETA
+    estimator (EWMA of batch cost), and counts batches replayed from a
+    checkpoint after a resume."""
+    with _lock:
+        q = _queries.get(stream_id)
+        if q is None or not q.streaming:
+            return
+        q.batch_epoch = int(epoch)
+        q.batches += 1
+        q.rows += int(rows)
+        q.lag_ms = float(lag_ms)
+        q.batch_ms_ewma = (float(batch_ms) if q.batch_ms_ewma is None
+                           else 0.7 * q.batch_ms_ewma + 0.3 * float(batch_ms))
+        if resumed:
+            q.resumed_batches += 1
+
+
+def stream_lag(stream_id: str, lag_ms: float) -> None:
+    """Between-batch lag refresh (idle ticks still age the watermark)."""
+    with _lock:
+        q = _queries.get(stream_id)
+        if q is not None and q.streaming:
+            q.lag_ms = float(lag_ms)
 
 
 def stage_begin(query_id: str, stage_id, kind: str,
@@ -280,6 +331,35 @@ def _ratio(q: _QueryProgress, now: float) -> float:
 
 def _summary_locked(q: _QueryProgress, now: float) -> Dict[str, Any]:
     elapsed = (now - q.started_at) * 1000.0
+    if q.streaming:
+        # unbounded session: no 0..1 ratio (the plan has no end). The
+        # ETA reported is the LAG eta — expected time to drain the
+        # current backlog at the observed per-batch cost — and the
+        # per-batch fields carry the "how far along" story.
+        lag_eta = (0.0 if q.lag_ms <= 0 else q.batch_ms_ewma)
+        return {
+            "query_id": q.query_id,
+            "tenant_id": q.tenant_id,
+            "phase": q.phase,
+            "streaming": True,
+            "elapsed_ms": round(elapsed, 3),
+            "progress_ratio": None,
+            "eta_ms": None,
+            "batch_epoch": q.batch_epoch,
+            "batches": q.batches,
+            "lag_ms": round(q.lag_ms, 3),
+            "lag_eta_ms": (round(lag_eta, 3)
+                           if lag_eta is not None else None),
+            "batch_ms": (round(q.batch_ms_ewma, 3)
+                         if q.batch_ms_ewma is not None else None),
+            "resumed_batches": q.resumed_batches,
+            "slo_objective_ms": q.slo_ms,
+            "slo_headroom_ms": None,
+            "rows": q.rows,
+            "stages_total": len(q.order),
+            "stages_done": sum(1 for st in q.stages.values()
+                               if st.finished_at is not None),
+        }
     eta = _eta_ms(q, now)
     return {
         "query_id": q.query_id,

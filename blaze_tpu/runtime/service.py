@@ -229,6 +229,7 @@ class QueryService:
         self.scheduler: Optional[supervisor.FairScheduler] = None
         self._open = False
         self._pool = None  # attached executor pool (capacity source)
+        self._streams: List[Any] = []  # long-lived StreamingQuery sessions
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -283,6 +284,18 @@ class QueryService:
 
     def close(self) -> None:
         global _active
+        # detach live streams FIRST (their micro-batches run through
+        # admission): non-graceful stop — a service shutdown must not
+        # settle a stream's journal, the stream stays adoptable by the
+        # next driver (streaming.resume_stream)
+        with self._lock:
+            streams = list(self._streams)
+            self._streams = []
+        for sq in streams:
+            try:
+                sq.stop(graceful=False)
+            except Exception:  # noqa: BLE001 — close() must not raise
+                pass
         with self._lock:
             self._open = False
             self._slot_free.notify_all()
@@ -483,6 +496,40 @@ class QueryService:
         t.start()
         return fut
 
+    # -- streaming sessions ------------------------------------------------
+
+    def open_stream(self, source, spec, tenant_id: str = "", *,
+                    stream_id: Optional[str] = None, **kwargs: Any):
+        """Open a long-lived streaming session (runtime/streaming.py)
+        bound to this service: every micro-batch is admitted like any
+        other query — the tenant's priority weight, quota, fairness
+        share and per-batch SLO scoring all apply — so a stream cannot
+        starve batch tenants, and admission pressure shows up as stream
+        lag rather than unbounded queueing. Returns the started
+        StreamingQuery."""
+        from blaze_tpu.runtime import streaming
+
+        with self._lock:
+            if not self._open:
+                raise RuntimeError("QueryService is closed")
+        sq = streaming.open_stream(source, spec, stream_id=stream_id,
+                                   tenant_id=tenant_id, service=self,
+                                   **kwargs)
+        with self._lock:
+            self._streams = [s for s in self._streams if s.alive()]
+            self._streams.append(sq)
+        return sq
+
+    def resume_stream(self, stream_id: str, **kwargs: Any):
+        """Adopt a dead driver's stream (journal checkpoints) into this
+        service — the standby-takeover path."""
+        from blaze_tpu.runtime import streaming
+
+        sq = streaming.resume_stream(stream_id, service=self, **kwargs)
+        with self._lock:
+            self._streams.append(sq)
+        return sq
+
     # -- introspection -----------------------------------------------------
 
     def stats(self) -> Dict[str, int]:
@@ -495,6 +542,7 @@ class QueryService:
                 "parked": self._parked_total,
                 "rejected": self._rejected_total,
                 "capacity": cap,
+                "streams": sum(1 for s in self._streams if s.alive()),
             }
 
 

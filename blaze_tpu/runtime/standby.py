@@ -403,6 +403,7 @@ class StandbyDriver:
             "queries_resumed": int(scan.get("resumable", 0)),
             "queries_rebilled": int(scan.get("billed_failed", 0)),
             "stages_recovered": int(scan.get("stages_recovered", 0)),
+            "streams_adoptable": int(scan.get("streams_adoptable", 0)),
             "executors_adopted": adopted,
             "takeover_ms": round((time.monotonic() - t0) * 1000),
         }

@@ -232,6 +232,12 @@ EVENT_KINDS = (
     "speculation_win",      # supervisor: speculative twin won
     "spill",                # memory: spill file written
     "spill_pages_flush",    # memory: spill page pool flushed
+    "stream_batch",         # streaming: micro-batch merged into the
+                            # stream's aggregation state
+    "stream_checkpoint",    # streaming: offsets+state+epoch made durable
+                            # in one crash-atomic journal record
+    "stream_resume",        # streaming: state restored from the last
+                            # committed checkpoint after a crash/takeover
     "task_abandoned",       # supervisor: attempt abandoned post-kill
     "task_error",           # supervisor: classified attempt failure
     "telemetry_recovered",  # executor_pool: dead worker's sidecar-spilled
@@ -887,6 +893,11 @@ def build_run_record(query_id: str, run_info: Optional[dict] = None,
     fleet = autoscaler.fleet_snapshot()
     if fleet:
         rec["fleet"] = fleet
+    # streaming evidence (runtime/streaming.py): a micro-batch ledger
+    # line carries its stream's lag posture so doctor's stream_lag rule
+    # can rank offline, from the record alone
+    if isinstance(info.get("stream"), dict):
+        rec["stream"] = dict(info["stream"])
     if conf.doctor_enabled:
         from blaze_tpu.runtime import doctor
 

@@ -102,8 +102,14 @@ def run_plan(root: SparkPlan, num_partitions: int = 4,
     history.begin_query(qid)
     # write-ahead journal (runtime/journal.py): the admission record
     # opens this query's crash-recovery log (no-op with journal_dir
-    # unset); the terminal record in the finally below settles it
-    jnl = journal.journal_for(qid)
+    # unset); the terminal record in the finally below settles it.
+    # Stream micro-batches (run_info["stream"], runtime/streaming.py)
+    # skip per-batch journals: the stream's checkpoint record is the
+    # durability unit, and a crashed batch is re-processed from the
+    # last checkpoint — billing it driver_restart at takeover would
+    # double-count work the resumed stream replays by design.
+    jnl = (None if run_info.get("stream")
+           else journal.journal_for(qid))
     if jnl is not None:
         jnl.admitted(tenant_id=tenant)
     if conf.progress_enabled:
@@ -218,7 +224,8 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
         def provider(partition, nparts, _p=subtree):
             return fallback.export_iterator(_p, partition, nparts)
         resources.put(rid, provider)
-    jnl = journal.journal_for(run_info.get("query_id", ""))
+    jnl = (None if run_info.get("stream")
+           else journal.journal_for(run_info.get("query_id", "")))
     if jnl is not None:
         # the plan record pins what this journal is a log OF: the
         # pre-AQE query fingerprint plus the stage skeleton (per-stage

@@ -147,7 +147,8 @@ class TestRecoveryScan:
         jnl = _crashed_journal(tmp_path, qid="crashed1", fp=fp)
         summary = journal.ensure_recovery_scan(force=True)
         assert summary == {"scanned": 1, "resumable": 1,
-                           "billed_failed": 1, "stages_recovered": 1}
+                           "billed_failed": 1, "stages_recovered": 1,
+                           "streams_adoptable": 0}
         records = journal.load_records(jnl.path)
         terminal = records[-1]
         assert terminal["kind"] == "complete"

@@ -1,0 +1,549 @@
+"""Durable exactly-once streaming (runtime/streaming.py, ISSUE 17):
+TailSource file-offset discovery, micro-batch incremental aggregation
+oracle-equal to a pandas replay of the full input, the crash-atomic
+(offsets, state, epoch) checkpoint protocol — crash-before-checkpoint
+re-processes, torn mid-checkpoint tails heal and fall back, resume
+never skips or double-counts — journal retention/recovery treating
+live stream journals as adoptable (never pruned, never billed
+driver_restart), the stream_stall dossier + doctor stream_lag rule,
+streaming progress summaries, and the QueryService session wiring.
+
+The full chaos round (executor SIGKILL mid-batch + primary driver
+SIGKILL with standby takeover, pandas-oracle final state) is
+`tools/chaos_soak.py --streaming` / `make check-streaming`.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from blaze_tpu.config import conf
+from blaze_tpu.columnar import types as T
+from blaze_tpu.runtime import (doctor, flight_recorder, journal, monitor,
+                               progress, streaming, trace)
+from blaze_tpu.runtime.service import QueryService
+
+# ---------------------------------------------------------------------------
+# harness
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(autouse=True)
+def _stream_env(tmp_path):
+    saved = {k: getattr(conf, k) for k in (
+        "journal_dir", "journal_retention", "recovery_enabled",
+        "flight_dir", "flight_triggers", "progress_enabled",
+        "monitor_enabled", "trace_enabled", "stream_poll_ms",
+        "stream_checkpoint_interval", "stream_max_lag_ms")}
+    conf.journal_dir = str(tmp_path / "journal")
+    conf.journal_retention = 256
+    conf.recovery_enabled = True
+    conf.flight_dir = ""
+    conf.progress_enabled = True
+    conf.stream_poll_ms = 10
+    conf.stream_checkpoint_interval = 1
+    conf.stream_max_lag_ms = 10000
+    journal.reset()
+    flight_recorder.reset()
+    progress.reset()
+    yield
+    streaming.reset()
+    journal.reset()
+    flight_recorder.reset()
+    progress.reset()
+    trace.reset()
+    monitor.reset()
+    for k, v in saved.items():
+        setattr(conf, k, v)
+
+
+SCHEMA = T.Schema([T.Field("k", T.INT64), T.Field("amount", T.FLOAT64)])
+
+
+def _spec():
+    return streaming.StreamSpec(
+        SCHEMA,
+        keys=[{"col": "k", "name": "k"}],
+        aggs=[{"fn": "sum", "col": "amount", "name": "amount_sum"},
+              {"fn": "count", "col": "amount", "name": "n"},
+              {"fn": "min", "col": "amount", "name": "amount_min"},
+              {"fn": "max", "col": "amount", "name": "amount_max"}])
+
+
+def _frame(seed, rows=60):
+    r = np.random.default_rng(seed)
+    return pd.DataFrame({"k": r.integers(0, 5, rows).astype("int64"),
+                         "amount": r.normal(10.0, 3.0, rows)})
+
+
+def _publish(src, i, df):
+    src.publish(f"part-{i:04d}.parquet",
+                pa.Table.from_pandas(df, preserve_index=False))
+
+
+def _oracle(frames):
+    return (pd.concat(frames).groupby("k", as_index=False)
+            .agg(amount_sum=("amount", "sum"), n=("amount", "count"),
+                 amount_min=("amount", "min"), amount_max=("amount", "max"))
+            .sort_values("k").reset_index(drop=True))
+
+
+def _assert_oracle_equal(sq, frames):
+    got = (pd.DataFrame(sq.result_rows()).sort_values("k")
+           .reset_index(drop=True))
+    want = _oracle(frames)
+    assert list(got["k"]) == list(want["k"])
+    for c in ("amount_sum", "amount_min", "amount_max"):
+        assert np.allclose(got[c].astype(float), want[c].astype(float)), c
+    assert list(got["n"]) == list(want["n"])
+
+
+def _wait(cond, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _dead_pid() -> int:
+    p = subprocess.Popen([sys.executable, "-c", "pass"])
+    p.wait()
+    return p.pid
+
+
+def _ckpt_epochs(stream_id):
+    records = journal.load_records(
+        journal.journal_path(stream_id, conf.journal_dir))
+    return [r["epoch"] for r in records
+            if r.get("kind") == "stream_checkpoint"]
+
+
+# ---------------------------------------------------------------------------
+# TailSource + StreamSpec
+# ---------------------------------------------------------------------------
+
+
+def test_tail_source_discovery_and_atomic_publish(tmp_path):
+    src = streaming.TailSource(str(tmp_path / "in"))
+    assert src.discover({}) == []
+    _publish(src, 0, _frame(0))
+    # an in-flight temp file is never discovered (rename-publish contract)
+    with open(os.path.join(src.directory, "part-x.parquet.inprogress"),
+              "wb") as f:
+        f.write(b"torn")
+    assert src.discover({}) == ["part-0000.parquet"]
+    assert src.rows_in("part-0000.parquet") == 60
+    assert src.discover({"part-0000.parquet": 60}) == []
+    assert src.lag_ms({"part-0000.parquet": 60}) == 0.0
+    assert src.lag_ms({}) >= 0.0
+    # doc round trip survives a process boundary
+    src2 = streaming.TailSource.from_doc(src.to_doc())
+    assert src2.directory == src.directory and src2.pattern == src.pattern
+
+
+def test_stream_spec_round_trip_and_merge_guard():
+    spec = _spec()
+    spec2 = streaming.StreamSpec.from_doc(
+        json.loads(json.dumps(spec.to_doc())))
+    assert spec2.key_names() == ["k"]
+    assert spec2.agg_names() == spec.agg_names()
+    assert [f.dtype for f in spec2.schema.fields] == [T.INT64, T.FLOAT64]
+    with pytest.raises(ValueError):
+        streaming.StreamSpec(SCHEMA, [{"col": "k", "name": "k"}],
+                             [{"fn": "median", "col": "amount",
+                               "name": "m"}])
+    with pytest.raises(ValueError):
+        streaming.StreamSpec(SCHEMA, [], [])
+
+
+# ---------------------------------------------------------------------------
+# the micro-batch loop: incremental state == full replay
+# ---------------------------------------------------------------------------
+
+
+def test_incremental_batches_oracle_equal(tmp_path):
+    src = streaming.TailSource(str(tmp_path / "in"))
+    frames = [_frame(i) for i in range(4)]
+    _publish(src, 0, frames[0])
+    sq = streaming.open_stream(src, _spec(), stream_id="st-inc",
+                               work_dir=str(tmp_path / "work"))
+    try:
+        assert sq.wait_consumed(1)
+        # feed the rest one at a time so merging is exercised across
+        # real batch boundaries, not one lucky mega-batch
+        for i in (1, 2, 3):
+            _publish(src, i, frames[i])
+            assert sq.wait_consumed(i + 1)
+        _assert_oracle_equal(sq, frames)
+        st = sq.stats()
+        assert st["rows_total"] == sum(len(f) for f in frames)
+        assert st["batches_total"] >= 2
+        assert st["checkpoint_bytes"] > 0
+        epochs = _ckpt_epochs("st-inc")
+        assert epochs == sorted(set(epochs)), "epochs strictly monotone"
+    finally:
+        sq.stop(graceful=True)
+    # graceful stop settles the journal: terminal complete/ok record
+    records = journal.load_records(
+        journal.journal_path("st-inc", conf.journal_dir))
+    assert journal.is_complete(records)
+
+
+def test_null_groups_match_pandas_min_count(tmp_path):
+    src = streaming.TailSource(str(tmp_path / "in"))
+    frames = [
+        pd.DataFrame({"k": np.array([1, 1, 2], dtype="int64"),
+                      "amount": [np.nan, np.nan, 3.0]}),
+        pd.DataFrame({"k": np.array([1, 2], dtype="int64"),
+                      "amount": [5.0, np.nan]}),
+    ]
+    _publish(src, 0, frames[0])
+    sq = streaming.open_stream(src, _spec(), stream_id="st-null",
+                               work_dir=str(tmp_path / "work"))
+    try:
+        assert sq.wait_consumed(1)
+        _publish(src, 1, frames[1])
+        assert sq.wait_consumed(2)
+        got = {r["k"]: r for r in sq.result_rows()}
+        # pandas sum(min_count=1): all-null group -> missing, not 0.0
+        want = (pd.concat(frames).groupby("k")["amount"]
+                .agg(lambda s: s.sum(min_count=1)))
+        assert got[1]["amount_sum"] == pytest.approx(want[1])
+        assert got[2]["amount_sum"] == pytest.approx(want[2])
+        assert got[1]["n"] == 1 and got[2]["n"] == 1
+    finally:
+        sq.stop(graceful=True)
+
+
+# ---------------------------------------------------------------------------
+# the checkpoint protocol: every crash point resumes exactly-once
+# ---------------------------------------------------------------------------
+
+
+def test_resume_from_checkpoint_no_skip_no_double_count(tmp_path):
+    src = streaming.TailSource(str(tmp_path / "in"))
+    frames = [_frame(10 + i) for i in range(3)]
+    _publish(src, 0, frames[0])
+    _publish(src, 1, frames[1])
+    sq = streaming.open_stream(src, _spec(), stream_id="st-res",
+                               work_dir=str(tmp_path / "work"))
+    assert sq.wait_consumed(2)
+    first_epoch = sq.stats()["epoch"]
+    sq.stop(graceful=False)  # crash posture: journal NOT settled
+
+    _publish(src, 2, frames[2])
+    sq2 = streaming.resume_stream("st-res", work_dir=str(tmp_path / "w2"))
+    try:
+        assert sq2.resumed_from_epoch == first_epoch
+        assert sq2.wait_consumed(3)
+        _assert_oracle_equal(sq2, frames)  # 0 dropped, 0 double-counted
+        assert sq2.stats()["resumed_batches"] >= 1
+        epochs = _ckpt_epochs("st-res")
+        assert epochs == sorted(set(epochs)), "no epoch re-emitted"
+    finally:
+        sq2.stop(graceful=True)
+
+
+def test_crash_before_checkpoint_reprocesses_into_prior_state(tmp_path):
+    conf.stream_checkpoint_interval = 100  # batch commits, checkpoint not due
+    src = streaming.TailSource(str(tmp_path / "in"))
+    frames = [_frame(20), _frame(21)]
+    _publish(src, 0, frames[0])
+    sq = streaming.open_stream(src, _spec(), stream_id="st-pre",
+                               work_dir=str(tmp_path / "work"))
+    assert _wait(lambda: sq.stats()["files_consumed"] >= 1)
+    assert _ckpt_epochs("st-pre") == []  # nothing durable yet
+    sq.stop(graceful=False)
+
+    conf.stream_checkpoint_interval = 1
+    _publish(src, 1, frames[1])
+    sq2 = streaming.resume_stream("st-pre", work_dir=str(tmp_path / "w2"))
+    try:
+        # no checkpoint to restore: the in-flight batch re-processes
+        # from scratch into EMPTY state — merged once, not twice
+        assert sq2.resumed_from_epoch is None
+        assert sq2.wait_consumed(2)
+        _assert_oracle_equal(sq2, frames)
+    finally:
+        sq2.stop(graceful=True)
+
+
+def test_torn_checkpoint_tail_heals_and_falls_back(tmp_path):
+    src = streaming.TailSource(str(tmp_path / "in"))
+    frames = [_frame(30 + i) for i in range(3)]
+    _publish(src, 0, frames[0])
+    sq = streaming.open_stream(src, _spec(), stream_id="st-torn",
+                               work_dir=str(tmp_path / "work"))
+    assert sq.wait_consumed(1)
+    _publish(src, 1, frames[1])
+    assert sq.wait_consumed(2)
+    good_epoch = sq.stats()["epoch"]
+    sq.stop(graceful=False)
+
+    # SIGKILL mid-checkpoint: a torn, newline-less half-record at the
+    # tail claiming a FUTURE epoch with bogus offsets
+    jpath = journal.journal_path("st-torn", conf.journal_dir)
+    with open(jpath, "ab") as f:
+        f.write(b'{"kind": "stream_checkpoint", "epoch": 99, '
+                b'"offsets": {"bogus-file.parquet": 1, "tr')
+
+    _publish(src, 2, frames[2])
+    sq2 = streaming.resume_stream("st-torn", work_dir=str(tmp_path / "w2"))
+    try:
+        # fell back to the last PARSEABLE checkpoint — the torn epoch-99
+        # line is never honoured, no file is skipped
+        assert sq2.resumed_from_epoch == good_epoch
+        assert "bogus-file.parquet" not in sq2.offsets
+        assert sq2.wait_consumed(3)
+        _assert_oracle_equal(sq2, frames)
+    finally:
+        sq2.stop(graceful=True)
+    # the resume's appends healed the torn tail: the garbage got its own
+    # terminated line (loaders skip it) and nothing concatenated onto it
+    with open(jpath, "rb") as f:
+        lines = f.read().splitlines()
+    assert sum(1 for ln in lines if b'"epoch": 99' in ln) == 1
+    json.loads(lines[-1])  # post-heal appends are clean records
+
+
+# ---------------------------------------------------------------------------
+# satellite 1: retention + recovery treat stream journals as adoptable
+# ---------------------------------------------------------------------------
+
+
+def test_retention_never_prunes_live_stream_journal(tmp_path):
+    conf.journal_retention = 1
+    src = streaming.TailSource(str(tmp_path / "in"))
+    _publish(src, 0, _frame(40))
+    sq = streaming.open_stream(src, _spec(), stream_id="st-ret",
+                               work_dir=str(tmp_path / "work"))
+    assert sq.wait_consumed(1)
+    jpath = journal.journal_path("st-ret", conf.journal_dir)
+    # heavy settled-journal churn: way past the retention budget
+    for i in range(4):
+        j = journal.QueryJournal(f"batch-{i}")
+        j.admitted(tenant_id="t")
+        j.complete("ok")
+    journal.prune()
+    assert os.path.exists(jpath), "live stream journal pruned"
+    # crash posture keeps it adoptable too (stream not settled)
+    sq.stop(graceful=False)
+    journal.prune()
+    assert os.path.exists(jpath)
+    # graceful settle releases it to normal retention
+    sq2 = streaming.resume_stream("st-ret", work_dir=str(tmp_path / "w2"))
+    sq2.stop(graceful=True)
+    for i in range(4, 8):
+        j = journal.QueryJournal(f"batch-{i}")
+        j.admitted(tenant_id="t")
+        j.complete("ok")
+    journal.prune()
+    assert not os.path.exists(jpath), "settled stream must age out"
+
+
+def test_recovery_scan_adopts_dead_writer_streams(tmp_path):
+    conf.flight_dir = str(tmp_path / "flight")
+    src = streaming.TailSource(str(tmp_path / "in"))
+    _publish(src, 0, _frame(41))
+    jnl = journal.QueryJournal("st-dead")
+    jnl.record("admitted", tenant_id="acme", pid=_dead_pid())
+    jnl.record("stream_open", pid=0, tenant_id="acme",
+               spec=_spec().to_doc(), source=src.to_doc(),
+               num_partitions=2, shuffle_parts=2, mesh_exchange="off",
+               resumed_from_epoch=None)
+    summary = journal.ensure_recovery_scan(force=True)
+    assert summary["streams_adoptable"] == 1
+    # adopted, NOT billed: no driver_restart terminal record or dossier
+    assert summary["billed_failed"] == 0
+    assert flight_recorder.list_dossiers() == []
+    assert os.path.exists(jnl.path)
+    assert "st-dead" in streaming.adoptable_streams()
+    # adoption is consume-once; resume reconstructs spec+source from the
+    # journal alone and processes the pending input
+    sq = streaming.resume_stream("st-dead", work_dir=str(tmp_path / "w"))
+    try:
+        assert streaming.adoptable_streams() == {}
+        assert sq.wait_consumed(1)
+        _assert_oracle_equal(sq, [_frame(41)])
+    finally:
+        sq.stop(graceful=True)
+
+
+# ---------------------------------------------------------------------------
+# satellite 3: stream_stall dossier (exactly once) + doctor stream_lag
+# ---------------------------------------------------------------------------
+
+
+def test_stream_stall_dossier_exactly_once(tmp_path):
+    conf.flight_dir = str(tmp_path / "flight")
+    conf.flight_triggers = "all"
+    conf.stream_max_lag_ms = 1
+    src = streaming.TailSource(str(tmp_path / "in"))
+    # a poisoned published file: every batch fails, lag only grows
+    bad = os.path.join(src.directory, "part-0000.parquet")
+    os.makedirs(src.directory)
+    with open(bad, "wb") as f:
+        f.write(b"not a parquet file")
+    old = time.time() - 120
+    os.utime(bad, (old, old))
+    sq = streaming.open_stream(src, _spec(), stream_id="st-stall",
+                               work_dir=str(tmp_path / "work"))
+    try:
+        assert _wait(lambda: any(
+            d["trigger"] == "stream_stall"
+            for d in flight_recorder.list_dossiers()))
+        assert _wait(lambda: sq.stats()["batch_failures"] >= 2)
+        stalls = [d for d in flight_recorder.list_dossiers()
+                  if d["trigger"] == "stream_stall"]
+        assert len(stalls) == 1, "stall dossier must dedup per stream"
+        assert stalls[0]["query_id"] == "st-stall"
+    finally:
+        sq.stop(graceful=False)
+
+
+def test_doctor_stream_lag_rule():
+    rec = {"schema_version": trace.SCHEMA_VERSION, "query_id": "st-1",
+           "tenant_id": "t", "admission_outcome": "admitted",
+           "admission_wait_ms": 0, "duration_ms": 50.0, "stages": [],
+           "resilience_events": {}, "counters": {},
+           "stream": {"stream_id": "st-1", "epoch": 7,
+                      "lag_ms": 25000.0, "prev_lag_ms": 20000.0,
+                      "max_lag_ms": 10000.0, "files": 4}}
+    findings = doctor.diagnose(rec)
+    lag = [f for f in findings if f.code == "stream_lag"]
+    assert len(lag) == 1
+    assert lag[0].evidence["lag_ms"] == 25000.0
+    assert "stream_poll_ms" in lag[0].suggestion
+    # shrinking lag is a recovering stream, not a finding
+    rec2 = dict(rec, stream=dict(rec["stream"], lag_ms=15000.0))
+    assert not any(f.code == "stream_lag" for f in doctor.diagnose(rec2))
+    # no objective -> no rule
+    rec3 = dict(rec, stream=dict(rec["stream"], max_lag_ms=0))
+    assert not any(f.code == "stream_lag" for f in doctor.diagnose(rec3))
+
+
+def test_micro_batch_ledger_line_carries_stream_evidence():
+    rec = trace.build_run_record(
+        "st-led", run_info={"tenant_id": "t", "stream": {
+            "stream_id": "st-led", "epoch": 3, "lag_ms": 12.0,
+            "prev_lag_ms": 0.0, "max_lag_ms": 10000, "files": 1}},
+        records=[])
+    assert rec["stream"]["epoch"] == 3
+
+
+# ---------------------------------------------------------------------------
+# satellite 2: streaming progress summaries
+# ---------------------------------------------------------------------------
+
+
+def test_streaming_progress_summary(tmp_path):
+    src = streaming.TailSource(str(tmp_path / "in"))
+    _publish(src, 0, _frame(50))
+    sq = streaming.open_stream(src, _spec(), stream_id="st-prog",
+                               work_dir=str(tmp_path / "work"))
+    try:
+        assert sq.wait_consumed(1)
+        s = progress.snapshot_query("st-prog")
+        assert s is not None and s["streaming"] is True
+        # an unbounded query has no 0..1 ratio or completion ETA —
+        # progress is per-batch epoch + lag + time-to-drain
+        assert s["progress_ratio"] is None and s["eta_ms"] is None
+        assert s["batch_epoch"] >= 1 and s["batches"] >= 1
+        assert s["rows"] == 60
+        assert s["lag_ms"] is not None and s["batch_ms"] is not None
+        assert s["lag_eta_ms"] == 0.0  # caught up -> nothing to drain
+    finally:
+        sq.stop(graceful=True)
+    assert progress.snapshot_query("st-prog") is None
+
+
+def test_lag_eta_estimates_drain_time():
+    progress.begin_stream("st-eta", "t")
+    progress.stream_batch("st-eta", 1, 100, lag_ms=500.0, batch_ms=40.0)
+    s = progress.snapshot_query("st-eta")
+    assert s["lag_eta_ms"] == pytest.approx(40.0)  # one EWMA batch behind
+    progress.finish_query("st-eta")
+
+
+# ---------------------------------------------------------------------------
+# satellite 4: registry sync — gauges, events, blaze_top row
+# ---------------------------------------------------------------------------
+
+
+def test_stream_gauges_and_blaze_top_row(tmp_path):
+    conf.monitor_enabled = True
+    monitor.reset()
+    src = streaming.TailSource(str(tmp_path / "in"))
+    _publish(src, 0, _frame(51))
+    sq = streaming.open_stream(src, _spec(), stream_id="st-gauge",
+                               work_dir=str(tmp_path / "work"))
+    try:
+        assert sq.wait_consumed(1)
+        text = monitor.prometheus_text()
+        assert 'blaze_stream_lag_ms{qid="st-gauge"}' in text
+        assert 'blaze_stream_batches_total{qid="st-gauge"}' in text
+        assert 'blaze_stream_checkpoint_bytes{qid="st-gauge"}' in text
+        # a streaming query must not render a bogus 0..1 progress ratio
+        assert 'blaze_query_progress_ratio{qid="st-gauge"}' not in text
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "tools"))
+        import blaze_top
+
+        frame = blaze_top.render(blaze_top.parse_prometheus(text), "test")
+        row = [ln for ln in frame.splitlines()
+               if ln.startswith("stream   st-gauge")]
+        assert len(row) == 1 and "batches=" in row[0]
+    finally:
+        sq.stop(graceful=True)
+
+
+def test_stream_event_kinds_registered():
+    for kind in ("stream_batch", "stream_checkpoint", "stream_resume"):
+        assert kind in trace.EVENT_KINDS
+    assert "stream_stall" in flight_recorder.TRIGGERS
+    for g in ("blaze_stream_lag_ms", "blaze_stream_batches_total",
+              "blaze_stream_checkpoint_bytes"):
+        assert g in monitor.GAUGE_NAMES
+
+
+# ---------------------------------------------------------------------------
+# QueryService wiring: streams as long-lived admitted sessions
+# ---------------------------------------------------------------------------
+
+
+def test_service_stream_session_admitted_per_batch(tmp_path):
+    src = streaming.TailSource(str(tmp_path / "in"))
+    frames = [_frame(60), _frame(61)]
+    _publish(src, 0, frames[0])
+    with QueryService(max_concurrent=2) as svc:
+        sq = svc.open_stream(src, _spec(), tenant_id="acme",
+                             stream_id="st-svc",
+                             work_dir=str(tmp_path / "work"))
+        assert sq.wait_consumed(1)
+        _publish(src, 1, frames[1])
+        assert sq.wait_consumed(2)
+        assert svc.stats()["streams"] == 1
+        # every micro-batch went through admission accounting
+        assert svc.stats()["admitted"] >= 2
+        _assert_oracle_equal(sq, frames)
+    # service close detaches non-gracefully: the stream is stopped but
+    # its journal stays ADOPTABLE for the next driver
+    assert not sq.alive()
+    records = journal.load_records(
+        journal.journal_path("st-svc", conf.journal_dir))
+    assert not journal.is_complete(records)
+    sq2 = streaming.resume_stream("st-svc", work_dir=str(tmp_path / "w2"))
+    try:
+        assert sq2.resumed_from_epoch is not None
+        _assert_oracle_equal(sq2, frames)
+    finally:
+        sq2.stop(graceful=True)

@@ -161,6 +161,16 @@ def render(metrics: dict, source: str) -> str:
                 + (" ** DRAINING **"
                    if g("blaze_executor_draining" + sel) else "")
                 + ("" if v else "  ** DOWN **"))
+    stream_rows = [(k, v) for k, v in metrics.items()
+                   if k.startswith("blaze_stream_lag_ms{")]
+    for key, lag in sorted(stream_rows):
+        # blaze_stream_lag_ms{qid="stream-7"} -> stream-7
+        sid = key.split('qid="', 1)[-1].rstrip('"}')
+        sel = '{qid="' + sid + '"}'
+        lines.append(
+            f"stream   {sid:<16} lag={lag:6.0f}ms "
+            f"batches={int(g('blaze_stream_batches_total' + sel))} "
+            f"ckpt={human_bytes(int(g('blaze_stream_checkpoint_bytes' + sel)))}")
     tenants = [(k, v) for k, v in metrics.items()
                if k.startswith("blaze_tenant_mem_used_bytes{")]
     for key, v in sorted(tenants):

@@ -74,6 +74,21 @@ respawning the dead ones), replay the dead primary's journals, and
 answer every query oracle-equal — with exactly ONE driver_failover
 dossier and zero orphans.
 
+`--streaming` (ISSUE 17): the durable exactly-once streaming
+acceptance run, emitting `STREAMING_r21.json`. A subprocess primary
+(4-seat pool, fenced leader lease, fleet manifest) opens a
+checkpointed micro-batch stream over a growing parquet directory
+through QueryService while the parent keeps publishing files; one of
+its executors is SIGKILLed mid-batch (the primary must keep
+committing checkpoints), then the primary itself is SIGKILLed; a
+warm-standby subprocess must take over, ADOPT the dead driver's
+stream from its journal (takeover reports streams_adoptable, never a
+driver_restart bill), resume from the last committed checkpoint
+(resumed_batches >= 1) and drain the remaining input — final
+aggregation state oracle-equal to a pandas replay of EVERY published
+file (0 dropped, 0 double-counted rows), checkpoint epochs strictly
+monotone across both drivers, exactly ONE driver_failover dossier.
+
 Each cell installs one deterministic fault spec (fail the first N calls
 of one KNOWN_POINTS prefix), runs a full driver-path query, and diffs
 the answer against the pandas oracle. A cell is
@@ -1639,6 +1654,290 @@ def _elastic_failover_round(args):
     return rec
 
 
+# the --streaming primary child: a subprocess driver owning a 4-seat
+# pool with a fenced leader lease and a published fleet manifest. It
+# opens the checkpointed stream as a QueryService session (every
+# micro-batch goes through admission), touches BLZ_READY once the first
+# checkpoint is durable, and sleeps — the parent SIGKILLs one of its
+# executors from the manifest (the stream must keep checkpointing),
+# then SIGKILLs the driver itself mid-stream.
+_STREAM_PRIMARY = '''\
+import os, sys, time
+sys.path.insert(0, os.environ["BLZ_REPO"])
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from blaze_tpu.config import conf
+conf.journal_dir = os.environ["BLZ_JDIR"]
+conf.flight_dir = os.environ["BLZ_FDIR"]
+conf.trace_enabled = False
+conf.executor_death_ms = 20000   # workers must outlive the driver gap
+conf.executor_heartbeat_ms = 100
+conf.leader_lease_ms = 1000
+conf.stream_poll_ms = 50
+conf.stream_checkpoint_interval = 1
+from blaze_tpu.columnar import types as T
+from blaze_tpu.runtime import executor_pool as ep
+from blaze_tpu.runtime import standby, streaming
+from blaze_tpu.runtime.service import QueryService
+
+pool = ep.ExecutorPool(count=4, slots=2)
+pool.start()
+ep.activate(pool)
+lease = standby.LeaderLease(os.environ["BLZ_JDIR"])
+lease.acquire()
+lease.start_renewing()
+standby.wire_manifest(pool, os.environ["BLZ_JDIR"])
+schema = T.Schema([T.Field("k", T.INT64), T.Field("amount", T.FLOAT64)])
+spec = streaming.StreamSpec(
+    schema, keys=[{"col": "k", "name": "k"}],
+    aggs=[{"fn": "sum", "col": "amount", "name": "amount_sum"},
+          {"fn": "count", "col": "amount", "name": "n"}])
+svc = QueryService(queue_depth=16)
+svc.start()
+sq = svc.open_stream(streaming.TailSource(os.environ["BLZ_SRC"]), spec,
+                     tenant_id="stream", stream_id="stream-chaos",
+                     num_partitions=4, work_dir=os.environ["BLZ_WDIR"],
+                     mesh_exchange="off")
+while not (sq.last_checkpoint_epoch >= 1 and len(sq.offsets) >= 1):
+    time.sleep(0.05)
+with open(os.environ["BLZ_READY"], "w") as f:
+    f.write("ready")
+time.sleep(600)  # the parent SIGKILLs inside this window
+'''
+
+# the --streaming standby child: a warm StandbyDriver on the same
+# journal dir. After lease-fenced takeover it must find the dead
+# primary's stream ADOPTABLE, resume it from the last committed
+# checkpoint, and drain every published file — reporting the final
+# aggregation state for the parent's pandas-oracle diff, plus the full
+# checkpoint-epoch chain for the monotonicity gate.
+_STREAM_STANDBY = '''\
+import json, os, sys, time
+sys.path.insert(0, os.environ["BLZ_REPO"])
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from blaze_tpu.config import conf
+conf.journal_dir = os.environ["BLZ_JDIR"]
+conf.flight_dir = os.environ["BLZ_FDIR"]
+conf.trace_enabled = False
+conf.executor_death_ms = 20000
+conf.executor_heartbeat_ms = 100
+conf.leader_lease_ms = 1000
+conf.stream_poll_ms = 50
+conf.stream_checkpoint_interval = 1
+from blaze_tpu.runtime import journal, standby, streaming
+
+sb = standby.StandbyDriver(os.environ["BLZ_JDIR"]).start()
+with open(os.environ["BLZ_SREADY"], "w") as f:
+    f.write("watching")
+if not sb.wait_takeover(120):
+    print("STREAM_RESULT " + json.dumps({"took_over": False}))
+    sys.exit(1)
+adoptable = sorted(streaming.adoptable_streams())
+sq = streaming.resume_stream("stream-chaos",
+                             work_dir=os.environ["BLZ_WDIR"] + "_sb")
+total = None
+deadline = time.monotonic() + 300
+while time.monotonic() < deadline:
+    if total is None and os.path.exists(os.environ["BLZ_FEED_DONE"]):
+        with open(os.environ["BLZ_FEED_DONE"]) as f:
+            total = int(f.read().strip())
+    st = sq.stats()
+    if (total is not None and st["files_consumed"] >= total
+            and sq.last_checkpoint_epoch >= sq.epoch):
+        break
+    time.sleep(0.05)
+records = journal.load_records(
+    journal.journal_path("stream-chaos", os.environ["BLZ_JDIR"]))
+print("STREAM_RESULT " + json.dumps({
+    "took_over": True,
+    "takeover": sb.takeover_info,
+    "role": standby.role(),
+    "adoptable": adoptable,
+    "stats": sq.stats(),
+    "rows": sq.result_rows(),
+    "checkpoint_epochs": [r["epoch"] for r in records
+                          if r.get("kind") == "stream_checkpoint"],
+}))
+sq.stop(graceful=True)
+sb.close()
+'''
+
+
+def _streaming_round(args):
+    """--streaming round: a checkpointed micro-batch stream survives an
+    executor SIGKILL mid-batch AND a primary-driver SIGKILL with
+    warm-standby takeover — resumed from the last committed checkpoint,
+    final state oracle-equal to a pandas replay of every published file
+    (0 dropped, 0 double-counted), checkpoint epochs strictly monotone
+    across both drivers, exactly one driver_failover dossier and no
+    driver_restart bill (the stream is adopted, not billed)."""
+    import signal
+    import subprocess
+
+    import numpy as np
+    import pandas as pd
+    import pyarrow as pa
+
+    from blaze_tpu.runtime import flight_recorder, journal, streaming
+    from blaze_tpu.spark import validator
+
+    root = tempfile.mkdtemp(prefix="chaos_stream_")
+    jdir = os.path.join(root, "journal")
+    fdir = os.path.join(root, "flight")
+    sdir = os.path.join(root, "source")
+    ready = os.path.join(root, "ready")
+    sready = os.path.join(root, "standby_ready")
+    feed_done = os.path.join(root, "feed_done")
+    primary = os.path.join(root, "stream_primary.py")
+    standby_py = os.path.join(root, "stream_standby.py")
+    with open(primary, "w") as f:
+        f.write(_STREAM_PRIMARY)
+    with open(standby_py, "w") as f:
+        f.write(_STREAM_STANDBY)
+    env = dict(os.environ, BLZ_REPO=REPO, BLZ_JDIR=jdir, BLZ_FDIR=fdir,
+               BLZ_SRC=sdir, BLZ_WDIR=os.path.join(root, "work"),
+               BLZ_READY=ready, BLZ_SREADY=sready,
+               BLZ_FEED_DONE=feed_done, JAX_PLATFORMS="cpu")
+    src = streaming.TailSource(sdir)
+    rng = np.random.default_rng(args.seed)
+    frames = []
+
+    def feed(n):
+        # the producer side of the stream: numbered immutable files,
+        # rename-published — it outlives both driver kills
+        for _ in range(n):
+            i = len(frames)
+            df = pd.DataFrame({
+                "k": rng.integers(0, 8, 120).astype("int64"),
+                "amount": np.round(rng.normal(50.0, 12.0, 120), 6)})
+            frames.append(df)
+            src.publish("part-%04d.parquet" % i,
+                        pa.Table.from_pandas(df, preserve_index=False))
+            time.sleep(0.1)
+
+    def _ckpt_files():
+        # files covered by the primary's newest durable checkpoint
+        recs = journal.load_records(
+            journal.journal_path("stream-chaos", jdir))
+        offs = [len(r.get("offsets") or {}) for r in recs
+                if r.get("kind") == "stream_checkpoint"]
+        return max(offs) if offs else 0
+
+    rec = {"round": "stream_failover"}
+    t0 = time.time()
+    log1 = open(os.path.join(root, "primary.log"), "w")
+    feed(3)
+    p1 = subprocess.Popen([sys.executable, primary], env=env,
+                          stdout=log1, stderr=subprocess.STDOUT)
+    p2 = None
+    try:
+        deadline = time.monotonic() + 300
+        while (not os.path.exists(ready) and p1.poll() is None
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        rec["held"] = os.path.exists(ready)
+        p2 = subprocess.Popen([sys.executable, standby_py], env=env,
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True)
+        deadline = time.monotonic() + 120
+        while (not os.path.exists(sready) and p2.poll() is None
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        rec["standby_watching"] = os.path.exists(sready)
+        manifest = {}
+        try:
+            with open(os.path.join(jdir, "fleet.manifest.json")) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError):
+            pass
+        exec_pids = [int(s["pid"]) for s in manifest.get("seats", [])]
+        # (a) executor SIGKILL mid-batch: new files keep arriving around
+        # the kill, and the PRIMARY must keep committing checkpoints —
+        # the failed micro-batch simply re-runs from unconsumed offsets
+        feed(2)
+        killed_execs = 0
+        for pid in exec_pids[:1]:
+            try:
+                os.kill(pid, signal.SIGKILL)
+                killed_execs += 1
+            except ProcessLookupError:
+                pass
+        rec["killed_executors"] = killed_execs
+        feed(2)
+        before = _ckpt_files()
+        deadline = time.monotonic() + 240
+        while (_ckpt_files() < len(frames) and p1.poll() is None
+               and time.monotonic() < deadline):
+            time.sleep(0.1)
+        rec["survived_executor_kill"] = _ckpt_files() >= len(frames)
+        rec["checkpointed_files_before_driver_kill"] = _ckpt_files()
+        rec["checkpointed_files_at_exec_kill"] = before
+        # (b) primary driver SIGKILL: the standby must take over and
+        # ADOPT the stream; files published after the kill are
+        # standby-only input
+        if p1.poll() is None:
+            p1.send_signal(signal.SIGKILL)
+        p1.wait(timeout=30)
+        rec["killed_primary"] = p1.returncode == -signal.SIGKILL
+        feed(2)
+        with open(feed_done, "w") as f:
+            f.write(str(len(frames)))
+        try:
+            out, err = p2.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            p2.kill()
+            out, err = p2.communicate()
+        res = None
+        for line in out.splitlines():
+            if line.startswith("STREAM_RESULT "):
+                res = json.loads(line[len("STREAM_RESULT "):])
+        rec["standby"] = res
+        if res is None:
+            rec["standby_output"] = (out + err)[-2000:]
+        rec["failover_dossiers"] = len(
+            [d for d in flight_recorder.list_dossiers(fdir)
+             if d.get("trigger") == "driver_failover"])
+        rec["restart_dossiers"] = len(
+            [d for d in flight_recorder.list_dossiers(fdir)
+             if d.get("trigger") == "driver_restart"])
+        st = (res or {}).get("stats") or {}
+        takeover = (res or {}).get("takeover") or {}
+        diff = "no result"
+        if res and res.get("rows"):
+            got = (pd.DataFrame(res["rows"])[["k", "amount_sum", "n"]]
+                   .sort_values("k").reset_index(drop=True))
+            want = (pd.concat(frames).groupby("k", as_index=False)
+                    .agg(amount_sum=("amount", "sum"),
+                         n=("amount", "count"))
+                    .sort_values("k").reset_index(drop=True))
+            diff = validator._compare(got, want)
+        rec["diff"] = diff
+        epochs = (res or {}).get("checkpoint_epochs") or []
+        rec["epochs_monotone"] = epochs == sorted(set(epochs))
+        ok = (rec["held"] and rec["standby_watching"]
+              and rec["killed_primary"] and killed_execs == 1
+              and rec["survived_executor_kill"]
+              and res is not None and res.get("took_over")
+              and diff is None
+              and st.get("rows_total") == sum(len(f) for f in frames)
+              and st.get("files_consumed") == len(frames)
+              and st.get("resumed_batches", 0) >= 1
+              and st.get("resumed_from_epoch") is not None
+              and takeover.get("streams_adoptable", 0) >= 1
+              and "stream-chaos" in (res.get("adoptable") or [])
+              and rec["epochs_monotone"] and len(epochs) >= 2
+              and rec["failover_dossiers"] == 1
+              and rec["restart_dossiers"] == 0)
+        rec["outcome"] = "recovered" if ok else "failed"
+    finally:
+        log1.close()
+        for p in (p1, p2):
+            if p is not None and p.poll() is None:
+                p.kill()
+    rec["seconds"] = round(time.time() - t0, 3)
+    shutil.rmtree(root, ignore_errors=True)
+    return rec
+
+
 def _overhead(tables):
     """Disabled-path cost: the microbench backs the <=1%-claim at the
     per-call level; the catalogue A/B shows end-to-end parity with an
@@ -1969,6 +2268,14 @@ def main() -> int:
                          "driver plus two executors — lease-fenced "
                          "takeover, worker adoption, journal replay, "
                          "every answer oracle-equal")
+    ap.add_argument("--streaming", action="store_true",
+                    help="durable exactly-once streaming acceptance: a "
+                         "checkpointed micro-batch stream must survive an "
+                         "executor SIGKILL mid-batch and a primary-driver "
+                         "SIGKILL with warm-standby takeover — adopted "
+                         "from its journal, resumed from the last "
+                         "committed checkpoint, final state pandas-oracle "
+                         "equal with strictly monotone checkpoint epochs")
     ap.add_argument("--concurrent-queries", type=int, default=8,
                     help="client sessions per --service round")
     ap.add_argument("--tenants", type=int, default=3,
@@ -1981,7 +2288,8 @@ def main() -> int:
     ap.add_argument("--json-out", default=None)
     args = ap.parse_args()
     if args.json_out is None:
-        args.json_out = ("ELASTIC_r20.json" if args.elastic
+        args.json_out = ("STREAMING_r21.json" if args.streaming
+                         else "ELASTIC_r20.json" if args.elastic
                          else "NETWORK_r19.json" if args.network
                          else "DIST_OBS_r18.json" if args.dist_obs
                          else "DURABILITY_r17.json" if (args.durability
@@ -2013,6 +2321,34 @@ def main() -> int:
         os.makedirs(args.trace_dir, exist_ok=True)
         conf.trace_enabled = True
         conf.trace_export_dir = args.trace_dir
+
+    if args.streaming:
+        # the round feeds its own growing parquet directory — no
+        # catalogue tables needed
+        try:
+            rnd = _streaming_round(args)
+        finally:
+            for k, v in saved_conf.items():
+                setattr(conf, k, v)
+        bad = []
+        if rnd.get("outcome") != "recovered":
+            bad.append({"round": rnd["round"],
+                        "outcome": rnd.get("outcome"),
+                        "diff": rnd.get("diff"),
+                        "standby": rnd.get("standby"),
+                        "failover_dossiers": rnd.get("failover_dossiers"),
+                        "restart_dossiers": rnd.get("restart_dossiers")})
+        report = {
+            "rows": args.rows, "seed": args.seed,
+            "ok": not bad, "bad": bad, "rounds": [rnd],
+        }
+        with open(args.json_out, "w") as f:
+            json.dump(report, f, indent=1)
+        print(f"\nstreaming soak {'OK' if report['ok'] else 'FAILED'} "
+              f"-> {args.json_out}")
+        if bad:
+            print(f"bad: {bad}")
+        return 0 if report["ok"] else 1
 
     tmpdir = tempfile.mkdtemp(prefix="chaos_tables_")
     tables = validator.generate_tables(tmpdir, rows=args.rows)
