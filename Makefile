@@ -9,14 +9,16 @@ PYENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
 .PHONY: check check-fast check-faults check-supervisor check-trace \
 	check-durability check-dist-obs check-network check-elastic \
-	check-streaming check-pipeline check-pipeline-soak check-perf \
+	check-streaming check-autopilot check-pipeline check-pipeline-soak \
+	check-perf \
 	check-perf-update check-obs check-history check-lint check-service \
 	check-doctor check-flight check-executors test test-fast validate \
 	validate-fast warm
 
 check: check-lint test validate check-perf check-history check-service \
 	check-doctor check-flight check-executors check-durability \
-	check-dist-obs check-network check-elastic check-streaming
+	check-dist-obs check-network check-elastic check-streaming \
+	check-autopilot
 	@echo "CHECK OK — safe to commit"
 
 # Static invariant gate (tools/blazelint): lock discipline, knob
@@ -236,6 +238,10 @@ check-elastic:
 check-streaming:
 	$(PYENV) python tools/chaos_soak.py --streaming \
 	  --json-out STREAMING_r21.json
+
+check-autopilot:
+	$(PYENV) python tools/chaos_soak.py --autopilot \
+	  --json-out AUTOPILOT_r22.json
 
 # Pre-warm the persistent compile caches (runtime/compile_service):
 # replays the shape manifest + the TPC-DS catalogue into the XLA cache.

@@ -370,8 +370,10 @@ def diagnose(record: dict,
                 f"stage {sid} bounded by skewed task {ch['task_id']} "
                 f"({worst:.0f}ms vs {med:.0f}ms median, "
                 f"x{worst / med:.1f})",
-                "repartition on a higher-cardinality key or raise "
-                "num_partitions to split the hot partition",
+                "repartition on a higher-cardinality key (raise the "
+                "run_plan num_partitions argument) and lower "
+                "conf.speculation_multiplier so a twin can cover the "
+                "hot partition",
                 evidence))
 
     # executor_skew: one pooled worker dominates federated wall time.
@@ -416,7 +418,7 @@ def diagnose(record: dict,
             "spill_bound", spill_share,
             f"spill I/O took {_term_ms(cp, 'spill'):.0f}ms "
             f"({int(spill_bytes)} bytes spilled)",
-            "raise conf.mem_budget_bytes or this tenant's share in "
+            "raise conf.memory_budget or this tenant's share in "
             "conf.tenant_quota_spec",
             {"spill_ms": _r(_term_ms(cp, "spill")),
              "spill_bytes": spill_bytes,
@@ -431,8 +433,9 @@ def diagnose(record: dict,
             "compile_storm", _share(cp, "compile"),
             f"XLA compile took {_term_ms(cp, 'compile'):.0f}ms with "
             f"{misses} cache miss(es) vs {hits} hit(s)",
-            "pre-warm the persistent compile cache (`make warm` / "
-            "conf.compile_cache_dir)",
+            "pre-warm the persistent compile cache (`make warm`) and "
+            "keep conf.enable_compile_canonicalization on so capacity "
+            "buckets collapse onto fewer compiled shapes",
             {"compile_ms": _r(_term_ms(cp, "compile")),
              "compile_cache_misses": misses, "compile_cache_hits": hits}))
 
@@ -476,8 +479,9 @@ def diagnose(record: dict,
             f"circuit breaker tripped {trips} time(s) "
             f"({degrades} degrade event(s)) — operator running on the "
             f"fallback path",
-            "inspect faults telemetry; raise conf.breaker_threshold "
-            "only after fixing the underlying fault",
+            "inspect faults telemetry; raise "
+            "conf.breaker_failure_threshold only after fixing the "
+            "underlying fault",
             {"breaker_trips": trips, "degrades": degrades}))
 
     # network_flaky: the control/shuffle transport misbehaved during the
@@ -499,6 +503,7 @@ def diagnose(record: dict,
             f"{conn_drops} dropped shuffle conn(s), "
             f"{fences} lease fence(s)",
             "check the host's socket/FD pressure; raise "
+            "conf.control_reconnect_backoff_ms / "
             "conf.control_reconnect_max for flakier links, or "
             "conf.executor_death_ms if partitions out-live the lease",
             {"control_reconnects": reconnects,
@@ -522,7 +527,7 @@ def diagnose(record: dict,
                 f"pipeline overlap only {overlap}% "
                 f"(producers busy {busy:.0f}ms, consumers waited "
                 f"{wait:.0f}ms)",
-                "raise conf.pipeline_depth or check "
+                "raise conf.prefetch_batches or check "
                 "conf.enable_pipeline is on for I/O-bound stages",
                 {"overlap_pct": overlap, "producer_busy_ms": _r(busy),
                  "consumer_wait_ms": _r(wait)}))
@@ -625,8 +630,10 @@ def diagnose(record: dict,
                     f"stage {s.get('stage_id')} ran {ms:.0f}ms vs "
                     f"historical median {p50:.0f}ms "
                     f"(n={cost.get('n')})",
-                    "diff recent changes for this fingerprint; "
-                    "tools/history_report.py shows the trend",
+                    "diff recent changes for this fingerprint "
+                    "(tools/history_report.py shows the trend); raise "
+                    "conf.history_regression_pct only if this magnitude "
+                    "is expected",
                     {"stage_id": s.get("stage_id"), "fingerprint": fp,
                      "ms": _r(ms), "ms_p50": _r(p50),
                      "n": cost.get("n")}))

@@ -1,0 +1,637 @@
+"""Self-tuning autopilot (runtime/autopilot.py) + conf overlay
+composition (config.py): layer precedence and validation, thread-scoped
+application, the crash-atomic OverlayStore (torn tails, restart and
+standby-takeover folds), the suggestion-parsing explorer with quarantine
+step-over, canary promotion/rollback verdicts against like-with-like
+history baselines, provenance stamping in ledger lines and flight
+dossiers, and the observability registries (gauges, events, blaze_top
+row)."""
+
+import glob
+import json
+import os
+import sys
+import threading
+
+import pytest
+
+from blaze_tpu import config
+from blaze_tpu.config import KNOBS, conf
+from blaze_tpu.runtime import (autopilot, flight_recorder, history,
+                               monitor, trace)
+
+FP = "fp-test-0001"
+
+
+@pytest.fixture(autouse=True)
+def _clean_autopilot_conf(tmp_path):
+    saved = {k: getattr(conf, k) for k in (
+        "autopilot_enabled", "autopilot_dir", "autopilot_canary_runs",
+        "autopilot_max_active_canaries", "history_dir", "trace_enabled",
+        "trace_export_dir", "flight_dir", "flight_triggers",
+        "history_regression_pct", "target_batch_bytes", "autoscale_max",
+        "prefetch_batches", "telemetry_ship_ms", "enable_pipeline")}
+    autopilot.reset()
+    history.reset()
+    trace.reset()
+    flight_recorder.reset()
+    config.set_tenant_overlay("tA", None)
+    config.set_tenant_overlay("tB", None)
+    yield
+    for k, v in saved.items():
+        setattr(conf, k, v)
+    autopilot.reset()
+    history.reset()
+    trace.reset()
+    flight_recorder.reset()
+    config.set_tenant_overlay("tA", None)
+    config.set_tenant_overlay("tB", None)
+
+
+# ---------------------------------------------------------------------------
+# overlay composition (config.py)
+# ---------------------------------------------------------------------------
+
+
+def test_overlay_precedence_base_tenant_fingerprint_pin():
+    config.set_tenant_overlay("tA", {"prefetch_batches": 2,
+                                     "telemetry_ship_ms": 400})
+    r = config.resolve_overlay(
+        tenant="tA",
+        fingerprint_overlay={"prefetch_batches": 3,
+                             "target_batch_bytes": 1 << 20},
+        pin={"target_batch_bytes": 2 << 20})
+    assert r.values == {"prefetch_batches": 3,
+                        "telemetry_ship_ms": 400,
+                        "target_batch_bytes": 2 << 20}
+    assert r.provenance == {"prefetch_batches": "fingerprint",
+                            "telemetry_ship_ms": "tenant",
+                            "target_batch_bytes": "pin"}
+
+
+def test_overlay_validation_rejects_unknown_and_mistyped():
+    with pytest.raises(KeyError, match="pin"):
+        config.resolve_overlay(pin={"no_such_knob": 1})
+    with pytest.raises(TypeError):
+        config.resolve_overlay(pin={"prefetch_batches": "three"})
+    # int knobs coerce clean floats, bools stay strict
+    assert config.validate_overlay({"prefetch_batches": 3.0})[
+        "prefetch_batches"] == 3
+    with pytest.raises(TypeError):
+        config.validate_overlay({"autopilot_enabled": 1})
+
+
+def test_overlay_hash_stable_and_empty_none():
+    h1 = config.overlay_hash({"a": 1, "b": 2})
+    h2 = config.overlay_hash({"b": 2, "a": 1})
+    assert h1 == h2 and len(h1) == 12
+    assert config.overlay_hash({}) is None
+
+
+def test_overlay_scope_applies_and_isolates_threads():
+    seen = {}
+    ready = threading.Event()
+    release = threading.Event()
+
+    def other():
+        ready.set()
+        release.wait(5)
+        seen["other"] = conf.prefetch_batches
+
+    t = threading.Thread(target=other)
+    t.start()
+    ready.wait(5)
+    base = conf.prefetch_batches
+    with config.overlay_scope({"prefetch_batches": base + 5}):
+        assert conf.prefetch_batches == base + 5
+        # nested scope merges then restores
+        with config.overlay_scope({"prefetch_batches": base + 7}):
+            assert conf.prefetch_batches == base + 7
+        assert conf.prefetch_batches == base + 5
+        assert config.current_overlay() == {"prefetch_batches": base + 5}
+        release.set()
+        t.join(5)
+    assert conf.prefetch_batches == base
+    # the concurrent thread never saw this thread's overlay
+    assert seen["other"] == base
+
+
+def test_overlay_reaches_pipeline_producer_threads():
+    # scans run on pipeline pump threads (conf.enable_pipeline), so the
+    # per-query overlay must ride _CtxSnapshot into the producer — a
+    # canaried target_batch_bytes that only the task thread sees would
+    # silently change nothing
+    from blaze_tpu.runtime import pipeline
+
+    conf.enable_pipeline = True
+    base = conf.prefetch_batches
+    seen = []
+
+    def source():
+        seen.append(conf.prefetch_batches)
+        yield 1
+
+    with config.overlay_scope({"prefetch_batches": base + 5}):
+        stream = pipeline.prefetch(source(), depth=1, name="ovl-test")
+    assert list(stream) == [1]
+    assert seen == [base + 5]
+
+
+def test_tenant_isolation_in_resolution():
+    config.set_tenant_overlay("tA", {"prefetch_batches": 7})
+    ra = config.resolve_overlay(tenant="tA")
+    rb = config.resolve_overlay(tenant="tB")
+    assert ra.values == {"prefetch_batches": 7}
+    assert rb.values == {}
+    # and a live scope for tenant A's query is invisible to tenant B's
+    # resolution on another thread
+    out = {}
+
+    def tb_resolve():
+        out["rb"] = config.resolve_overlay(tenant="tB").values
+        out["base"] = conf.prefetch_batches
+
+    with config.overlay_scope(ra.values, ra.provenance):
+        t = threading.Thread(target=tb_resolve)
+        t.start()
+        t.join(5)
+    assert out["rb"] == {} and out["base"] != 7
+
+
+def test_propose_step_schedules():
+    tb = KNOBS["target_batch_bytes"]  # geometric x2
+    assert tb.propose_step(1 << 20, +1) == 2 << 20
+    assert tb.propose_step(1 << 20, -1) == 1 << 19
+    assert tb.propose_step(tb.max, +1) is None  # at the rail
+    pf = KNOBS["prefetch_batches"]  # linear +-1, int
+    assert pf.propose_step(2, +1) == 3
+    assert pf.propose_step(pf.min, -1) is None
+    # a knob without a declared schedule never steps
+    assert KNOBS["memory_budget"].propose_step(1 << 30, +1) is None
+
+
+# ---------------------------------------------------------------------------
+# OverlayStore durability
+# ---------------------------------------------------------------------------
+
+
+def test_store_fold_propose_promote_rollback(tmp_path):
+    st = autopilot.OverlayStore(str(tmp_path))
+    st.append("propose", FP, knob="prefetch_batches", value=3)
+    folded = st.fold()[FP]
+    assert folded.canary == {"knob": "prefetch_batches", "value": 3,
+                             "wins": 0, "runs": 0}
+    st.append("promote", FP, knob="prefetch_batches", value=3)
+    folded = st.fold()[FP]
+    assert folded.settled == {"prefetch_batches": 3}
+    assert folded.canary is None and folded.promotions == 1
+    st.append("propose", FP, knob="target_batch_bytes", value=1 << 20)
+    st.append("rollback", FP, knob="target_batch_bytes", value=1 << 20,
+              reason="regression", verdict={})
+    folded = st.fold()[FP]
+    assert folded.quarantined("target_batch_bytes", 1 << 20)
+    assert folded.settled == {"prefetch_batches": 3}
+    assert folded.rollbacks == 1
+
+
+def test_store_heals_torn_tail(tmp_path):
+    st = autopilot.OverlayStore(str(tmp_path))
+    st.append("promote", FP, knob="prefetch_batches", value=2)
+    with open(st.path, "ab") as f:  # simulate a SIGKILL mid-write
+        f.write(b'{"kind": "promote", "fp": "x", "knob": "pre')
+    st2 = autopilot.OverlayStore(str(tmp_path))
+    assert [r["fp"] for r in st2.load_records()] == [FP]
+    st2.append("promote", "fp2", knob="prefetch_batches", value=4)
+    kinds = [(r["fp"], r["kind"]) for r in st2.load_records()]
+    assert kinds == [(FP, "promote"), ("fp2", "promote")]
+
+
+def test_quarantine_survives_restart_and_standby_takeover(tmp_path):
+    ap = autopilot.Autopilot(str(tmp_path))
+    ap.store.append("rollback", FP, knob="target_batch_bytes",
+                    value=8 << 20, reason="regression", verdict={})
+    # driver restart: module cache dropped, next active() refolds
+    conf.autopilot_enabled = True
+    conf.autopilot_dir = str(tmp_path)
+    autopilot.reset()
+    restarted = autopilot.active()
+    assert restarted.state_for(FP).quarantined("target_batch_bytes",
+                                               8 << 20)
+    # standby takeover: a DIFFERENT process folds the same store file
+    standby_ap = autopilot.Autopilot(str(tmp_path))
+    assert standby_ap.state_for(FP).quarantined("target_batch_bytes",
+                                                8 << 20)
+    assert standby_ap.metrics()["rollbacks_total"] == {
+        "target_batch_bytes": 1}
+
+
+# ---------------------------------------------------------------------------
+# explorer
+# ---------------------------------------------------------------------------
+
+
+def _serde_bound_record(qid="q1", ms=1000.0):
+    return {"query_id": qid, "duration_ms": ms,
+            "counters": {}, "stages": [],
+            "critical_path": {"total_ms": ms,
+                              "terms": {"serde_encode": 0.6 * ms}}}
+
+
+def _settled_history(n=3, ms=100.0, stage_ms=100.0, fp=FP,
+                     overlay_hash=None):
+    st = history.store()
+    for i in range(n):
+        st.append({"query_id": f"base{i}", "autopilot_fp": fp,
+                   "canary": False, "overlay_hash": overlay_hash,
+                   "duration_ms": ms,
+                   "stages": [{"fingerprint": "s1", "ms": stage_ms,
+                               "copied_bytes": 1000}]})
+
+
+def test_parse_suggestion_knob_and_direction():
+    assert autopilot.parse_suggestion(
+        "raise conf.target_batch_bytes (fewer, larger frames)") == (
+            "target_batch_bytes", 1)
+    assert autopilot.parse_suggestion(
+        "lower conf.telemetry_ship_ms for fresher gauges") == (
+            "telemetry_ship_ms", -1)
+    # verbless and non-actuator mentions are not actionable
+    assert autopilot.parse_suggestion(
+        "check conf.target_batch_bytes") is None
+    assert autopilot.parse_suggestion(
+        "raise conf.memory_budget") is None
+    # first actuatable mention wins even after a non-actuator
+    assert autopilot.parse_suggestion(
+        "raise conf.memory_budget or raise conf.prefetch_batches") == (
+            "prefetch_batches", 1)
+
+
+def test_explorer_proposes_one_step_from_top_finding(tmp_path):
+    conf.history_dir = str(tmp_path / "hist")
+    conf.target_batch_bytes = 1 << 20
+    _settled_history()
+    ap = autopilot.Autopilot(str(tmp_path / "ap"))
+    run_info = {"autopilot": {"fingerprint": FP, "canary": False}}
+    ap.observe("q1", run_info, _serde_bound_record())
+    st = ap.state_for(FP)
+    assert st.canary == {"knob": "target_batch_bytes", "value": 2 << 20,
+                         "wins": 0, "runs": 0}
+    values, canary_knob = ap.overlay_for(FP)
+    assert values == {"target_batch_bytes": 2 << 20}
+    assert canary_knob == "target_batch_bytes"
+    # persisted: a refold sees the same live canary
+    assert autopilot.Autopilot(
+        str(tmp_path / "ap")).overlay_for(FP) == (values, canary_knob)
+
+
+def test_explorer_needs_a_settled_baseline(tmp_path):
+    conf.history_dir = str(tmp_path / "hist")
+    _settled_history(n=2)  # one run is not a distribution; two isn't
+    ap = autopilot.Autopilot(str(tmp_path / "ap"))
+    ap.observe("q1", {"autopilot": {"fingerprint": FP}},
+               _serde_bound_record())
+    assert ap.state_for(FP).canary is None
+
+
+def test_explorer_steps_over_quarantined_values(tmp_path):
+    conf.history_dir = str(tmp_path / "hist")
+    conf.target_batch_bytes = 1 << 20
+    _settled_history()
+    ap = autopilot.Autopilot(str(tmp_path / "ap"))
+    ap.store.append("rollback", FP, knob="target_batch_bytes",
+                    value=2 << 20, reason="inconclusive", verdict={})
+    ap = autopilot.Autopilot(str(tmp_path / "ap"))
+    ap.observe("q1", {"autopilot": {"fingerprint": FP}},
+               _serde_bound_record())
+    # 2MB is quarantined (a neutral plateau): the walk passes it, never
+    # re-proposes it
+    assert ap.state_for(FP).canary["value"] == 4 << 20
+
+
+def test_explorer_respects_max_active_canaries(tmp_path):
+    conf.history_dir = str(tmp_path / "hist")
+    conf.autopilot_max_active_canaries = 1
+    _settled_history()
+    ap = autopilot.Autopilot(str(tmp_path / "ap"))
+    ap.store.append("propose", "other-fp", knob="prefetch_batches",
+                    value=3)
+    ap = autopilot.Autopilot(str(tmp_path / "ap"))
+    ap.observe("q1", {"autopilot": {"fingerprint": FP}},
+               _serde_bound_record())
+    assert ap.state_for(FP).canary is None
+
+
+# ---------------------------------------------------------------------------
+# canary verdicts
+# ---------------------------------------------------------------------------
+
+
+def _canary_run_info(knob="target_batch_bytes"):
+    return {"autopilot": {"fingerprint": FP, "canary": True,
+                          "canary_knob": knob}}
+
+
+def _canary_record(qid, ms, stage_ms=None, overlay_hash="abc123"):
+    return {"query_id": qid, "autopilot_fp": FP, "canary": True,
+            "overlay_hash": overlay_hash, "duration_ms": ms,
+            "counters": {},
+            "stages": [{"fingerprint": "s1",
+                        "ms": ms if stage_ms is None else stage_ms,
+                        "copied_bytes": 1000}]}
+
+
+def _proposed(tmp_path, value=2 << 20):
+    ap = autopilot.Autopilot(str(tmp_path / "ap"))
+    ap.store.append("propose", FP, knob="target_batch_bytes",
+                    value=value)
+    return autopilot.Autopilot(str(tmp_path / "ap"))
+
+
+def test_canary_promoted_after_consecutive_wins(tmp_path):
+    conf.history_dir = str(tmp_path / "hist")
+    conf.autopilot_canary_runs = 2
+    _settled_history(ms=100.0)
+    ap = _proposed(tmp_path)
+    ap.observe("c1", _canary_run_info(), _canary_record("c1", 50.0))
+    assert ap.state_for(FP).canary["wins"] == 1
+    ap.observe("c2", _canary_run_info(), _canary_record("c2", 50.0))
+    st = ap.state_for(FP)
+    assert st.canary is None
+    assert st.settled == {"target_batch_bytes": 2 << 20}
+    kinds = [r["kind"] for r in ap.store.load_records()]
+    assert kinds[-1] == "promote"
+    # settled overlay now applies without a canary mark
+    assert ap.overlay_for(FP) == ({"target_batch_bytes": 2 << 20}, "")
+
+
+def test_broken_streak_resets_wins(tmp_path):
+    conf.history_dir = str(tmp_path / "hist")
+    conf.autopilot_canary_runs = 2
+    _settled_history(ms=100.0)
+    ap = _proposed(tmp_path)
+    ap.observe("c1", _canary_run_info(), _canary_record("c1", 50.0))
+    ap.observe("c2", _canary_run_info(),
+               _canary_record("c2", 100.0))  # tie: not a win
+    st = ap.state_for(FP)
+    assert st.canary is not None and st.canary["wins"] == 0
+    ap.observe("c3", _canary_run_info(), _canary_record("c3", 50.0))
+    assert ap.state_for(FP).canary["wins"] == 1  # consecutive, not total
+
+
+def test_regression_rolls_back_quarantines_and_captures(tmp_path):
+    conf.history_dir = str(tmp_path / "hist")
+    conf.flight_dir = str(tmp_path / "flight")
+    conf.history_regression_pct = 25.0
+    _settled_history(ms=100.0, stage_ms=100.0)
+    ap = _proposed(tmp_path)
+    # stage wall 500ms vs settled median 100ms: a regression verdict
+    ap.observe("c1", _canary_run_info(),
+               _canary_record("c1", 500.0))
+    st = ap.state_for(FP)
+    assert st.canary is None
+    assert st.quarantined("target_batch_bytes", 2 << 20)
+    last = ap.store.load_records()[-1]
+    assert last["kind"] == "rollback" and last["reason"] == "regression"
+    assert last["verdict"]["metric"] == "wall_ms"
+    # flight dossier: trigger + overlay provenance for the 3am operator
+    paths = glob.glob(os.path.join(conf.flight_dir, "dossier_*.json"))
+    assert len(paths) == 1 and "autopilot_rollback" in paths[0]
+    doc = json.load(open(paths[0]))
+    assert doc["trigger"] == "autopilot_rollback"
+    assert doc["detail"]["knob"] == "target_batch_bytes"
+    assert doc["detail"]["quarantine"]["target_batch_bytes"] == [2 << 20]
+    assert doc["autopilot"]["fingerprint"] == FP
+    # quarantined values are never re-proposed (no oscillation): the
+    # next exploration steps over 2MB
+    ap.observe("q9", {"autopilot": {"fingerprint": FP}},
+               _serde_bound_record())
+    canary = ap.state_for(FP).canary
+    assert canary is None or canary["value"] != 2 << 20
+
+
+def test_inconclusive_canary_expires_into_quarantine(tmp_path):
+    conf.history_dir = str(tmp_path / "hist")
+    conf.autopilot_canary_runs = 1
+    _settled_history(ms=100.0)
+    ap = _proposed(tmp_path)
+    for i in range(3):  # 3x the budget of ties
+        ap.observe(f"c{i}", _canary_run_info(),
+                   _canary_record(f"c{i}", 100.0))
+    st = ap.state_for(FP)
+    assert st.canary is None
+    assert st.quarantined("target_batch_bytes", 2 << 20)
+    last = ap.store.load_records()[-1]
+    assert last["kind"] == "rollback" and \
+        last["reason"] == "inconclusive"
+
+
+def test_promote_publishes_fleet_knob_to_base_conf(tmp_path):
+    conf.history_dir = str(tmp_path / "hist")
+    conf.autopilot_canary_runs = 1
+    conf.autoscale_max = 4
+    _settled_history(ms=100.0)
+    ap = autopilot.Autopilot(str(tmp_path / "ap"))
+    ap.store.append("propose", FP, knob="autoscale_max", value=5)
+    ap = autopilot.Autopilot(str(tmp_path / "ap"))
+    ap.observe("c1", _canary_run_info("autoscale_max"),
+               _canary_record("c1", 50.0))
+    assert ap.state_for(FP).settled == {"autoscale_max": 5}
+    # fleet-class knob: the autoscaler's policy loop reads base conf on
+    # its own thread, so promotion publishes the bound globally
+    assert conf.autoscale_max == 5
+
+
+# ---------------------------------------------------------------------------
+# history/feed hygiene: like-with-like baselines
+# ---------------------------------------------------------------------------
+
+
+def test_record_run_stamps_overlay_fields(tmp_path):
+    conf.history_dir = str(tmp_path / "hist")
+    history.begin_query("qo")
+    rec = history.record_run("qo", {
+        "autopilot": {"fingerprint": FP, "canary": True,
+                      "overlay_hash": "abc123def456"}})
+    assert rec["canary"] is True
+    assert rec["overlay_hash"] == "abc123def456"
+    assert rec["autopilot_fp"] == FP
+    # no autopilot in run_info -> no stamp (legacy record shape)
+    history.begin_query("qp")
+    rec2 = history.record_run("qp", {})
+    assert "canary" not in rec2 and "overlay_hash" not in rec2
+
+
+def test_feed_skips_canary_records():
+    settled = {"query_id": "a", "stages": [
+        {"fingerprint": "s1", "ms": 100.0, "copied_bytes": 10}]}
+    canary = {"query_id": "b", "canary": True, "stages": [
+        {"fingerprint": "s1", "ms": 900.0, "copied_bytes": 10}]}
+    feed = history.StatisticsFeed([settled, canary, dict(settled)])
+    cost = feed.observed_stage_cost("s1")
+    assert cost["n"] == 2 and cost["ms_p50"] == 100.0
+
+
+def test_detect_regressions_canary_vs_settled_baseline():
+    base = [{"query_id": f"b{i}", "canary": False, "overlay_hash": None,
+             "stages": [{"fingerprint": "s1", "ms": 100.0,
+                         "copied_bytes": 10}]} for i in range(3)]
+    canary = {"query_id": "c", "canary": True, "overlay_hash": "zzz",
+              "stages": [{"fingerprint": "s1", "ms": 500.0,
+                          "copied_bytes": 10}]}
+    out = history.detect_regressions(base + [canary], pct=25.0)
+    assert out and out[0]["metric"] == "wall_ms" and out[0]["runs"] == 3
+
+
+def test_detect_regressions_never_uses_canary_priors():
+    # three slow CANARY runs in the window must not mask a settled
+    # regression (nor serve as its baseline)
+    base = [{"query_id": f"b{i}", "canary": False, "overlay_hash": None,
+             "stages": [{"fingerprint": "s1", "ms": 100.0,
+                         "copied_bytes": 10}]} for i in range(3)]
+    canaries = [{"query_id": f"c{i}", "canary": True,
+                 "overlay_hash": "zzz",
+                 "stages": [{"fingerprint": "s1", "ms": 5000.0,
+                             "copied_bytes": 10}]} for i in range(3)]
+    latest = {"query_id": "x", "canary": False, "overlay_hash": None,
+              "stages": [{"fingerprint": "s1", "ms": 300.0,
+                          "copied_bytes": 10}]}
+    out = history.detect_regressions(base + canaries + [latest],
+                                     pct=25.0)
+    assert out and out[0]["latest"] == 300.0 and out[0]["runs"] == 3
+
+
+def test_detect_regressions_filters_overlay_generations():
+    # pre-promotion (hash None, 1000ms) and post-promotion (hash "new",
+    # 400ms) runs must not mix: a 700ms run under the new overlay IS a
+    # regression against its own generation, but the old generation's
+    # slower median would hide it
+    old = [{"query_id": f"o{i}", "canary": False, "overlay_hash": None,
+            "stages": [{"fingerprint": "s1", "ms": 1000.0,
+                        "copied_bytes": 10}]} for i in range(5)]
+    new = [{"query_id": f"n{i}", "canary": False, "overlay_hash": "new",
+            "stages": [{"fingerprint": "s1", "ms": 400.0,
+                        "copied_bytes": 10}]} for i in range(3)]
+    latest = {"query_id": "x", "canary": False, "overlay_hash": "new",
+              "stages": [{"fingerprint": "s1", "ms": 700.0,
+                          "copied_bytes": 10}]}
+    out = history.detect_regressions(old + new + [latest], pct=25.0)
+    assert out and out[0]["latest"] == 700.0 and out[0]["runs"] == 3
+    # against the mixed window it would NOT have flagged
+    legacy = [dict(r, overlay_hash=None) for r in old + new]
+    assert history.detect_regressions(
+        legacy + [dict(latest, overlay_hash=None)], pct=25.0) == []
+
+
+# ---------------------------------------------------------------------------
+# registries: gauges, events, triggers, blaze_top
+# ---------------------------------------------------------------------------
+
+
+def test_registries_declare_autopilot_names():
+    for kind in ("autopilot_apply", "autopilot_explore",
+                 "autopilot_promote", "autopilot_rollback"):
+        assert kind in trace.EVENT_KINDS
+    assert "autopilot_rollback" in flight_recorder.TRIGGERS
+    for g in ("blaze_autopilot_overlays_active",
+              "blaze_autopilot_promotions_total",
+              "blaze_autopilot_rollbacks_total"):
+        assert g in monitor.GAUGE_NAMES
+
+
+def test_gauges_and_blaze_top_row(tmp_path):
+    conf.autopilot_enabled = True
+    conf.autopilot_dir = str(tmp_path / "ap")
+    ap = autopilot.active()
+    ap.store.append("promote", FP, knob="prefetch_batches", value=3)
+    ap.store.append("rollback", FP, knob="target_batch_bytes",
+                    value=1 << 20, reason="regression", verdict={})
+    autopilot.reset()
+    text = monitor.prometheus_text()
+    assert "blaze_autopilot_overlays_active 1" in text
+    assert "blaze_autopilot_promotions_total 1" in text
+    assert ('blaze_autopilot_rollbacks_total'
+            '{knob="target_batch_bytes"} 1') in text
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import blaze_top
+
+    frame = blaze_top.render(blaze_top.parse_prometheus(text), "test")
+    row = [ln for ln in frame.splitlines()
+           if ln.startswith("autopilot")]
+    assert len(row) == 1
+    assert "overlays=1" in row[0] and "promotions=1" in row[0]
+    assert "rollbacks=1" in row[0] and "target_batch_bytes=1" in row[0]
+
+
+# ---------------------------------------------------------------------------
+# e2e: run_plan applies overlays and stamps provenance
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tables(tmp_path_factory):
+    from blaze_tpu.spark import validator
+
+    d = str(tmp_path_factory.mktemp("ap_tables"))
+    return validator.generate_tables(d, rows=600)
+
+
+def _run(tables, tmp_path, run_info):
+    from blaze_tpu.spark import validator
+    from blaze_tpu.spark.local_runner import run_plan
+
+    paths, frames = tables
+    plan, oracle = validator.QUERIES["q1_scan_filter_project"](
+        paths, frames, "bhj")
+    out = run_plan(plan, num_partitions=2,
+                   work_dir=str(tmp_path / "work"),
+                   mesh_exchange="off", run_info=run_info)
+    diff = validator._compare(
+        validator._to_pandas(out).reset_index(drop=True),
+        oracle().reset_index(drop=True))
+    assert diff is None, diff
+
+
+def test_run_plan_stamps_overlay_provenance_everywhere(tables, tmp_path):
+    conf.autopilot_enabled = True
+    conf.autopilot_dir = str(tmp_path / "ap")
+    conf.history_dir = str(tmp_path / "hist")
+    conf.trace_enabled = True
+    conf.trace_export_dir = str(tmp_path / "trace")
+    info = {"conf_pins": {"prefetch_batches": 2}}
+    _run(tables, tmp_path, info)
+    ap = info["autopilot"]
+    assert ap["fingerprint"]
+    assert ap["overlay"] == {"prefetch_batches": 2}
+    assert ap["provenance"] == {"prefetch_batches": "pin"}
+    assert ap["canary"] is False
+    # ledger line carries the same stamp
+    led = [json.loads(ln) for ln in
+           open(os.path.join(conf.trace_export_dir, "ledger.jsonl"))]
+    assert led[-1]["autopilot"]["provenance"] == {
+        "prefetch_batches": "pin"}
+    # history record carries the like-with-like keys
+    rec = history.store().records()[-1]
+    assert rec["autopilot_fp"] == ap["fingerprint"]
+    assert rec["canary"] is False
+    assert rec["overlay_hash"] == config.overlay_hash(
+        {"prefetch_batches": 2})
+
+
+def test_run_plan_applies_stored_fingerprint_overlay(tables, tmp_path):
+    conf.autopilot_enabled = True
+    conf.autopilot_dir = str(tmp_path / "ap")
+    conf.history_dir = str(tmp_path / "hist")
+    # first run discovers the fingerprint
+    info = {}
+    _run(tables, tmp_path, info)
+    fp = info["autopilot"]["fingerprint"]
+    # seed a settled overlay for it, as a prior process would have
+    autopilot.active().store.append("promote", fp,
+                                    knob="prefetch_batches", value=3)
+    autopilot.reset()
+    info2 = {}
+    _run(tables, tmp_path, info2)
+    assert info2["autopilot"]["overlay"] == {"prefetch_batches": 3}
+    assert info2["autopilot"]["provenance"] == {
+        "prefetch_batches": "fingerprint"}

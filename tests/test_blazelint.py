@@ -540,7 +540,8 @@ def test_cli_clean_mini_repo_exits_zero(tmp_path):
     assert report["ok"] is True
     assert set(report["per_checker"]) == {
         "lock-discipline", "knob-registry", "resource-pairing",
-        "hot-path-gating", "registry-sync", "pyflakes"}
+        "hot-path-gating", "registry-sync", "doctor-knob-sync",
+        "pyflakes"}
 
 
 SEEDS = {

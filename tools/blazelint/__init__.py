@@ -18,7 +18,8 @@ from tools.blazelint.core import (Checker, Finding, ModuleInfo,  # noqa: F401
 
 
 def default_checkers(root):
-    """The five production checkers + the pyflakes-equivalent pass."""
+    """The six production checkers + the pyflakes-equivalent pass."""
+    from tools.blazelint.doctor_knob_sync import DoctorKnobSync
     from tools.blazelint.hot_path_gating import HotPathGating
     from tools.blazelint.knob_registry import KnobRegistry
     from tools.blazelint.lock_discipline import LockDiscipline
@@ -32,5 +33,6 @@ def default_checkers(root):
         ResourcePairing(),
         HotPathGating(),
         RegistrySync(),
+        DoctorKnobSync(root=root),
         PyflakesLite(),
     ]
