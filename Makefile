@@ -9,7 +9,8 @@ PYENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
 .PHONY: check check-fast check-faults check-supervisor check-trace \
 	check-durability check-dist-obs check-network check-elastic \
-	check-streaming check-autopilot check-pipeline check-pipeline-soak \
+	check-streaming check-autopilot check-profile check-pipeline \
+	check-pipeline-soak \
 	check-perf \
 	check-perf-update check-obs check-history check-lint check-service \
 	check-doctor check-flight check-executors test test-fast validate \
@@ -18,7 +19,7 @@ PYENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 check: check-lint test validate check-perf check-history check-service \
 	check-doctor check-flight check-executors check-durability \
 	check-dist-obs check-network check-elastic check-streaming \
-	check-autopilot
+	check-autopilot check-profile
 	@echo "CHECK OK — safe to commit"
 
 # Static invariant gate (tools/blazelint): lock discipline, knob
@@ -242,6 +243,13 @@ check-streaming:
 check-autopilot:
 	$(PYENV) python tools/chaos_soak.py --autopilot \
 	  --json-out AUTOPILOT_r22.json
+
+# Continuous-profiling acceptance (ISSUE 19): seeded-stall attribution
+# in the collapsed-stack export, pooled SIGKILL sidecar recovery of
+# executor samples, and the profiler on/off overhead A/B (<2%).
+check-profile:
+	$(PYENV) python tools/chaos_soak.py --profile \
+	  --json-out PROFILE_r23.json
 
 # Pre-warm the persistent compile caches (runtime/compile_service):
 # replays the shape manifest + the TPC-DS catalogue into the XLA cache.

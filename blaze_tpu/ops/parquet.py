@@ -237,34 +237,43 @@ class ParquetSinkExec(Operator):
     def plan_key(self) -> tuple:
         return ("parquet_sink", self.path, self.children[0].plan_key())
 
-    def _task_path(self, ctx: ExecContext) -> str:
-        """Per-task part file (ref: Hive-compatible part files,
-        parquet_sink_exec.rs): a multi-task stage writing ONE path would
-        have every task truncate the previous tasks' rows. With one task
-        the path is used as-is unless it already IS a part directory.
+    def is_remote(self) -> bool:
+        from blaze_tpu.runtime import filesystem
 
-        Overwrite semantics: task 0 of a local multi-task write clears
-        stale part files (re-running into the same path must not leave a
-        previous run's higher-numbered parts behind). In deployment the
-        embedding layer's output-commit protocol owns this — the
+        return bool(self.fs_resource_id) or (
+            filesystem.path_scheme(self.path) is not None)
+
+    @staticmethod
+    def clear_stale_parts(path: str) -> None:
+        """Overwrite semantics for a local multi-task write: re-running
+        into the same path must not leave a previous run's
+        higher-numbered parts behind. This MUST run before any task of
+        the new run is dispatched (local_runner calls it driver-side) —
+        clearing from inside a task races task scheduling and can
+        delete parts the current run already committed. In deployment
+        the embedding layer's output-commit protocol owns this — the
         reference leans on Hive temp+move semantics the same way
         (NativeParquetInsertIntoHiveTableBase)."""
         import glob as _glob
         import os as _os
 
-        from blaze_tpu.runtime import filesystem
+        _os.makedirs(path, exist_ok=True)
+        for stale in _glob.glob(_os.path.join(path, "part-*.parquet")):
+            _os.remove(stale)
 
-        remote = bool(self.fs_resource_id) or (
-            filesystem.path_scheme(self.path) is not None)
+    def _task_path(self, ctx: ExecContext) -> str:
+        """Per-task part file (ref: Hive-compatible part files,
+        parquet_sink_exec.rs): a multi-task stage writing ONE path would
+        have every task truncate the previous tasks' rows. With one task
+        the path is used as-is unless it already IS a part directory."""
+        import os as _os
+
+        remote = self.is_remote()
         if ctx.num_partitions <= 1 and not (
                 not remote and _os.path.isdir(self.path)):
             return self.path
         if not remote:
             _os.makedirs(self.path, exist_ok=True)
-            if ctx.partition == 0:
-                for stale in _glob.glob(
-                        _os.path.join(self.path, "part-*.parquet")):
-                    _os.remove(stale)
         return _os.path.join(self.path,
                              f"part-{ctx.partition:05d}.parquet")
 

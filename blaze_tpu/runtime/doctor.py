@@ -331,6 +331,31 @@ def diagnose(record: dict,
              "serde_decode_ms": _r(_term_ms(cp, "serde_decode")),
              "bytes_copied_serde": counters.get("bytes_copied_serde", 0)}))
 
+    # host_cpu_bound: the host_compute term dominates AND the sampling
+    # profiler names the code — the term alone is a black box; the
+    # run record's "profile" block (runtime/profiler.py, attached by
+    # build_run_record while conf.profile_enabled) turns it into an
+    # actionable top-self-time-frames list
+    prof = record.get("profile") or {}
+    hot = prof.get("hot_frames") or []
+    host_ms = _term_ms(cp, "host_compute")
+    if hot and host_ms >= _MIN_TERM_MS and \
+            _share(cp, "host_compute") >= _MIN_TERM_SHARE:
+        top = hot[0]
+        findings.append(Finding(
+            "host_cpu_bound", _share(cp, "host_compute"),
+            f"host-side compute took {host_ms:.0f}ms "
+            f"({100 * _share(cp, 'host_compute'):.0f}% of wall time); "
+            f"top frame {top.get('frame')} "
+            f"({top.get('pct')}% of samples)",
+            "inspect the flamegraph (conf.profile_export_dir exports "
+            "collapsed stacks per query) and raise "
+            "conf.target_batch_bytes so per-batch host overhead "
+            "amortizes over more rows",
+            {"host_compute_ms": _r(host_ms),
+             "profiled_samples": prof.get("samples", 0),
+             "hot_frames": hot}))
+
     # skew / straggler: one task bounds a significant stage
     skew_ratio = max(float(conf.doctor_skew_ratio), 1.0)
     for ch in cp.get("chains") or []:

@@ -842,6 +842,16 @@ class ExecutorPool:
                                 truncated=truncated)
         monitor.merge_remote(msg.get("counters") or {})
         trace.ingest_histograms(msg.get("histograms") or {})
+        if conf.profile_enabled and (msg.get("profile")
+                                     or msg.get("profile_duty")):
+            from blaze_tpu.runtime import profiler
+
+            if msg.get("profile"):
+                profiler.merge_remote(msg["profile"],
+                                      exec_id=handle.exec_id,
+                                      recovered=truncated)
+            if msg.get("profile_duty"):
+                profiler.merge_duty(msg["profile_duty"])
         nbytes = int(msg.get("nbytes") or 0)
         with self._lock:
             handle.tel_records += len(records)
@@ -1634,6 +1644,9 @@ class _Worker:
         self._tel_pending: List[dict] = []
         self._tel_counters: Dict[str, dict] = {}
         self._tel_hists: Dict[str, dict] = {}
+        self._tel_profile: List[list] = []
+        self._tel_profile_last = 0.0  # last profiler drain (monotonic)
+        self._tel_duty_mark = (0.0, 0.0)  # duty (cost, wall) shipped so far
         self._sidecar = os.path.join(os.path.dirname(self.ctl_path),
                                      f"{self.token}.telemetry")
 
@@ -1779,9 +1792,10 @@ class _Worker:
         watermark stays exactly-once. ship=False spills WITHOUT
         sending (the self-fence path: the driver is unreachable, but
         the death dossier recovers the sidecar)."""
-        from blaze_tpu.runtime import monitor, trace
+        from blaze_tpu.runtime import monitor, profiler, trace
 
-        if not (conf.trace_enabled or conf.monitor_enabled):
+        if not (conf.trace_enabled or conf.monitor_enabled
+                or conf.profile_enabled):
             return
         with self._tel_lock:
             self._tel_pending.extend(trace.TRACE.drain())
@@ -1789,16 +1803,39 @@ class _Worker:
                                   monitor.drain_remote_deltas())
             _merge_hist_snaps(self._tel_hists,
                               trace.histograms_snapshot(reset=True))
+            if conf.profile_enabled:
+                # profiler rows have no before-the-span-closes ordering
+                # requirement (they merge by query id whenever), so only
+                # the timer-paced ships and the fence/exit flush drain
+                # them — NOT the flush that runs before every task
+                # result, which must stay a no-op when trace/monitor
+                # are off or profiling would tax each task with a
+                # spill+ship
+                now = time.monotonic()
+                period_s = max(int(conf.telemetry_ship_ms), 10) / 1000.0
+                if not ship or now - self._tel_profile_last >= period_s:
+                    self._tel_profile.extend(profiler.drain_remote())
+                    self._tel_profile_last = now
             if not (self._tel_pending or self._tel_counters
-                    or self._tel_hists):
+                    or self._tel_hists or self._tel_profile):
                 return
             seq = self._tel_seq + 1
             doc = {"type": "telemetry", "seq": seq,
                    "records": self._tel_pending,
                    "counters": self._tel_counters,
                    "histograms": self._tel_hists,
+                   "profile": self._tel_profile,
                    "dropped": trace.TRACE.dropped,
                    "mono_ns": time.monotonic_ns()}
+            if conf.profile_enabled:
+                # duty ledger rides the frame as a watermarked delta so
+                # the driver can prove the fleet-wide sampling overhead
+                cost, wall = profiler.duty_snapshot()
+                c0, w0 = self._tel_duty_mark
+                if cost > c0 or wall > w0:
+                    doc["profile_duty"] = {"cost_s": cost - c0,
+                                           "wall_s": wall - w0}
+                    self._tel_duty_mark = (cost, wall)
             payload = json.dumps(doc, default=str)
             doc["nbytes"] = len(payload)
             tmp = self._sidecar + ".tmp"
@@ -1818,6 +1855,7 @@ class _Worker:
             self._tel_pending = []
             self._tel_counters = {}
             self._tel_hists = {}
+            self._tel_profile = []
 
     def _ship_loop(self) -> None:
         period_ms = int(conf.telemetry_ship_ms)
@@ -2140,6 +2178,12 @@ def _worker_main() -> int:
         for name, value in json.loads(overrides).items():
             if name in KNOBS:
                 setattr(conf, name, value)
+    if conf.profile_enabled:
+        # the worker samples its own threads; folded-stack deltas ship
+        # driver-ward with _flush_telemetry (sidecar-recoverable)
+        from blaze_tpu.runtime import profiler
+
+        profiler.ensure_started()
     worker = _Worker()
     # SIGTERM is a decommission order, not a kill: drain in-flight work,
     # flush telemetry, hand shuffle rids back, then exit 0.

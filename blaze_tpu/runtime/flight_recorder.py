@@ -300,6 +300,17 @@ def _capture_locked_out(trigger, query_id, tenant_id, error, run_info,
         "thread_stacks": stacks_doc,
         "ledger": ledger,
     }
+    # continuous-profiler upgrade (runtime/profiler.py): the aggregated
+    # window the sampler collected around the incident — what the code
+    # was doing leading up to the hang/deadline, fleet-merged, instead
+    # of only the single thread_stacks instant above. Exactly-once per
+    # (query, trigger) rides the existing _captured dedup.
+    if conf.profile_enabled:
+        from blaze_tpu.runtime import profiler
+
+        doc["profile_window"] = profiler.window(query_id)
+    else:
+        doc["profile_window"] = None
     try:
         from blaze_tpu.runtime import executor_pool
 

@@ -206,6 +206,10 @@ def begin_query(qid: str, manager=None) -> None:
     global _active_qid
     if conf.metrics_port:
         ensure_started()
+    if conf.profile_enabled:
+        from blaze_tpu.runtime import profiler
+
+        profiler.ensure_started()
     if not conf.monitor_enabled:
         return
     acct = _QueryAcct(qid)
@@ -601,6 +605,13 @@ GAUGE_NAMES = (
     "blaze_stream_lag_ms",
     "blaze_stream_batches_total",
     "blaze_stream_checkpoint_bytes",
+    "blaze_profile_samples_total",
+    "blaze_profile_remote_samples_total",
+    "blaze_profile_recovered_samples_total",
+    "blaze_profile_stacks",
+    "blaze_profile_dropped_total",
+    "blaze_profile_duty_pct",
+    "blaze_profile_fleet_duty_pct",
 )
 GAUGE_PREFIXES = (
     "blaze_pipeline_",  # pipeline.TELEMETRY counters
@@ -906,6 +917,36 @@ def prometheus_text() -> str:
     emit("blaze_endpoint_requests_total", "counter",
          "Debug-endpoint requests served, by route",
          [({"route": r}, n) for r, n in sorted(reqs.items())])
+
+    # continuous sampling profiler (runtime/profiler.py): fleet-merged
+    # folded-stack table posture — local + federated executor samples
+    from blaze_tpu.runtime import profiler
+
+    ps = profiler.stats()
+    emit("blaze_profile_samples_total", "counter",
+         "Thread-samples folded locally by this process's sampler",
+         [({}, ps["samples"])])
+    emit("blaze_profile_remote_samples_total", "counter",
+         "Executor samples federated driver-ward on telemetry frames",
+         [({}, ps["remote_samples"])])
+    emit("blaze_profile_recovered_samples_total", "counter",
+         "Remote samples replayed from a dead worker's sidecar spill",
+         [({}, ps["recovered_samples"])])
+    emit("blaze_profile_stacks", "gauge",
+         "Distinct (attribution, folded-stack) entries in the bounded "
+         "aggregate table",
+         [({}, ps["stacks"])])
+    emit("blaze_profile_dropped_total", "counter",
+         "Samples dropped with the table at capacity",
+         [({}, ps["dropped"])])
+    emit("blaze_profile_duty_pct", "gauge",
+         "Sampler overhead: cpu seconds inside sampling passes per "
+         "wall second alive, this process",
+         [({}, ps["duty_pct"])])
+    emit("blaze_profile_fleet_duty_pct", "gauge",
+         "Sampler overhead summed across this driver and every "
+         "executor's shipped duty ledger",
+         [({}, ps["fleet_duty_pct"])])
 
     for prefix, help_text, ms in (
             ("blaze_pipeline", "pipeline telemetry", pipeline.TELEMETRY),

@@ -1,0 +1,531 @@
+"""Always-on wall-clock sampling profiler with fleet-wide attribution.
+
+The observability ladder (trace spans -> monitor counters -> doctor ->
+flight dossiers) says *which stage* was slow but never *which code*.
+This module closes that gap with a sampling profiler cheap enough to
+leave on in production:
+
+  * A single daemon thread wakes every ``conf.profile_sample_ms``,
+    snapshots ``sys._current_frames()`` and folds each thread's stack
+    (root->leaf, ``module.function`` frames, depth-bounded by
+    ``conf.profile_max_frames``) into a bounded aggregated table — the
+    flattened form of a folded-stack trie keyed by
+    ``(query_id, tenant_id, stage_id, task_id, exec, stack)``.
+  * Attribution rides the existing thread-local trace context: a
+    ``threading.local`` stack is invisible to other threads, so
+    ``trace.context()`` mirrors the merged correlation ids into
+    ``trace._live_ctx`` (thread ident -> ids) while profiling is on,
+    and the sampler joins that map against the frame snapshot. The
+    pipeline pumps, the supervisor's pool threads and the executor-pool
+    workers all already replay the driver's context, so their samples
+    attribute for free.
+  * Pooled executor processes run the same sampler; their workers drain
+    folded-stack deltas (``drain_remote`` — counts move, accumulators
+    stay, the monitor-counter federation model) onto the existing BCS
+    telemetry frames, which are sidecar-spilled before every ship.  The
+    driver merges them back (``merge_remote``) stamped with the
+    executor id, so one table covers the whole fleet and a SIGKILLed
+    worker's last batch still lands via sidecar recovery.
+
+Everything is gated on ONE ``conf.profile_enabled`` truthiness check
+(the blazelint hot-path-gating posture): disabled means no sampler
+thread, no context mirroring, and every integration hook returns after
+a single attribute read.
+
+Exports: ``collapsed()`` (flamegraph.pl collapsed-stack text),
+``speedscope()`` (speedscope.app JSON), per-query files via
+``export_query`` into ``conf.profile_export_dir`` (render/convert with
+``tools/blaze_prof.py``), a hot-frames block in ``explain_analyze``,
+``window()`` embeds for hang/deadline flight dossiers, and
+``profile_summary()`` attached to run records as evidence for the
+doctor's ``host_cpu_bound`` finding.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from blaze_tpu.config import conf
+
+# table key: (query_id, tenant_id, stage_id, task_id, exec, stack).
+# exec is "" for samples taken in this process and the executor token
+# for federated rows (stamped driver-side at merge).
+_Key = Tuple[str, str, str, str, str, str]
+
+_lock = threading.Lock()
+_table: Dict[_Key, int] = {}
+_qmeta: Dict[str, List[float]] = {}  # qid -> [first_wall, last_wall, n]
+_samples = 0            # thread-samples folded locally (accumulator)
+_remote_samples = 0     # samples merged from executor telemetry frames
+_recovered_samples = 0  # subset of remote that arrived via sidecar recovery
+_dropped = 0            # samples folded into nothing: table at capacity
+_duty_cost_s = 0.0      # seconds spent inside sampling passes + drains
+_duty_wall_s = 0.0      # wall seconds the sampler loop has been alive
+_remote_duty_cost_s = 0.0  # federated: sum of executor duty deltas
+_remote_duty_wall_s = 0.0
+
+_thread: Optional[threading.Thread] = None
+_stop = threading.Event()
+_start_lock = threading.Lock()
+
+# capacity bounds — the table is an aggregate (one entry per distinct
+# folded stack per attribution), so these are generous: a steady-state
+# engine run folds into a few hundred entries
+_MAX_ENTRIES = 8192
+_MAX_QUERIES = 64          # per-query window metadata (FIFO eviction)
+_EMPTY: Dict[str, Any] = {}
+
+
+# -- sampling ---------------------------------------------------------------
+
+# fold caches: the sampler runs at up to ~100Hz over every thread in
+# the process, so per-frame string work (basename/splitext/format) must
+# never repeat. Code objects are interned per function for the life of
+# the process; an idle thread's whole stack hashes to the same code
+# tuple every tick, so the common case is one dict hit per thread.
+_fold_lock = threading.Lock()       # guards the two fold caches only
+_name_cache: Dict[Any, str] = {}    # code object -> "mod.func"
+_fold_cache: Dict[Any, str] = {}    # (code, code, ...) -> folded stack
+_FOLD_CACHE_MAX = 32768
+
+
+def _fold(frame, max_frames: int) -> str:
+    """One thread's stack as ``mod.func;mod.func;...`` root->leaf."""
+    codes = []
+    f = frame
+    while f is not None and len(codes) < max_frames:
+        codes.append(f.f_code)
+        f = f.f_back
+    key = tuple(codes)
+    with _fold_lock:
+        cached = _fold_cache.get(key)
+    if cached is not None:
+        return cached
+    parts: List[str] = []
+    for co in codes:
+        with _fold_lock:
+            name = _name_cache.get(co)
+        if name is None:
+            mod = os.path.splitext(os.path.basename(co.co_filename))[0]
+            name = f"{mod}.{co.co_name}"
+            with _fold_lock:
+                _name_cache[co] = name
+        parts.append(name)
+    parts.reverse()
+    out = ";".join(parts)
+    with _fold_lock:
+        if len(_fold_cache) < _FOLD_CACHE_MAX:
+            _fold_cache[key] = out
+    return out
+
+
+def _bump_locked(key: _Key, n: int, now: float) -> None:
+    global _dropped
+    if key in _table:
+        _table[key] += n
+    elif len(_table) < _MAX_ENTRIES:
+        _table[key] = n
+    else:
+        _dropped += n
+        return
+    qid = key[0]
+    if qid:
+        meta = _qmeta.get(qid)
+        if meta is None:
+            if len(_qmeta) >= _MAX_QUERIES:
+                _qmeta.pop(next(iter(_qmeta)))
+            _qmeta[qid] = [now, now, n]
+        else:
+            meta[1] = now
+            meta[2] += n
+
+
+def sample_once(frames: Optional[Dict[int, Any]] = None) -> int:
+    """One sampling pass: fold every live thread's stack into the
+    table, attributed through ``trace._live_ctx``. Returns the number
+    of thread-samples folded. ``frames`` is injectable for tests."""
+    global _samples
+    from blaze_tpu.runtime import trace
+
+    me = threading.get_ident()
+    with _start_lock:
+        t = _thread
+    sampler = t.ident if t is not None else None
+    if frames is None:
+        frames = sys._current_frames()
+    now = time.time()
+    max_frames = max(int(conf.profile_max_frames), 1)
+    live = trace._live_ctx
+    # prune idents whose thread died while holding a context (the pop
+    # side of trace.context() only runs while profiling is on, so a
+    # mid-flight toggle can strand an entry)
+    for ident in list(live):
+        if ident not in frames:
+            live.pop(ident, None)
+    folded: List[_Key] = []
+    for ident, frame in frames.items():
+        if ident == me or ident == sampler:
+            continue  # never profile the profiler
+        stack = _fold(frame, max_frames)
+        if not stack:
+            continue
+        ids = live.get(ident) or _EMPTY
+        # str() via None-check, not truthiness: stage 0 is a real stage
+        folded.append(tuple(
+            "" if v is None else str(v)
+            for v in (ids.get("query_id"), ids.get("tenant_id"),
+                      ids.get("stage_id"), ids.get("task_id")))
+            + ("", stack))
+    with _lock:
+        for key in folded:
+            _bump_locked(key, 1, now)
+        _samples += len(folded)
+    return len(folded)
+
+
+def _loop(stop_evt: threading.Event) -> None:
+    global _duty_cost_s, _duty_wall_s
+    last = time.perf_counter()
+    while not stop_evt.is_set():
+        cost = 0.0
+        if conf.profile_enabled:
+            t0 = time.perf_counter()
+            try:
+                sample_once()
+            except Exception:  # noqa: BLE001 — the sampler must never die
+                pass
+            cost = time.perf_counter() - t0
+        # overhead governor: the interval knob is a floor, not a
+        # promise — a pass over an unusually wide/deep thread set
+        # stretches the next sleep so sampling itself stays around a
+        # 1% duty cycle (the always-on contract) no matter the process
+        stop_evt.wait(max(max(int(conf.profile_sample_ms), 1) / 1000.0,
+                          cost * 100.0))
+        now = time.perf_counter()
+        with _lock:
+            # duty ledger: cost/wall is the profiler's own overhead
+            # figure, the one number the <2% always-on contract is
+            # gated on (wall-clock A/B on a busy host can't resolve
+            # 2%). Booked per full cycle — a pass and the sleep that
+            # amortizes it land together, so the ratio is meaningful
+            # from the first observable update
+            _duty_cost_s += cost
+            _duty_wall_s += now - last
+        last = now
+
+
+def ensure_started() -> Optional[threading.Thread]:
+    """Start the sampler daemon (idempotent). The one gate: disabled
+    profiling returns after a single truthiness check."""
+    global _thread
+    if not conf.profile_enabled:
+        return None
+    with _start_lock:
+        if _thread is None or not _thread.is_alive():
+            _stop.clear()
+            _thread = threading.Thread(
+                target=_loop, args=(_stop,), name="blaze-profiler",
+                daemon=True)
+            _thread.start()
+        return _thread
+
+
+def running() -> bool:
+    with _start_lock:
+        t = _thread
+    return t is not None and t.is_alive()
+
+
+def stop() -> None:
+    """Stop the sampler thread (tests / clean teardown)."""
+    global _thread
+    with _start_lock:
+        t = _thread
+        _thread = None
+        if t is None:
+            return
+        _stop.set()
+    t.join(timeout=2.0)
+
+
+def reset() -> None:
+    """Clear the table and counters (tests / chaos rounds)."""
+    global _samples, _remote_samples, _recovered_samples, _dropped
+    global _duty_cost_s, _duty_wall_s
+    global _remote_duty_cost_s, _remote_duty_wall_s
+    with _lock:
+        _table.clear()
+        _qmeta.clear()
+        _samples = 0
+        _remote_samples = 0
+        _recovered_samples = 0
+        _dropped = 0
+        _duty_cost_s = 0.0
+        _duty_wall_s = 0.0
+        _remote_duty_cost_s = 0.0
+        _remote_duty_wall_s = 0.0
+    with _fold_lock:
+        _fold_cache.clear()
+        _name_cache.clear()
+
+
+# -- federation (the monitor-counter delta model) ---------------------------
+
+def drain_remote() -> List[list]:
+    """Executor side: pop the folded-stack table as delta rows
+    ``[qid, tenant, stage, task, stack, count]`` for the telemetry
+    frame. Counts move, accumulators stay — a row handed out here is
+    either shipped (possibly recovered from the sidecar spill) or lost
+    with the frame, exactly like remote monitor counters."""
+    global _duty_cost_s
+    t0 = time.perf_counter()
+    with _lock:
+        rows = [[k[0], k[1], k[2], k[3], k[5], n]
+                for k, n in _table.items()]
+        _table.clear()
+        _qmeta.clear()
+        _duty_cost_s += time.perf_counter() - t0
+    return rows
+
+
+def merge_remote(rows: Sequence[Sequence], exec_id: str = "",
+                 recovered: bool = False) -> int:
+    """Driver side: fold executor delta rows into the fleet table,
+    stamped with the executor id. ``recovered`` marks rows replayed
+    from a dead worker's sidecar spill."""
+    global _remote_samples, _recovered_samples
+    if not rows:
+        return 0
+    from blaze_tpu.runtime import trace
+
+    now = time.time()
+    total = 0
+    ex = str(exec_id or "")
+    with _lock:
+        for r in rows:
+            try:
+                qid, tenant, stage, task, stack = (
+                    str(r[0]), str(r[1]), str(r[2]), str(r[3]), str(r[4]))
+                n = int(r[5])
+            except Exception:  # noqa: BLE001 — a torn row never poisons
+                continue       # the rest of the frame
+            if n <= 0 or not stack:
+                continue
+            _bump_locked((qid, tenant, stage, task, ex, stack), n, now)
+            total += n
+        _remote_samples += total
+        if recovered:
+            _recovered_samples += total
+    trace.event("profile_merge", exec=ex, rows=len(rows),
+                samples=total, recovered=bool(recovered))
+    return total
+
+
+def duty_snapshot() -> Tuple[float, float]:
+    """Executor ship path: cumulative (cost_s, wall_s) of this
+    process's sampler. The worker ships watermarked deltas so the
+    driver can sum them without double counting."""
+    with _lock:
+        return _duty_cost_s, _duty_wall_s
+
+
+def merge_duty(d: Any) -> None:
+    """Driver side: fold one executor's duty delta into the fleet
+    ledger. Torn payloads are dropped, never raised."""
+    global _remote_duty_cost_s, _remote_duty_wall_s
+    try:
+        cost = float(d.get("cost_s", 0.0))
+        wall = float(d.get("wall_s", 0.0))
+    except Exception:  # noqa: BLE001 — a torn frame never poisons ingest
+        return
+    if cost <= 0.0 and wall <= 0.0:
+        return
+    with _lock:
+        _remote_duty_cost_s += max(cost, 0.0)
+        _remote_duty_wall_s += max(wall, 0.0)
+
+
+def stats() -> Dict[str, Any]:
+    """Cheap counter snapshot for the monitor gauges / blaze_top."""
+    with _lock:
+        duty = (100.0 * _duty_cost_s / _duty_wall_s
+                if _duty_wall_s > 0 else 0.0)
+        fleet_cost = _duty_cost_s + _remote_duty_cost_s
+        fleet_wall = _duty_wall_s + _remote_duty_wall_s
+        fleet = 100.0 * fleet_cost / fleet_wall if fleet_wall > 0 else 0.0
+        return {"samples": _samples,
+                "remote_samples": _remote_samples,
+                "recovered_samples": _recovered_samples,
+                "dropped": _dropped,
+                "stacks": len(_table),
+                "duty_pct": round(duty, 3),
+                "duty_cost_s": round(_duty_cost_s, 6),
+                "duty_wall_s": round(_duty_wall_s, 3),
+                "fleet_duty_pct": round(fleet, 3),
+                "running": running()}
+
+
+# -- views ------------------------------------------------------------------
+
+def rows(query_id: Optional[str] = None) -> List[list]:
+    """Table snapshot as ``[qid, tenant, stage, task, exec, stack,
+    count]`` rows, optionally filtered to one query."""
+    with _lock:
+        items = sorted(_table.items())
+    out = []
+    for (qid, tenant, stage, task, ex, stack), n in items:
+        if query_id is not None and qid != query_id:
+            continue
+        out.append([qid, tenant, stage, task, ex, stack, n])
+    return out
+
+
+def collapsed(query_id: Optional[str] = None) -> List[str]:
+    """flamegraph.pl-compatible collapsed-stack lines. Attribution is
+    encoded as synthetic root frames (``query:<id>;stage:<id>;...``) so
+    a flamegraph groups by query then stage then executor."""
+    lines = []
+    for qid, tenant, stage, task, ex, stack, n in rows(query_id):
+        prefix = [f"query:{qid or '-'}"]
+        if stage:
+            prefix.append(f"stage:{stage}")
+        if ex:
+            prefix.append(f"exec:{ex}")
+        lines.append(";".join(prefix + [stack]) + f" {n}")
+    return lines
+
+
+def stacks_to_speedscope(pairs: Sequence[Tuple[str, int]],
+                         name: str = "blaze profile") -> Dict[str, Any]:
+    """Pure converter: ``(folded_stack, count)`` pairs -> a speedscope
+    'sampled' profile document (also used by tools/blaze_prof.py)."""
+    frame_ix: Dict[str, int] = {}
+    frames: List[Dict[str, str]] = []
+    samples: List[List[int]] = []
+    weights: List[int] = []
+    total = 0
+    for stack, n in pairs:
+        ixs = []
+        for f in stack.split(";"):
+            ix = frame_ix.get(f)
+            if ix is None:
+                ix = frame_ix[f] = len(frames)
+                frames.append({"name": f})
+            ixs.append(ix)
+        samples.append(ixs)
+        weights.append(int(n))
+        total += int(n)
+    return {
+        "$schema": "https://www.speedscope.app/file-format-schema.json",
+        "name": name,
+        "exporter": "blaze_prof",
+        "shared": {"frames": frames},
+        "profiles": [{"type": "sampled", "name": name, "unit": "none",
+                      "startValue": 0, "endValue": total,
+                      "samples": samples, "weights": weights}],
+    }
+
+
+def speedscope(query_id: Optional[str] = None) -> Dict[str, Any]:
+    pairs = []
+    for qid, tenant, stage, task, ex, stack, n in rows(query_id):
+        prefix = [f"query:{qid or '-'}"]
+        if stage:
+            prefix.append(f"stage:{stage}")
+        if ex:
+            prefix.append(f"exec:{ex}")
+        pairs.append((";".join(prefix + [stack]), n))
+    name = f"blaze profile {query_id}" if query_id else "blaze profile"
+    return stacks_to_speedscope(pairs, name=name)
+
+
+def hot_frames(query_id: Optional[str] = None,
+               top: int = 8) -> List[Dict[str, Any]]:
+    """Leaf self-time ranking: the frame actually on-stack-top when the
+    sample fired, aggregated across attributions. The doctor's
+    host_cpu_bound evidence and explain_analyze's hot-frames block."""
+    agg: Dict[str, int] = {}
+    total = 0
+    for _qid, _tenant, _stage, _task, _ex, stack, n in rows(query_id):
+        leaf = stack.rsplit(";", 1)[-1]
+        agg[leaf] = agg.get(leaf, 0) + n
+        total += n
+    if not total:
+        return []
+    ranked = sorted(agg.items(), key=lambda kv: (-kv[1], kv[0]))[:top]
+    return [{"frame": f, "samples": n,
+             "pct": round(100.0 * n / total, 1)} for f, n in ranked]
+
+
+def window(query_id: str,
+           max_stacks: int = 64) -> Optional[Dict[str, Any]]:
+    """The profiled window around an incident, for flight dossiers: the
+    query's aggregated folded stacks plus sampling metadata — the
+    continuous upgrade of the dossier's single-instant thread_stacks."""
+    qrows = rows(query_id)
+    if not qrows:
+        return None
+    with _lock:
+        meta = list(_qmeta.get(query_id) or ())
+    qrows.sort(key=lambda r: (-r[6], r[5]))
+    stacks = [{"stage_id": r[2], "task_id": r[3], "exec": r[4],
+               "stack": r[5], "samples": r[6]} for r in qrows[:max_stacks]]
+    return {"query_id": query_id,
+            "samples": sum(r[6] for r in qrows),
+            "first_wall": meta[0] if meta else None,
+            "last_wall": meta[1] if meta else None,
+            "sample_ms": int(conf.profile_sample_ms),
+            "stacks": stacks,
+            "hot_frames": hot_frames(query_id, top=5)}
+
+
+def profile_summary(query_id: str) -> Optional[Dict[str, Any]]:
+    """Compact per-query evidence attached to run records (feeds the
+    doctor's host_cpu_bound rule through the pure diagnose() path)."""
+    hot = hot_frames(query_id, top=5)
+    if not hot:
+        return None
+    with _lock:
+        meta = list(_qmeta.get(query_id) or ())
+    return {"samples": int(meta[2]) if meta else
+            sum(h["samples"] for h in hot),
+            "sample_ms": int(conf.profile_sample_ms),
+            "hot_frames": hot}
+
+
+# -- export -----------------------------------------------------------------
+
+def export_query(query_id: str) -> Optional[Dict[str, str]]:
+    """Write the query's profile as collapsed-stack text plus
+    speedscope JSON into ``conf.profile_export_dir`` (crash-atomic,
+    first-commit-wins like every other artifact)."""
+    out_dir = conf.profile_export_dir
+    if not out_dir:
+        return None
+    lines = collapsed(query_id)
+    if not lines:
+        return None
+    from blaze_tpu.runtime import artifacts, trace
+
+    os.makedirs(out_dir, exist_ok=True)
+    text = "\n".join(lines) + "\n"
+    folded_path = os.path.join(out_dir, f"profile_{query_id}.collapsed")
+    scope_path = os.path.join(out_dir,
+                              f"profile_{query_id}.speedscope.json")
+    doc = json.dumps(speedscope(query_id))
+
+    def _write(payload):
+        def fn(tmp):
+            with open(tmp, "w", encoding="utf-8") as f:
+                f.write(payload)
+        return fn
+
+    artifacts.commit_file(_write(text), folded_path, fsync=False)
+    artifacts.commit_file(_write(doc), scope_path, fsync=False)
+    trace.event("profile_export", query_id=query_id, stacks=len(lines))
+    return {"collapsed": folded_path, "speedscope": scope_path}

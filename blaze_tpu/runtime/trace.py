@@ -92,15 +92,32 @@ def current_context() -> Dict[str, Any]:
     return merged
 
 
+# thread ident -> merged correlation ids, mirrored by context() while
+# conf.profile_enabled: the sampling profiler's daemon thread cannot
+# read another thread's threading.local stack, so the push/pop sites
+# publish the merged ids here for it to join against
+# sys._current_frames(). Empty (and never written) while profiling is
+# off — the mirror costs one truthiness check per push/pop.
+_live_ctx: Dict[int, Dict[str, Any]] = {}
+
+
 @contextlib.contextmanager
 def context(**ids):
     """Push correlation ids for records opened inside the block."""
     stack = _ctx_stack()
     stack.append({k: v for k, v in ids.items() if v is not None})
+    if conf.profile_enabled:
+        _live_ctx[threading.get_ident()] = current_context()
     try:
         yield
     finally:
         stack.pop()
+        if conf.profile_enabled:
+            ident = threading.get_ident()
+            if stack:
+                _live_ctx[ident] = current_context()
+            else:
+                _live_ctx.pop(ident, None)
 
 
 class TraceLog:
@@ -222,6 +239,10 @@ EVENT_KINDS = (
     "partition_suspected",  # executor_pool: control conn broken but the
                             # process looks alive — reconnect window open
     "pipeline_stats",       # pipeline: per-stream close statistics
+    "profile_export",       # profiler: per-query collapsed-stack +
+                            # speedscope files committed
+    "profile_merge",        # profiler: executor folded-stack deltas
+                            # federated into the driver table
     "progress_snapshot",    # monitor endpoints: live progress scraped
     "queue_depth",          # pipeline: sampler queue-depth reading
     "resource_leak",        # monitor: leaked reservation/stream detected
@@ -821,6 +842,20 @@ def explain_analyze(root, run_info: Optional[dict] = None,
         for name in sorted(hists):
             lines.append("  " + histogram(name).summary())
 
+    # continuous-profiler section: top self-time frames for the (last)
+    # query span in scope — the "which code, not just which stage"
+    # answer, fleet-merged (executor samples federate driver-ward)
+    if conf.profile_enabled:
+        from blaze_tpu.runtime import profiler
+
+        hot = profiler.hot_frames(
+            qspans[-1].get("query_id") if qspans else None, top=5)
+        if hot:
+            lines.append("-- hot frames --")
+            for h in hot:
+                lines.append(f"  {h['frame']:<48} {h['samples']:>6} "
+                             f"samples  {h['pct']:>5.1f}%")
+
     from blaze_tpu.runtime import compile_service, faults
 
     for summary in (compile_service.telemetry_summary(),
@@ -909,6 +944,15 @@ def build_run_record(query_id: str, run_info: Optional[dict] = None,
     # 3am "why did my query's conf change" answer, in the ledger line
     if isinstance(info.get("autopilot"), dict):
         rec["autopilot"] = dict(info["autopilot"])
+    # sampling-profiler evidence (runtime/profiler.py): top self-time
+    # frames so doctor's host_cpu_bound rule ranks offline, from the
+    # record alone (diagnose() stays a pure function of its inputs)
+    if conf.profile_enabled:
+        from blaze_tpu.runtime import profiler
+
+        prof = profiler.profile_summary(query_id)
+        if prof:
+            rec["profile"] = prof
     if conf.doctor_enabled:
         from blaze_tpu.runtime import doctor
 

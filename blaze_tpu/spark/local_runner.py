@@ -148,6 +148,12 @@ def run_plan(root: SparkPlan, num_partitions: int = 4,
         # most want to read
         if conf.trace_enabled and conf.trace_export_dir:
             trace.export_query(qid, run_info)
+        # per-query continuous-profiling artifacts (collapsed stacks +
+        # speedscope), fleet-merged — same export-even-on-failure rule
+        if conf.profile_enabled and conf.profile_export_dir:
+            from blaze_tpu.runtime import profiler
+
+            profiler.export_query(qid)
         # persist the run's fingerprinted statistics (after the monitor
         # roll-up so the record carries the byte/spill/compile counters)
         rec = (history.record_run(qid, run_info)
@@ -355,9 +361,11 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
                                              fallback=num_partitions)))
             if stage.kind == "shuffle_map":
                 shuffle_parts[stage.stage_id] = stage.num_partitions
-                with trace.span("stage", stage_id=stage.stage_id,
-                                stage_kind="shuffle_map", fingerprint=fp,
-                                tasks=_input_tasks(stage, stages)) as sp:
+                with trace.context(stage_id=stage.stage_id), \
+                        trace.span("stage", stage_id=stage.stage_id,
+                                   stage_kind="shuffle_map",
+                                   fingerprint=fp,
+                                   tasks=_input_tasks(stage, stages)) as sp:
                     if jnl is not None and fp:
                         # a crashed driver's verified stage commit for
                         # this fingerprint? reuse it — zero map tasks run
@@ -456,9 +464,10 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
                 if progress is not None:
                     progress.stage_end(qid, stage.stage_id)
             elif stage.kind == "broadcast":
-                with trace.span("stage", stage_id=stage.stage_id,
-                                stage_kind="broadcast", fingerprint=fp,
-                                tasks=1) as sp:
+                with trace.context(stage_id=stage.stage_id), \
+                        trace.span("stage", stage_id=stage.stage_id,
+                                   stage_kind="broadcast",
+                                   fingerprint=fp, tasks=1) as sp:
                     frames = _run_broadcast_stage(stage, stages, sup,
                                                   run_info, ns=ns)
                     if pool is not None:
@@ -474,9 +483,10 @@ def _run_plan_inner(root: SparkPlan, num_partitions: int,
                     progress.stage_end(qid, stage.stage_id)
             else:
                 parts = _input_tasks(stage, stages, fallback=num_partitions)
-                with trace.span("stage", stage_id=stage.stage_id,
-                                stage_kind="result", fingerprint=fp,
-                                tasks=parts) as sp:
+                with trace.context(stage_id=stage.stage_id), \
+                        trace.span("stage", stage_id=stage.stage_id,
+                                   stage_kind="result",
+                                   fingerprint=fp, tasks=parts) as sp:
                     out = _run_result_stage(stage, parts, sup, run_info)
                     sp.set(**monitor.stage_span_attrs(
                         run_info["query_id"], stage.stage_id))
@@ -1039,6 +1049,14 @@ def _run_result_stage(stage: Stage, parts: int, sup: Supervisor,
     split = (_root_sort_split(op)
              if host_sort.host_supported(op.schema) else None)
     strip = split[2] if split else 0
+
+    from blaze_tpu.ops.parquet import ParquetSinkExec
+    if (isinstance(op, ParquetSinkExec) and not op.is_remote()
+            and (parts > 1 or os.path.isdir(op.path))):
+        # stale-part overwrite semantics are a driver-side, before-any-
+        # dispatch step: clearing from task 0 raced task scheduling and
+        # could delete parts the current run had already written
+        ParquetSinkExec.clear_stale_parts(op.path)
 
     op_kinds = stage.op_kinds()
     specs: List[TaskSpec] = []

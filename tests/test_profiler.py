@@ -1,0 +1,486 @@
+"""Continuous profiler (runtime/profiler.py): sampler lifecycle and the
+disabled path costing one truthiness check, query/stage attribution via
+the trace._live_ctx mirror (pipeline and pool threads replay context),
+bounded folded-stack table, collapsed/speedscope export validity,
+executor federation (drain/merge delta model + sidecar-recovered
+accounting), doctor host_cpu_bound evidence, flight-dossier window
+embeds, and registry conformance (EVENT_KINDS / blaze_profile_*
+gauges)."""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+from blaze_tpu.config import conf
+from blaze_tpu.runtime import doctor, flight_recorder, monitor, \
+    profiler, trace
+
+
+@pytest.fixture(autouse=True)
+def _clean_profiler_conf():
+    saved = {k: getattr(conf, k) for k in (
+        "profile_enabled", "profile_sample_ms", "profile_max_frames",
+        "profile_export_dir", "trace_enabled", "monitor_enabled",
+        "flight_dir", "flight_triggers", "doctor_enabled",
+        "history_dir")}
+    profiler.stop()
+    profiler.reset()
+    trace.reset()
+    trace._live_ctx.clear()
+    monitor.reset()
+    flight_recorder.reset()
+    yield
+    for k, v in saved.items():
+        setattr(conf, k, v)
+    profiler.stop()
+    profiler.reset()
+    trace._live_ctx.clear()
+    flight_recorder.reset()
+    trace.reset()
+    monitor.reset()
+
+
+def _merge(rows, **kw):
+    """merge_remote without requiring trace to be on (it emits a
+    profile_merge event, a no-op while trace is disabled)."""
+    return profiler.merge_remote(rows, **kw)
+
+
+# ---------------------------------------------------------------------------
+# lifecycle + the disabled path
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_no_sampler_thread():
+    conf.update(profile_enabled=False)
+    assert profiler.ensure_started() is None
+    assert not profiler.running()
+    assert profiler.stats()["running"] is False
+
+
+def test_disabled_context_never_mirrors():
+    conf.update(profile_enabled=False)
+    with trace.context(query_id="q1", stage_id="s1"):
+        assert trace._live_ctx == {}
+    assert trace._live_ctx == {}
+
+
+def test_enabled_context_mirrors_and_unmirrors():
+    conf.update(profile_enabled=True)
+    me = threading.get_ident()
+    with trace.context(query_id="q1", tenant_id="tA"):
+        assert trace._live_ctx[me]["query_id"] == "q1"
+        with trace.context(stage_id="s2"):
+            ids = trace._live_ctx[me]
+            assert ids["query_id"] == "q1"
+            assert ids["stage_id"] == "s2"
+    assert me not in trace._live_ctx
+
+
+def test_ensure_started_idempotent_and_stop():
+    conf.update(profile_enabled=True, profile_sample_ms=5)
+    t1 = profiler.ensure_started()
+    t2 = profiler.ensure_started()
+    assert t1 is t2 and t1.is_alive()
+    assert profiler.running()
+    profiler.stop()
+    assert not profiler.running()
+
+
+def test_monitor_begin_query_starts_sampler():
+    conf.update(profile_enabled=True, profile_sample_ms=5,
+                monitor_enabled=False)
+    monitor.begin_query("qM")
+    try:
+        assert profiler.running()
+    finally:
+        monitor.finish_query("qM", {})
+
+
+# ---------------------------------------------------------------------------
+# sampling + attribution
+# ---------------------------------------------------------------------------
+
+
+def test_sample_once_injectable_frames_unattributed():
+    conf.update(profile_enabled=True)
+    n = profiler.sample_once(frames={999_999_001: sys._getframe()})
+    assert n == 1
+    (row,) = profiler.rows()
+    assert row[0] == ""                       # no context: qid empty
+    assert "test_profiler." in row[5]         # mod.func frames
+    assert row[6] == 1
+
+
+def test_sample_once_attributes_via_live_ctx():
+    conf.update(profile_enabled=True)
+    ready, release = threading.Event(), threading.Event()
+
+    def busy_hotspot():
+        with trace.context(query_id="qA", tenant_id="tZ",
+                           stage_id="s3", task_id="s3-t7"):
+            ready.set()
+            release.wait(5.0)
+
+    t = threading.Thread(target=busy_hotspot, daemon=True)
+    t.start()
+    assert ready.wait(5.0)
+    try:
+        frames = {k: v for k, v in sys._current_frames().items()
+                  if k == t.ident}
+        assert profiler.sample_once(frames=frames) == 1
+    finally:
+        release.set()
+        t.join(5.0)
+    (row,) = profiler.rows("qA")
+    assert row[:5] == ["qA", "tZ", "s3", "s3-t7", ""]
+    assert "test_profiler.busy_hotspot" in row[5]
+
+
+def test_daemon_sampler_profiles_spawned_thread():
+    conf.update(profile_enabled=True, profile_sample_ms=2)
+    stop = threading.Event()
+
+    def busy_hotspot():
+        with trace.context(query_id="qLoop", stage_id="s1"):
+            while not stop.is_set():
+                sum(i * i for i in range(200))
+
+    t = threading.Thread(target=busy_hotspot, daemon=True)
+    t.start()
+    profiler.ensure_started()
+    try:
+        deadline = time.time() + 5.0
+        while time.time() < deadline and not profiler.rows("qLoop"):
+            time.sleep(0.01)
+    finally:
+        stop.set()
+        t.join(5.0)
+        profiler.stop()
+    qrows = profiler.rows("qLoop")
+    assert qrows, "daemon sampler never attributed the busy thread"
+    assert any("busy_hotspot" in r[5] for r in qrows)
+
+
+def test_sampler_prunes_dead_thread_contexts():
+    conf.update(profile_enabled=True)
+    trace._live_ctx[999_999_002] = {"query_id": "qDead"}
+    profiler.sample_once(frames={999_999_003: sys._getframe()})
+    assert 999_999_002 not in trace._live_ctx
+
+
+def test_profile_max_frames_bounds_depth():
+    conf.update(profile_enabled=True, profile_max_frames=2)
+
+    def deep(n):
+        if n:
+            return deep(n - 1)
+        return profiler.sample_once(
+            frames={999_999_004: sys._getframe()})
+
+    assert deep(10) == 1
+    (row,) = profiler.rows()
+    assert len(row[5].split(";")) == 2
+
+
+def test_table_bounded_overflow_counts_dropped(monkeypatch):
+    monkeypatch.setattr(profiler, "_MAX_ENTRIES", 2)
+    _merge([["q1", "", "s1", "", "a.x", 3],
+            ["q1", "", "s1", "", "b.y", 2],
+            ["q1", "", "s1", "", "c.z", 4]])
+    st = profiler.stats()
+    assert st["stacks"] == 2
+    assert st["dropped"] == 4
+    _merge([["q1", "", "s1", "", "a.x", 1]])  # existing key still folds
+    assert profiler.stats()["dropped"] == 4
+
+
+# ---------------------------------------------------------------------------
+# federation: drain (executor) / merge (driver)
+# ---------------------------------------------------------------------------
+
+
+def test_drain_remote_moves_counts_accumulators_stay():
+    conf.update(profile_enabled=True)
+    profiler.sample_once(frames={999_999_005: sys._getframe()})
+    before = profiler.stats()["samples"]
+    rows = profiler.drain_remote()
+    assert rows and rows[0][5] >= 1
+    assert profiler.drain_remote() == []          # counts moved
+    assert profiler.rows() == []
+    assert profiler.stats()["samples"] == before  # accumulator stayed
+
+
+def test_merge_remote_stamps_exec_and_recovered():
+    assert _merge([["q1", "t1", "s1", "s1-t0", "a.x;b.y", 5]],
+                  exec_id="ex-7") == 5
+    assert _merge([["q1", "t1", "s1", "s1-t0", "a.x;b.y", 3]],
+                  exec_id="ex-7", recovered=True) == 3
+    (row,) = profiler.rows("q1")
+    assert row[4] == "ex-7"
+    assert row[6] == 8                            # same key folds
+    st = profiler.stats()
+    assert st["remote_samples"] == 8
+    assert st["recovered_samples"] == 3
+
+
+def test_duty_ledger_accumulates_and_gates_overhead():
+    conf.update(profile_enabled=True)
+    st = profiler.stats()
+    assert st["duty_pct"] == 0.0 and st["fleet_duty_pct"] == 0.0
+    t = profiler.ensure_started()
+    assert t is not None
+    deadline = time.time() + 2.0
+    while profiler.stats()["duty_wall_s"] == 0.0 and time.time() < deadline:
+        time.sleep(0.01)
+    st = profiler.stats()
+    assert st["duty_wall_s"] > 0.0
+    # the always-on contract: sampling duty stays around ~1%
+    assert st["duty_pct"] < 5.0
+
+
+def test_merge_duty_federates_and_rejects_torn_payloads():
+    profiler.merge_duty({"cost_s": 0.02, "wall_s": 2.0})
+    profiler.merge_duty({"cost_s": 0.01, "wall_s": 2.0})
+    profiler.merge_duty({"cost_s": "bogus"})     # torn: dropped
+    profiler.merge_duty(None)                    # torn: dropped
+    profiler.merge_duty({"cost_s": 0.0, "wall_s": 0.0})  # empty: no-op
+    st = profiler.stats()
+    # no local sampler wall -> fleet view is the 0.03/4.0 remote ledger
+    assert st["fleet_duty_pct"] == pytest.approx(0.75, abs=0.01)
+    profiler.reset()
+    assert profiler.stats()["fleet_duty_pct"] == 0.0
+
+
+def test_duty_snapshot_watermark_semantics():
+    c0, w0 = profiler.duty_snapshot()
+    assert c0 == 0.0 and w0 == 0.0
+    conf.update(profile_enabled=True)
+    profiler.ensure_started()
+    deadline = time.time() + 2.0
+    while profiler.duty_snapshot()[1] == 0.0 and time.time() < deadline:
+        time.sleep(0.01)
+    c1, w1 = profiler.duty_snapshot()
+    assert w1 > 0.0
+    time.sleep(0.06)
+    c2, w2 = profiler.duty_snapshot()
+    assert w2 >= w1 and c2 >= c1  # cumulative, never resets mid-run
+
+
+def test_merge_remote_skips_torn_rows():
+    merged = _merge([
+        ["q1", "", "s1", "", "a.x", 2],
+        ["q1", "", "s1"],                         # short: torn
+        ["q1", "", "s1", "", "b.y", "NaN-ish"],   # bad count
+        ["q1", "", "s1", "", "", 9],              # empty stack
+        ["q1", "", "s1", "", "c.z", 0],           # non-positive
+    ], exec_id="ex-1")
+    assert merged == 2
+    assert len(profiler.rows("q1")) == 1
+
+
+# ---------------------------------------------------------------------------
+# views + export formats
+# ---------------------------------------------------------------------------
+
+
+def test_collapsed_lines_carry_attribution_prefix():
+    _merge([["q9", "tA", "s2", "s2-t1", "mod.a;mod.b", 5]],
+            exec_id="ex-3")
+    _merge([["", "", "", "", "idle.loop", 2]])
+    lines = profiler.collapsed()
+    assert "query:q9;stage:s2;exec:ex-3;mod.a;mod.b 5" in lines
+    assert "query:-;idle.loop 2" in lines
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    from tools import blaze_prof
+
+    pairs = blaze_prof.parse_collapsed("\n".join(lines))
+    assert sorted(n for _, n in pairs) == [2, 5]
+
+
+def test_speedscope_document_is_valid():
+    _merge([["q1", "", "s1", "", "a.x;b.y", 3],
+            ["q1", "", "s2", "", "a.x;c.z", 2]])
+    doc = profiler.speedscope("q1")
+    assert doc["$schema"].endswith("file-format-schema.json")
+    frames = doc["shared"]["frames"]
+    (prof,) = doc["profiles"]
+    assert prof["type"] == "sampled"
+    assert len(prof["samples"]) == len(prof["weights"]) == 2
+    assert prof["endValue"] == sum(prof["weights"]) == 5
+    for ixs in prof["samples"]:
+        assert all(0 <= i < len(frames) for i in ixs)
+    # shared frame table dedups across stacks (a.x appears once)
+    names = [f["name"] for f in frames]
+    assert names.count("a.x") == 1
+    json.dumps(doc)  # serializable
+
+
+def test_stacks_to_speedscope_pure_converter():
+    doc = profiler.stacks_to_speedscope(
+        [("a;b", 4), ("a;c", 1)], name="unit")
+    assert doc["name"] == "unit"
+    assert doc["profiles"][0]["weights"] == [4, 1]
+    assert len(doc["shared"]["frames"]) == 3
+
+
+def test_hot_frames_rank_leaf_self_time():
+    _merge([["q1", "", "s1", "", "a.x;b.y", 3],
+            ["q1", "", "s2", "", "c.z;b.y", 2],
+            ["q1", "", "s1", "", "a.x;d.w", 1]])
+    hot = profiler.hot_frames("q1")
+    assert hot[0] == {"frame": "b.y", "samples": 5, "pct": 83.3}
+    assert hot[1]["frame"] == "d.w"
+
+
+def test_window_shape_and_bounds():
+    _merge([["qW", "t1", "s1", "s1-t0", "a.x;b.y", 7],
+            ["qW", "t1", "s2", "", "c.z", 1]], exec_id="ex-2")
+    win = profiler.window("qW", max_stacks=1)
+    assert win["query_id"] == "qW"
+    assert win["samples"] == 8
+    assert win["sample_ms"] == int(conf.profile_sample_ms)
+    assert len(win["stacks"]) == 1                # bounded, hottest first
+    assert win["stacks"][0] == {
+        "stage_id": "s1", "task_id": "s1-t0", "exec": "ex-2",
+        "stack": "a.x;b.y", "samples": 7}
+    assert win["hot_frames"][0]["frame"] == "b.y"
+    assert profiler.window("no-such-query") is None
+
+
+def test_profile_summary_evidence():
+    assert profiler.profile_summary("qS") is None
+    _merge([["qS", "", "s1", "", "a.x;hot.leaf", 9]])
+    s = profiler.profile_summary("qS")
+    assert s["samples"] == 9
+    assert s["hot_frames"][0]["frame"] == "hot.leaf"
+
+
+def test_export_query_writes_collapsed_and_speedscope(tmp_path):
+    conf.update(profile_enabled=True,
+                profile_export_dir=str(tmp_path / "prof"))
+    _merge([["qE", "", "s1", "", "a.x;b.y", 4]])
+    paths = profiler.export_query("qE")
+    with open(paths["collapsed"], encoding="utf-8") as f:
+        assert "query:qE;stage:s1;a.x;b.y 4" in f.read()
+    with open(paths["speedscope"], encoding="utf-8") as f:
+        doc = json.load(f)
+    assert doc["profiles"][0]["endValue"] == 4
+    assert profiler.export_query("never-ran") is None
+
+
+def test_export_query_without_dir_is_a_noop():
+    conf.update(profile_export_dir="")
+    _merge([["qE", "", "s1", "", "a.x", 1]])
+    assert profiler.export_query("qE") is None
+
+
+# ---------------------------------------------------------------------------
+# doctor + dossier + explain_analyze integration
+# ---------------------------------------------------------------------------
+
+
+def _host_bound_record(profile):
+    rec = {"schema_version": trace.SCHEMA_VERSION, "query_id": "qD",
+           "tenant_id": "t1", "admission_outcome": "admitted",
+           "admission_wait_ms": 0.0, "duration_ms": 1000.0,
+           "stages": [], "resilience_events": {},
+           "counters": {"host_compute_ms": 600.0}}
+    if profile is not None:
+        rec["profile"] = profile
+    return rec
+
+
+def test_doctor_host_cpu_bound_needs_profile_evidence():
+    prof = {"samples": 50, "sample_ms": 10,
+            "hot_frames": [{"frame": "fused.chain", "samples": 40,
+                            "pct": 80.0}]}
+    findings = doctor.diagnose(_host_bound_record(prof))
+    (f,) = [f for f in findings if f.code == "host_cpu_bound"]
+    assert f.score == pytest.approx(0.6)
+    assert "fused.chain" in f.summary
+    assert "conf.profile_export_dir" in f.suggestion
+    assert f.evidence["hot_frames"][0]["frame"] == "fused.chain"
+    # the host_compute term alone (no profiler evidence) stays silent:
+    # the rule exists to NAME the code, not restate the term
+    codes = [f.code for f in doctor.diagnose(_host_bound_record(None))]
+    assert "host_cpu_bound" not in codes
+
+
+def test_flight_dossier_embeds_profile_window(tmp_path):
+    conf.update(flight_dir=str(tmp_path), flight_triggers="all",
+                profile_enabled=True)
+    _merge([["qF", "", "s1", "", "a.x;b.y", 6]])
+    path = flight_recorder.capture("hang", "qF")
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    win = doc["profile_window"]
+    assert win["query_id"] == "qF" and win["samples"] == 6
+    # exactly-once per (query, trigger) rides the existing dedup
+    assert flight_recorder.capture("hang", "qF") is None
+
+
+def test_flight_dossier_profile_window_none_when_disabled(tmp_path):
+    conf.update(flight_dir=str(tmp_path), flight_triggers="all",
+                profile_enabled=False)
+    path = flight_recorder.capture("deadline", "qF2")
+    with open(path, encoding="utf-8") as f:
+        assert json.load(f)["profile_window"] is None
+
+
+def test_explain_analyze_renders_hot_frames():
+    conf.update(profile_enabled=True, trace_enabled=True)
+    _merge([["qX", "", "s1", "", "a.x;hot.leaf", 5]])
+    from blaze_tpu.columnar import types as T
+    from blaze_tpu.ops.basic import MemorySourceExec
+
+    root = MemorySourceExec([], T.Schema([T.Field("x", T.INT64)]))
+    out = trace.explain_analyze(root, None)
+    assert "-- hot frames --" in out
+    assert "hot.leaf" in out
+    conf.update(profile_enabled=False)
+    assert "-- hot frames --" not in trace.explain_analyze(root, None)
+
+
+# ---------------------------------------------------------------------------
+# registry conformance
+# ---------------------------------------------------------------------------
+
+
+def test_event_kinds_registered():
+    assert "profile_export" in trace.EVENT_KINDS
+    assert "profile_merge" in trace.EVENT_KINDS
+
+
+def test_prometheus_gauges_registered_and_emitted():
+    for name in ("blaze_profile_samples_total",
+                 "blaze_profile_remote_samples_total",
+                 "blaze_profile_recovered_samples_total",
+                 "blaze_profile_stacks",
+                 "blaze_profile_dropped_total",
+                 "blaze_profile_duty_pct",
+                 "blaze_profile_fleet_duty_pct"):
+        assert name in monitor.GAUGE_NAMES
+    _merge([["q1", "", "s1", "", "a.x", 2]], exec_id="e1",
+           recovered=True)
+    text = monitor.prometheus_text()
+    assert "blaze_profile_remote_samples_total 2" in text
+    assert "blaze_profile_recovered_samples_total 2" in text
+    assert "blaze_profile_stacks 1" in text
+
+
+def test_merge_emits_profile_merge_event():
+    conf.update(trace_enabled=True)
+    with trace.context(query_id="qEv"):
+        _merge([["qEv", "", "s1", "", "a.x", 2]], exec_id="ex-9",
+               recovered=True)
+    evs = [r for r in trace.query_records("qEv")
+           if r.get("type") == "event"
+           and r.get("kind") == "profile_merge"]
+    assert evs and evs[0]["attrs"]["exec"] == "ex-9"
+    assert evs[0]["attrs"]["recovered"] is True

@@ -3,6 +3,8 @@ execution vs pandas (the local-mode analog of the reference's TPC-DS CI,
 SURVEY.md §4.2).
 """
 
+import os
+
 import numpy as np
 import pandas as pd
 import pyarrow as pa
@@ -386,3 +388,45 @@ def test_parquet_insert_multi_task_part_files(tables, tmp_path):
     got = dict(zip(written["item"], written["s"]))
     for k, v in want.items():
         np.testing.assert_allclose(got[int(k)], v, rtol=1e-9)
+
+    # overwrite semantics: a re-run into the same path drops the prior
+    # run's parts (including any higher-numbered strays), and the clear
+    # happens driver-side before dispatch — so it can never race task
+    # scheduling and delete the current run's own finished parts
+    stray = os.path.join(out_dir, "part-00099.parquet")
+    with open(stray, "wb") as f:
+        f.write(b"stale")
+    sc = P.scan(SS_SCHEMA, [(ss_path, [])])
+    partial = P.hash_agg(sc, "partial", [ir.col("ss_item_sk")], ["item"],
+                         [{"fn": "sum", "args": [ir.col("ss_ext_sales_price")],
+                           "dtype": T.FLOAT64, "name": "s"}],
+                         T.Schema([T.Field("item", T.INT64)]))
+    x = P.shuffle_exchange(partial, [ir.col("item")], 4)
+    final = P.hash_agg(x, "final", [ir.col("ss_item_sk")], ["item"],
+                       [{"fn": "sum", "args": [ir.col("ss_ext_sales_price")],
+                         "dtype": T.FLOAT64, "name": "s"}],
+                       T.Schema([T.Field("item", T.INT64),
+                                 T.Field("s", T.FLOAT64)]))
+    run_plan(P.parquet_insert(final, out_dir), num_partitions=4)
+    assert not os.path.exists(stray)
+    rerun = pq2.read_table(out_dir).to_pandas()
+    assert len(rerun) == len(want)
+
+
+def test_parquet_sink_task_path_never_clears_parts(tmp_path):
+    """A late-scheduled partition-0 task must not delete parts other
+    tasks of the same run already wrote (the old in-task clear raced
+    exactly that way)."""
+    from blaze_tpu.ops.base import ExecContext
+    from blaze_tpu.ops.parquet import ParquetSinkExec
+
+    out_dir = tmp_path / "sink_out"
+    out_dir.mkdir()
+    done = out_dir / "part-00003.parquet"
+    done.write_bytes(b"committed by task 3")
+    sink = ParquetSinkExec.__new__(ParquetSinkExec)
+    sink.path = str(out_dir)
+    sink.fs_resource_id = None
+    p0 = sink._task_path(ExecContext(partition=0, num_partitions=4))
+    assert p0.endswith("part-00000.parquet")
+    assert done.read_bytes() == b"committed by task 3"

@@ -1,8 +1,9 @@
 """Profiling hooks (trace.profiled_span / trace.metric_report): jax
 profiler traces + the metric report (SURVEY §5.4 — the reference
 surfaces per-op metrics in the Spark UI; we additionally capture XLA
-device timelines). runtime/tracing.py survives only as a deprecation
-shim; the alias test below pins its names to the trace.py pathway."""
+device timelines). The legacy runtime/tracing.py deprecation shim is
+retired: trace.py is the one import path (the continuous sampling
+profiler lives separately in runtime/profiler.py)."""
 
 import os
 
@@ -14,8 +15,7 @@ from blaze_tpu.config import conf
 from blaze_tpu.exprs import ir
 from blaze_tpu.ops.basic import FilterExec, MemorySourceExec
 from blaze_tpu.runtime.executor import collect
-from blaze_tpu.runtime.trace import metric_report
-from blaze_tpu.runtime.tracing import profiled_scope  # legacy shim path
+from blaze_tpu.runtime.trace import metric_report, profiled_span
 
 
 def test_profiler_trace_written(tmp_path, rng):
@@ -23,7 +23,7 @@ def test_profiler_trace_written(tmp_path, rng):
     old = conf.profiler_dir
     conf.profiler_dir = prof
     try:
-        with profiled_scope("test"):
+        with profiled_span("test"):
             import jax.numpy as jnp
 
             np.asarray(jnp.arange(16) * 2)
@@ -35,33 +35,31 @@ def test_profiler_trace_written(tmp_path, rng):
     assert found, "profiler must write trace files"
 
 
-def test_profiled_scope_noop_without_profiler_dir():
+def test_profiled_span_noop_without_profiler_dir():
     """conf.profiler_dir unset: the scope must be a plain passthrough —
     no jax.profiler session, no files, body still runs."""
     old = conf.profiler_dir
     conf.profiler_dir = ""
     try:
         ran = []
-        with profiled_scope("noop"):
+        with profiled_span("noop"):
             ran.append(1)
         assert ran == [1]
     finally:
         conf.profiler_dir = old
 
 
-def test_profiled_scope_is_the_trace_span_pathway():
-    """The legacy name is an alias of trace.profiled_span — one
-    instrumentation pathway; with tracing on, the block lands in the
-    ring as a "profile" span carrying the scope name."""
-    from blaze_tpu.runtime import trace, tracing
+def test_profiled_span_records_profile_span():
+    """With tracing on, the block lands in the ring as a "profile"
+    span carrying the scope name — the one instrumentation pathway
+    (the old tracing.py alias module is gone)."""
+    from blaze_tpu.runtime import trace
 
-    assert profiled_scope is trace.profiled_span
-    assert tracing.metric_report is trace.metric_report
     saved = conf.trace_enabled
     conf.trace_enabled = True
     trace.reset()
     try:
-        with profiled_scope("legacy-alias"):
+        with profiled_span("legacy-alias"):
             pass
         (rec,) = trace.TRACE.snapshot()
         assert rec["kind"] == "profile"

@@ -129,6 +129,17 @@ def render(metrics: dict, source: str) -> str:
             f"rollbacks={rollbacks}"
             + (f" [{by_knob}]" if by_knob else "")
             + ("  ** ROLLED BACK **" if rollbacks else ""))
+    if "blaze_profile_samples_total" in metrics:
+        p_dropped = int(g("blaze_profile_dropped_total"))
+        lines.append(
+            f"profile  samples={int(g('blaze_profile_samples_total'))} "
+            f"remote={int(g('blaze_profile_remote_samples_total'))} "
+            f"recovered="
+            f"{int(g('blaze_profile_recovered_samples_total'))} "
+            f"stacks={int(g('blaze_profile_stacks'))} "
+            f"duty={g('blaze_profile_fleet_duty_pct'):.2f}%"
+            + (f"  ** {p_dropped} SAMPLES DROPPED **" if p_dropped
+               else ""))
     exec_rows = [(k, v) for k, v in metrics.items()
                  if k.startswith("blaze_executor_up{")]
     if exec_rows:

@@ -42,6 +42,9 @@ RECORD_FUNCS: Dict[str, Tuple[Set[str], Tuple[str, ...]]] = {
                 ("history_dir", "history")),
     "faults": ({"inject"}, ("fault_injection_spec",)),
     "progress": ({"on_batch"}, ("progress_enabled", "progress")),
+    "profiler": ({"ensure_started", "sample_once", "merge_remote",
+                  "export_query"},
+                 ("profile_enabled",)),
 }
 
 
