@@ -9,7 +9,8 @@ PYENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
 .PHONY: check check-fast check-faults check-supervisor check-trace \
 	check-durability check-dist-obs check-network check-elastic \
-	check-streaming check-autopilot check-profile check-pipeline \
+	check-streaming check-autopilot check-profile check-zerocopy \
+	check-pipeline \
 	check-pipeline-soak \
 	check-perf \
 	check-perf-update check-obs check-history check-lint check-service \
@@ -19,7 +20,7 @@ PYENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 check: check-lint test validate check-perf check-history check-service \
 	check-doctor check-flight check-executors check-durability \
 	check-dist-obs check-network check-elastic check-streaming \
-	check-autopilot check-profile
+	check-autopilot check-profile check-zerocopy
 	@echo "CHECK OK — safe to commit"
 
 # Static invariant gate (tools/blazelint): lock discipline, knob
@@ -250,6 +251,15 @@ check-autopilot:
 check-profile:
 	$(PYENV) python tools/chaos_soak.py --profile \
 	  --json-out PROFILE_r23.json
+
+# Zero-copy data-plane acceptance (tools/zerocopy_bench.py): same-host
+# mmap shuffle A/B on the real server/client (latency collapse +
+# moved-only booking), the q3 catalogue query on a live pool (mmap
+# on/off, oracle-equal, copied-bytes drop), and a 2M-row string-heavy
+# dict-encoding A/B against the pandas oracle. Emits ZEROCOPY_r24.json.
+check-zerocopy:
+	$(PYENV) python tools/zerocopy_bench.py \
+	  --json-out ZEROCOPY_r24.json
 
 # Pre-warm the persistent compile caches (runtime/compile_service):
 # replays the shape manifest + the TPC-DS catalogue into the XLA cache.

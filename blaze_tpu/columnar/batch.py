@@ -86,6 +86,66 @@ class StringData:
         return cls(*children)
 
 
+def bucket_dict_rows(k: int) -> int:
+    """Round dictionary entry count up to a power-of-two bucket (min 8).
+
+    Dictionaries are small by construction (dict_max_cardinality caps
+    them), so they get their own bucket ladder instead of min_capacity —
+    padding a 12-entry dict to 1024 rows would erase the encoding win.
+    """
+    cap = 8
+    while cap < k:
+        cap <<= 1
+    return cap
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class DictData:
+    """Dictionary-encoded string/binary storage: per-row int32 codes into
+    a small (dict_capacity, width) uint8 dictionary.
+
+    INVARIANT: dictionary entry 0 is ALWAYS the empty string (all-zero
+    row, length 0). Encoders must guarantee it; `Column.normalized` and
+    padding rows rely on it to null-out a row by pointing its code at 0.
+
+    The lazy `bytes`/`lengths` properties expand to the StringData layout
+    via an in-jit gather, so every existing `.data.bytes`/`.data.lengths`
+    call site (hash, compare, sort keys) works on the encoded form
+    without a host round-trip."""
+
+    codes: Array         # int32 (capacity,)
+    dict_bytes: Array    # uint8 (dict_capacity, width)
+    dict_lengths: Array  # int32 (dict_capacity,)
+
+    @property
+    def capacity(self) -> int:
+        return self.codes.shape[0]
+
+    @property
+    def width(self) -> int:
+        return self.dict_bytes.shape[1]
+
+    @property
+    def dict_capacity(self) -> int:
+        return self.dict_bytes.shape[0]
+
+    @property
+    def bytes(self) -> Array:
+        return self.dict_bytes[self.codes]
+
+    @property
+    def lengths(self) -> Array:
+        return self.dict_lengths[self.codes]
+
+    def tree_flatten(self):
+        return (self.codes, self.dict_bytes, self.dict_lengths), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class ListData:
@@ -139,18 +199,23 @@ class StructData:
 @dataclasses.dataclass
 class Column:
     dtype: DataType
-    data: Union[Array, StringData, ListData, StructData]
+    data: Union[Array, StringData, DictData, ListData, StructData]
     validity: Optional[Array] = None  # bool (capacity,); None = all valid
 
     @property
     def capacity(self) -> int:
-        if isinstance(self.data, (StringData, ListData, StructData)):
+        if isinstance(self.data, (StringData, DictData, ListData,
+                                  StructData)):
             return self.data.capacity
         return self.data.shape[0]
 
     @property
     def is_string(self) -> bool:
-        return isinstance(self.data, StringData)
+        return isinstance(self.data, (StringData, DictData))
+
+    @property
+    def is_dict(self) -> bool:
+        return isinstance(self.data, DictData)
 
     @property
     def is_list(self) -> bool:
@@ -174,6 +239,14 @@ class Column:
             return Column(self.dtype, StructData(planes), v)
         if self.validity is None or self.is_list or self.is_struct:
             return self
+        if self.is_dict:
+            # dict entry 0 is the empty string (DictData invariant), so
+            # nulling a row is a code rewrite — the dictionary itself
+            # stays shared and untouched
+            v = self.validity
+            codes = jnp.where(v, self.data.codes, jnp.int32(0))
+            return Column(self.dtype, DictData(
+                codes, self.data.dict_bytes, self.data.dict_lengths), v)
         if self.is_string:
             v = self.validity
             b = jnp.where(v[:, None], self.data.bytes, jnp.uint8(0))
@@ -195,6 +268,11 @@ class Column:
             data = _list_take(self.data, idx)
         elif self.is_struct:
             data = StructData([ch.take(idx) for ch in self.data.children])
+        elif self.is_dict:
+            # gather codes only — the column stays encoded through
+            # filter/sort/join/limit; the dictionary is shared as-is
+            data = DictData(self.data.codes[idx], self.data.dict_bytes,
+                            self.data.dict_lengths)
         elif self.is_string:
             data = StringData(self.data.bytes[idx], self.data.lengths[idx])
         else:
@@ -353,7 +431,17 @@ class ColumnBatch:
                         if valid[i] else None for i in range(n)]
                 out[f.name] = vals
                 continue
-            if c.is_string:
+            if c.is_dict:
+                # decode at the result-merge edge: pull codes + the small
+                # dictionary, expand host-side (never materializes the
+                # (n, W) matrix on device)
+                codes = np.asarray(c.data.codes)[:n]
+                db = np.asarray(c.data.dict_bytes)
+                dl = np.asarray(c.data.dict_lengths)
+                vals = [bytes(db[codes[i], : dl[codes[i]]]) if valid[i]
+                        else None for i in range(n)]
+                out[f.name] = vals
+            elif c.is_string:
                 b = np.asarray(c.data.bytes)[:n]
                 l = np.asarray(c.data.lengths)[:n]
                 vals = [bytes(b[i, : l[i]]) if valid[i] else None for i in range(n)]
@@ -384,6 +472,9 @@ def _col_shape_key(c: Column) -> tuple:
                 _col_shape_key(c.data.elements), c.validity is not None)
     if c.is_struct:
         return ("t", tuple(_col_shape_key(ch) for ch in c.data.children),
+                c.validity is not None)
+    if c.is_dict:
+        return ("d", c.data.width, c.data.dict_capacity,
                 c.validity is not None)
     if c.is_string:
         return ("s", c.data.width, c.validity is not None)

@@ -19,7 +19,16 @@ Colblock:
   u8 has_validity | [ceil(n/8) bytes packed validity (LSB-first)]
   numeric/bool: n * itemsize raw LE values
   string/binary: u32 total | n x u32 lengths | concatenated bytes
+  string/binary (dict): u32 0xFFFFFFFF | u32 K | u32 dict_total |
+                        K x u32 dict_lengths | dict bytes | n x u32 codes
   null column: nothing
+
+The dict form (conf.dict_encode_strings) writes each distinct string once
+plus per-row int32 codes; 0xFFFFFFFF is an impossible plain `total` (a
+frame is capped well below 4 GiB) so old frames decode unchanged. Code 0
+is ALWAYS the empty string (the DictData invariant). A slice whose
+cardinality exceeds conf.dict_max_cardinality, or where the dict form is
+not smaller, falls back to the plain layout per column.
 """
 
 from __future__ import annotations
@@ -65,17 +74,21 @@ from blaze_tpu.config import conf
 from blaze_tpu.runtime import faults, monitor
 
 MAGIC = b"BTB1"
+DICT_SENTINEL = 0xFFFFFFFF  # impossible plain string `total` (frames < 2 GiB)
 
 
 @dataclasses.dataclass
 class _HostCol:
-    kind: str                      # "num" | "str" | "list" | "struct" | "null"
-    data: Optional[np.ndarray]     # (n,) values | (n, W) bytes | None
-    lengths: Optional[np.ndarray]  # strings/lists: per-row lengths
+    kind: str   # "num" | "str" | "dict" | "list" | "struct" | "null"
+    data: Optional[np.ndarray]     # (n,) values | (n, W) bytes | None;
+                                   # dict: (K, W) dictionary bytes
+    lengths: Optional[np.ndarray]  # strings/lists: per-row lengths;
+                                   # dict: (K,) dictionary entry lengths
     validity: Optional[np.ndarray]
     child: Optional["_HostCol"] = None        # lists: element column
     child_offsets: Optional[np.ndarray] = None  # lists: (n+1,) elem offsets
     children: Optional[List["_HostCol"]] = None  # structs: field columns
+    codes: Optional[np.ndarray] = None  # dict: (n,) int32 codes
 
 
 @dataclasses.dataclass
@@ -111,6 +124,42 @@ class HostBatch:
         return frame
 
 
+def _dict_encode_slice(b: np.ndarray, lens: np.ndarray):
+    """Distinct strings of a slice -> (dict (K, W), dict_lens (K,),
+    codes (n,)) with entry 0 == empty string, or None past the
+    cardinality cap. Length is part of the uniqueness key: b"a\\x00"
+    and b"a" share canonical bytes but are different strings."""
+    n = int(lens.shape[0])
+    w = int(b.shape[1]) if b.ndim == 2 else 0
+    pos = np.arange(w)[None, :] < lens[:, None]
+    canon = np.where(pos, b, 0).astype(np.uint8, copy=False)
+    key = np.concatenate(
+        [canon, lens.astype("<u4")[:, None].view(np.uint8)], axis=1)
+    # prepend an all-zero row: it sorts first, pinning code 0 to the
+    # empty string (the DictData invariant normalized()/padding rely on)
+    key = np.vstack([np.zeros((1, w + 4), np.uint8), key])
+    uniq, inv = np.unique(key, axis=0, return_inverse=True)
+    if uniq.shape[0] - 1 > conf.dict_max_cardinality:
+        return None
+    dmat = np.ascontiguousarray(uniq[:, :w])
+    dlens = np.ascontiguousarray(uniq[:, w:]).view("<u4").reshape(-1)
+    return dmat, dlens, inv.reshape(-1)[1:].astype(np.uint32)
+
+
+def _write_dict_block(out, dmat: np.ndarray, dlens: np.ndarray,
+                      codes: np.ndarray) -> None:
+    dlens = dlens.astype(np.uint32)
+    out.write(struct.pack("<III", DICT_SENTINEL, dlens.shape[0],
+                          int(dlens.sum())))
+    out.write(dlens.tobytes())
+    if dmat.size:
+        pos = np.arange(dmat.shape[1])[None, :] < dlens[:, None]
+        out.write(np.ascontiguousarray(dmat)[pos].tobytes())
+    out.write(codes.astype(np.uint32).tobytes())
+    if conf.monitor_enabled:
+        monitor.count_zerocopy("dict_cols_encoded")
+
+
 def _write_col(out, c: _HostCol, lo: int, hi: int) -> None:
     has_v = c.validity is not None
     out.write(struct.pack("<B", 1 if has_v else 0))
@@ -119,9 +168,27 @@ def _write_col(out, c: _HostCol, lo: int, hi: int) -> None:
                               bitorder="little").tobytes())
     if c.kind == "null":
         return
+    if c.kind == "dict":
+        # already encoded: ship the dictionary + the slice's codes —
+        # never re-concatenate payload bytes per hop
+        _write_dict_block(out, c.data, c.lengths, c.codes[lo:hi])
+        return
     if c.kind == "str":
         lens = c.lengths[lo:hi].astype(np.uint32)
         total = int(lens.sum())
+        n = int(lens.shape[0])
+        if conf.dict_encode_strings and n:
+            enc = _dict_encode_slice(c.data[lo:hi], lens)
+            if enc is not None:
+                dmat, dlens, codes = enc
+                dict_sz = 12 + 4 * dlens.shape[0] + int(dlens.sum()) + 4 * n
+                if dict_sz < 4 + 4 * n + total:
+                    if conf.trace_enabled:
+                        from blaze_tpu.runtime import trace
+                        trace.event("dict_encode", rows=n,
+                                    entries=int(dlens.shape[0]))
+                    _write_dict_block(out, dmat, dlens, codes)
+                    return
         out.write(struct.pack("<I", total) + lens.tobytes())
         if total:
             b = c.data[lo:hi]
@@ -156,6 +223,14 @@ def _host_col(col, n: int) -> _HostCol:
         return _HostCol("struct", None, None, validity,
                         children=[_host_col(ch, n)
                                   for ch in col.data.children])
+    if col.is_dict:
+        # keep the encoded form: pull codes + the small dictionary only
+        # (the expanded matrix is never materialized on either side)
+        dd = col.data
+        return _HostCol("dict", np.asarray(dd.dict_bytes),
+                        np.asarray(dd.dict_lengths).astype(np.int32),
+                        validity,
+                        codes=np.asarray(dd.codes)[:n].astype(np.int32))
     if col.is_string:
         return _HostCol("str", np.asarray(col.data.bytes)[:n],
                         np.asarray(col.data.lengths)[:n], validity)
@@ -167,7 +242,7 @@ def _host_col(col, n: int) -> _HostCol:
 
 def _col_nbytes(c: _HostCol) -> int:
     n = 0
-    for arr in (c.data, c.lengths, c.validity, c.child_offsets):
+    for arr in (c.data, c.lengths, c.validity, c.child_offsets, c.codes):
         if arr is not None:
             n += arr.nbytes
     if c.child is not None:
@@ -204,8 +279,13 @@ def serialize_slice(hb: HostBatch, lo: int, hi: int) -> bytes:
     identical payload bytes, one fewer python loop on the shuffle path."""
     from blaze_tpu import native
 
-    if native.available() and all(c.kind in ("num", "str", "null")
-                                  for c in hb.cols):
+    # the C++ encoder predates the dict colblock: route string columns
+    # through the python encoder while dict encoding is on so they ship
+    # (dict, codes) instead of plain payload bytes
+    dict_strings = conf.dict_encode_strings and any(
+        c.kind in ("str", "dict") for c in hb.cols)
+    if native.available() and not dict_strings and \
+            all(c.kind in ("num", "str", "null") for c in hb.cols):
         t0 = time.perf_counter_ns()
         if conf.fault_injection_spec:
             faults.inject("serde.encode")
@@ -316,10 +396,31 @@ def read_batch_host(fp: BinaryIO, schema: Schema,
     return hb
 
 
-def deserialize_batch_host(buf: bytes, schema: Schema) -> HostBatch:
-    hb = read_batch_host(io.BytesIO(buf), schema)
-    if hb is None:
+def deserialize_batch_host(buf, schema: Schema) -> HostBatch:
+    """Decode one frame held in memory. Accepts bytes OR a zero-copy
+    memoryview (the mmap shuffle fast path): decompression reads
+    straight from the caller's buffer, so a mapped frame is never
+    duplicated host-side before the (inherent) decompress."""
+    t0 = time.perf_counter_ns()
+    if conf.fault_injection_spec:
+        faults.inject("serde.decode")
+    mv = memoryview(buf)
+    if len(mv) == 0:
         raise ValueError("empty batch frame")
+    if len(mv) < 12 or mv[:4] != MAGIC:
+        raise ValueError("bad batch frame header")
+    raw_len, comp_len = struct.unpack("<II", mv[4:12])
+    raw = zstandard.ZstdDecompressor().decompress(
+        mv[12:12 + comp_len], max_output_size=raw_len)
+    if conf.monitor_enabled:
+        monitor.count_copy("serde", raw_len, moved=12 + comp_len)
+    bio = io.BytesIO(raw)
+    n, ncols = struct.unpack("<IH", _read_exact(bio, 6))
+    assert ncols == len(schema.fields), (ncols, len(schema.fields))
+    hb = HostBatch(schema, [_decode_col_host(bio, f.dtype, n)
+                            for f in schema], n)
+    if conf.monitor_enabled:
+        monitor.count_time("serde_decode", time.perf_counter_ns() - t0)
     return hb
 
 
@@ -330,6 +431,24 @@ def read_batches_host(fp: BinaryIO, schema: Schema) -> Iterator[HostBatch]:
         if hb is None:
             return
         yield hb
+
+
+def _read_dict_block(fp: BinaryIO, n: int):
+    """Read a dict colblock body (after the sentinel) -> host-form
+    (dict (K, w), dict_lens int32 (K,), codes int32 (n,))."""
+    K, dict_total = struct.unpack("<II", _read_exact(fp, 8))
+    dlens = np.frombuffer(_read_exact(fp, 4 * K), np.uint32)
+    payload = np.frombuffer(_read_exact(fp, dict_total), np.uint8)
+    w = max(int(dlens.max()) if K else 1, 1)
+    dmat = np.zeros((K, w), np.uint8)
+    if K:
+        pos = np.arange(w)[None, :] < dlens[:, None]
+        dmat[pos] = payload
+    codes = np.frombuffer(_read_exact(fp, 4 * n), np.uint32).astype(np.int32)
+    if conf.trace_enabled:
+        from blaze_tpu.runtime import trace
+        trace.event("dict_decode", rows=n, entries=K)
+    return dmat, dlens.astype(np.int32), codes
 
 
 def _decode_col_host(fp: BinaryIO, dtype, n: int) -> _HostCol:
@@ -354,6 +473,9 @@ def _decode_col_host(fp: BinaryIO, dtype, n: int) -> _HostCol:
         return _HostCol("struct", None, None, validity, children=children)
     if dtype.is_string_like:
         (total,) = struct.unpack("<I", _read_exact(fp, 4))
+        if total == DICT_SENTINEL:
+            dmat, dlens, codes = _read_dict_block(fp, n)
+            return _HostCol("dict", dmat, dlens, validity, codes=codes)
         lens = np.frombuffer(_read_exact(fp, 4 * n), np.uint32)
         payload = np.frombuffer(_read_exact(fp, total), np.uint8)
         w = max(int(lens.max()) if n else 1, 1)
@@ -410,6 +532,25 @@ def _decode_col(fp: BinaryIO, dtype, n: int, cap: int):
                       _pad_validity(validity_np, n, cap))
     if dtype.is_string_like:
         (total,) = struct.unpack("<I", _read_exact(fp, 4))
+        if total == DICT_SENTINEL:
+            from blaze_tpu.columnar.batch import DictData, bucket_dict_rows
+
+            dmat, dlens, codes_np = _read_dict_block(fp, n)
+            K = dmat.shape[0]
+            w = bucket_width(int(dlens.max()) if K else 1)
+            kcap = bucket_dict_rows(max(K, 1))
+            dict_b = np.zeros((kcap, w), np.uint8)
+            dict_l = np.zeros((kcap,), np.int32)
+            dict_b[:K, :dmat.shape[1]] = dmat
+            dict_l[:K] = dlens
+            # padding codes stay 0 -> empty string (the invariant)
+            codes = np.zeros((cap,), np.int32)
+            codes[:n] = codes_np
+            col = Column(dtype, DictData(jnp.asarray(codes),
+                                         jnp.asarray(dict_b),
+                                         jnp.asarray(dict_l)),
+                         _pad_validity(validity_np, n, cap))
+            return col.normalized() if validity_np is not None else col
         lens = np.frombuffer(_read_exact(fp, 4 * n), np.uint32)
         payload = np.frombuffer(_read_exact(fp, total), np.uint8)
         w = bucket_width(int(lens.max()) if n else 1)

@@ -84,7 +84,11 @@ class Knob:
         else:
             nxt = current + self.step * direction
         nxt = sorted((self.min, nxt, self.max))[1]
-        if self.type is int:
+        if self.type is bool:
+            # validate_overlay is strict on bool knobs — a proposed 0/1
+            # int would be rejected at apply time
+            nxt = bool(round(nxt))
+        elif self.type is int:
             nxt = int(round(nxt))
         return None if nxt == current else nxt
 
@@ -482,6 +486,33 @@ _DECLARATIONS: Tuple[Knob, ...] = (
              "budget: fetches retry with exponential backoff within this "
              "window instead of blocking forever on a hung shuffle "
              "server. 0 = legacy blocking socket with one reconnect."),
+
+    # -- zero-copy data plane (shuffle mmap + dictionary strings) --
+    Knob("shuffle_mmap_enabled", True,
+         doc="Same-host shuffle fast path: when the committed "
+             ".data/.index pair for a fetched rid is host-local, the "
+             "ShuffleClient mmaps the .data file read-only and slices "
+             "partition segments as zero-copy memoryviews (booked as "
+             "bytes_moved only), verifying per-frame CRC32 lazily on "
+             "first touch; a mismatch falls back to the BCS2 socket "
+             "fetch whose server-side read quarantines + lineage-"
+             "repairs. Off = every pooled fetch streams over the "
+             "socket.",
+         step=1, min=0, max=1),
+    Knob("dict_encode_strings", True,
+         doc="Dictionary-encode string columns in serde frames: ship "
+             "(dict, codes) once and keep filter/join/groupby on i32 "
+             "codes, decoding only at the result-merge edge. Columns "
+             "whose slice cardinality exceeds dict_max_cardinality (or "
+             "where the dict form is not smaller) fall back to plain "
+             "length-prefixed encoding per column.",
+         step=1, min=0, max=1),
+    Knob("dict_max_cardinality", 64 << 10,
+         doc="Distinct-value ceiling for dictionary-encoded string "
+             "columns: a serde slice with more unique strings than this "
+             "is written in plain form (the dict no longer pays for "
+             "itself and the code gather stops being cache-friendly).",
+         step=2.0, min=256, max=1 << 20, geometric=True),
 
     # -- elastic fleet & driver HA (runtime/autoscaler.py,
     # -- runtime/standby.py) --

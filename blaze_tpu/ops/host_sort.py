@@ -116,6 +116,14 @@ def _value_parts(c: _HostCol, kind: TypeKind, wide: bool,
                 _be(lo.view(np.uint64))]
     if kind in (TypeKind.STRING, TypeKind.BINARY):
         w = DEFAULT_MAX_STRING_WORDS * 8
+        if c.kind == "dict":
+            # build the prefix plane on the K dictionary entries, then
+            # gather per-row by code — O(K) byte work instead of O(n)
+            K, dw = c.data.shape
+            dp = np.zeros((K, w), np.uint8)
+            dp[:, :min(w, dw)] = c.data[:, :w]
+            return [dp[c.codes],
+                    _be(c.lengths[c.codes].astype(np.uint32))]
         b = c.data
         if b.shape[1] >= w:
             prefix = np.ascontiguousarray(b[:, :w])
@@ -202,6 +210,9 @@ def _col_take(c: _HostCol, idx: np.ndarray) -> _HostCol:
     if c.kind == "struct":
         return _HostCol("struct", None, None, v,
                         children=[_col_take(ch, idx) for ch in c.children])
+    if c.kind == "dict":
+        # gather codes only; the dictionary is shared untouched
+        return _HostCol("dict", c.data, c.lengths, v, codes=c.codes[idx])
     if c.kind == "str":
         return _HostCol("str", c.data[idx], c.lengths[idx], v)
     return _HostCol("num", c.data[idx], None, v)
@@ -227,7 +238,33 @@ def _col_concat(parts: List[_HostCol], kind: str) -> _HostCol:
                                 parts[0].children[i].kind)
                     for i in range(nch)]
         return _HostCol("struct", None, None, v, children=children)
-    if kind == "str":
+    if kind in ("str", "dict"):
+        tot_entries = sum(p.data.shape[0] for p in parts
+                          if p.kind == "dict")
+        tot_rows = sum(_host_len(p) for p in parts)
+        if all(p.kind == "dict" for p in parts) and \
+                tot_entries <= max(tot_rows, 8):
+            # merge dictionaries by offsetting codes: part 0's entry 0
+            # (the empty string) keeps the code-0 invariant for the
+            # merged dict; cross-part duplicate entries are harmless.
+            # Past tot_rows entries (many merge rounds accumulating
+            # dupes) the dict stops paying — expand instead.
+            w = max(p.data.shape[1] for p in parts)
+            dicts, dlens, codes, base = [], [], [], 0
+            for p in parts:
+                m = p.data
+                if m.shape[1] < w:
+                    mm = np.zeros((m.shape[0], w), np.uint8)
+                    mm[:, :m.shape[1]] = m
+                    m = mm
+                dicts.append(m)
+                dlens.append(p.lengths)
+                codes.append(p.codes + np.int32(base))
+                base += m.shape[0]
+            return _HostCol("dict", np.concatenate(dicts),
+                            np.concatenate(dlens), v,
+                            codes=np.concatenate(codes))
+        parts = [_dict_expand(p) for p in parts]
         w = max(p.data.shape[1] for p in parts)
         mats = []
         for p in parts:
@@ -242,7 +279,16 @@ def _col_concat(parts: List[_HostCol], kind: str) -> _HostCol:
     return _HostCol("num", np.concatenate([p.data for p in parts]), None, v)
 
 
+def _dict_expand(c: _HostCol) -> _HostCol:
+    """Decode a dict host col to the plain (n, W) string layout."""
+    if c.kind != "dict":
+        return c
+    return _HostCol("str", c.data[c.codes], c.lengths[c.codes], c.validity)
+
+
 def _host_len(c: _HostCol) -> int:
+    if c.kind == "dict":
+        return len(c.codes)
     if c.kind == "str":
         return len(c.lengths)
     if c.kind == "struct":
@@ -281,6 +327,22 @@ def _upload_col(c: _HostCol, f, n: int, cap: int):
         children = [_upload_col(ch, sf, n, cap)
                     for ch, sf in zip(c.children, fields)]
         return Column(dtype, StructData(children), validity)
+    if c.kind == "dict":
+        from blaze_tpu.columnar.batch import DictData, bucket_dict_rows
+
+        K = c.data.shape[0]
+        w = bucket_width(max(int(c.lengths.max()) if K else 1, 1))
+        kcap = bucket_dict_rows(max(K, 1))
+        db = np.zeros((kcap, w), np.uint8)
+        cw = min(w, c.data.shape[1])
+        db[:K, :cw] = c.data[:, :cw]
+        dl = np.zeros((kcap,), np.int32)
+        dl[:K] = c.lengths
+        codes = np.zeros((cap,), np.int32)
+        codes[:n] = c.codes
+        col = Column(dtype, DictData(jnp.asarray(codes), jnp.asarray(db),
+                                     jnp.asarray(dl)), validity)
+        return col.normalized() if validity is not None else col
     if c.kind == "str":
         w = bucket_width(max(int(c.lengths.max()) if n else 1, 1))
         mat = np.zeros((cap, w), np.uint8)
@@ -332,7 +394,9 @@ def host_nbytes(hb: HostBatch) -> int:
 
 def _col_nbytes_host(c: _HostCol) -> int:
     n = 0
-    if c.kind == "str":
+    if c.kind == "dict":
+        n += c.data.size + 4 * len(c.lengths) + 4 * len(c.codes)
+    elif c.kind == "str":
         n += c.data.size + 4 * len(c.lengths)
     elif c.kind == "struct":
         n += sum(_col_nbytes_host(ch) for ch in c.children)
@@ -448,6 +512,11 @@ def host_to_pylike(hb: HostBatch):
                 Schema([sf]), [ch], n))[sf.name]
                 for sf, ch in zip(f.dtype.fields, c.children)]
             out[f.name] = [tuple(s[i] for s in subs) if valid[i] else None
+                           for i in range(n)]
+            continue
+        if c.kind == "dict":
+            b, l, cd = c.data, c.lengths, c.codes
+            out[f.name] = [bytes(b[cd[i], :l[cd[i]]]) if valid[i] else None
                            for i in range(n)]
             continue
         if c.kind == "str":

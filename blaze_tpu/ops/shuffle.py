@@ -560,11 +560,14 @@ class IpcReaderExec(Operator):
                     elif isinstance(seg, serde.HostBatch):
                         absorb(seg)
                     elif isinstance(seg, (bytes, bytearray, memoryview)):
+                        # no bytes(seg): a memoryview from the mmap
+                        # shuffle path decodes straight from the mapped
+                        # file (serde reads it via the buffer protocol)
                         if hsup:
                             absorb(serde.deserialize_batch_host(
-                                bytes(seg), self._schema))
+                                seg, self._schema))
                         else:
-                            yield serde.deserialize_batch(bytes(seg),
+                            yield serde.deserialize_batch(seg,
                                                           self._schema)
                     else:  # file-like
                         if hsup:

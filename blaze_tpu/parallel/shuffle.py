@@ -94,7 +94,9 @@ def staged_all_to_all(batch: ColumnBatch, pid: Array, axis_name: str,
 
     cols = []
     for c in staged.columns:
-        if isinstance(c.data, StringData):
+        if c.is_string:
+            # covers DictData too: its lazy bytes/lengths expand in-jit,
+            # since per-device dictionaries cannot ride all_to_all
             data = StringData(exchange(c.data.bytes), exchange(c.data.lengths))
         else:
             # row-aligned storages (dense arrays, wide-decimal limb-plane

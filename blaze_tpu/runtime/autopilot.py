@@ -57,7 +57,9 @@ ACTUATORS = (
     "autoscale_max",
     "control_reconnect_backoff_ms",
     "dense_agg_range",
+    "dict_encode_strings",
     "prefetch_batches",
+    "shuffle_mmap_enabled",
     "target_batch_bytes",
     "telemetry_ship_ms",
 )

@@ -841,6 +841,7 @@ class ExecutorPool:
                                 offset_ns=handle.clock_offset_ns,
                                 truncated=truncated)
         monitor.merge_remote(msg.get("counters") or {})
+        monitor.merge_zerocopy(msg.get("zerocopy") or {})
         trace.ingest_histograms(msg.get("histograms") or {})
         if conf.profile_enabled and (msg.get("profile")
                                      or msg.get("profile_duty")):
@@ -1643,6 +1644,7 @@ class _Worker:
         self._tel_seq = 0
         self._tel_pending: List[dict] = []
         self._tel_counters: Dict[str, dict] = {}
+        self._tel_zerocopy: Dict[str, int] = {}
         self._tel_hists: Dict[str, dict] = {}
         self._tel_profile: List[list] = []
         self._tel_profile_last = 0.0  # last profiler drain (monotonic)
@@ -1801,6 +1803,8 @@ class _Worker:
             self._tel_pending.extend(trace.TRACE.drain())
             _merge_counter_deltas(self._tel_counters,
                                   monitor.drain_remote_deltas())
+            for k, v in monitor.drain_zerocopy().items():
+                self._tel_zerocopy[k] = self._tel_zerocopy.get(k, 0) + v
             _merge_hist_snaps(self._tel_hists,
                               trace.histograms_snapshot(reset=True))
             if conf.profile_enabled:
@@ -1817,12 +1821,14 @@ class _Worker:
                     self._tel_profile.extend(profiler.drain_remote())
                     self._tel_profile_last = now
             if not (self._tel_pending or self._tel_counters
-                    or self._tel_hists or self._tel_profile):
+                    or self._tel_zerocopy or self._tel_hists
+                    or self._tel_profile):
                 return
             seq = self._tel_seq + 1
             doc = {"type": "telemetry", "seq": seq,
                    "records": self._tel_pending,
                    "counters": self._tel_counters,
+                   "zerocopy": self._tel_zerocopy,
                    "histograms": self._tel_hists,
                    "profile": self._tel_profile,
                    "dropped": trace.TRACE.dropped,
@@ -1854,6 +1860,7 @@ class _Worker:
             self._tel_seq = seq
             self._tel_pending = []
             self._tel_counters = {}
+            self._tel_zerocopy = {}
             self._tel_hists = {}
             self._tel_profile = []
 
@@ -1917,13 +1924,30 @@ class _Worker:
         node.shuffle_writer.index_file = index_path
         client = self.shuffle_client()
         rids = list(payload.get("rids") or [])
+        rid_parts = dict(payload.get("rid_parts") or {})
 
         def make_provider(rid):
             # exactly one positional param: _call_provider passes the
             # task partition to 1-arg providers (a default-arg closure
             # would be miscounted as 2-arg and handed num_partitions)
+            if rid.endswith(":all"):
+                # build-side whole-relation read: chain every partition
+                # of the base rid (count shipped in the payload — the
+                # server registers outputs under the base rid only)
+                base = rid[:-len(":all")]
+                nparts = int(rid_parts.get(rid, 0))
+
+                def provider(partition):
+                    for p in range(nparts):
+                        for frame in client.fetch_frames(base, p):
+                            yield frame
+                return provider
+
             def provider(partition):
-                return iter(ss.split_frames(client.fetch(rid, partition)))
+                # fetch_frames prefers the same-host zero-copy mmap path
+                # (memoryview slices of the committed .data file) and
+                # falls back to the socket stream transparently
+                return iter(client.fetch_frames(rid, partition))
             return provider
 
         for rid in rids:

@@ -536,10 +536,17 @@ def batch_nbytes(batch: ColumnBatch) -> int:
 
 
 def _col_nbytes(c) -> int:
-    from blaze_tpu.columnar.batch import ListData, StringData, StructData
+    from blaze_tpu.columnar.batch import (
+        DictData, ListData, StringData, StructData,
+    )
 
     n = 0
-    if isinstance(c.data, StringData):
+    if isinstance(c.data, DictData):
+        # encoded resident form: codes + the small dictionary (NOT the
+        # expanded (capacity, width) matrix — that is the point)
+        n += (4 * c.data.codes.shape[0] + c.data.dict_bytes.size
+              + 4 * c.data.dict_lengths.shape[0])
+    elif isinstance(c.data, StringData):
         n += c.data.bytes.size + 4 * c.data.lengths.shape[0]
     elif isinstance(c.data, ListData):
         n += 4 * c.data.offsets.shape[0] + _col_nbytes(c.data.elements)

@@ -17,7 +17,11 @@ sampler, and the exporters that make the numbers visible.
                           host_sort.host_to_device upload) and the
                           native-ABI result payload (native_entry)
                 shuffle   partition-split frames pushed into the
-                          writer state / RSS writer (ops/shuffle.py)
+                          writer state / RSS writer (ops/shuffle.py),
+                          plus reader-side fetches — one entry per
+                          logical transfer: a socket stream is a copy,
+                          a same-host mmap hit books moved-only
+                          (shuffle_server.fetch_frames)
                 spill     SpillFile write + re-read (runtime/memory.py)
                 fallback  row-interpreter Arrow export (spark/fallback)
               Counts accumulate process-wide AND per query/stage: the
@@ -78,7 +82,7 @@ class _QueryAcct:
 
     __slots__ = ("qid", "copied", "moved", "stage_copied", "stage_moved",
                  "t0", "spilled0", "spill_count0", "compile0",
-                 "time_ns", "stage_time_ns")
+                 "time_ns", "stage_time_ns", "zc0")
 
     def __init__(self, qid: str) -> None:
         self.qid = qid
@@ -94,6 +98,10 @@ class _QueryAcct:
         # path category, query-level and per stage
         self.time_ns: Dict[str, int] = {}
         self.stage_time_ns: Dict[Any, Dict[str, int]] = {}
+        # zero-copy event watermark: query_end reports the delta, so the
+        # run record carries mmap/dict evidence (lock-free snapshot —
+        # constructors run both with and without _lock held)
+        self.zc0 = {k: _zerocopy.get(k, 0) for k in ZEROCOPY_KEYS}
 
 
 # -- copy/byte accounting ----------------------------------------------------
@@ -176,6 +184,59 @@ def copy_totals() -> Tuple[Dict[str, int], Dict[str, int]]:
         return dict(_copied), dict(_moved)
 
 
+# -- zero-copy event accounting ----------------------------------------------
+
+# event counters for the zero-copy data plane: how often the cheap path
+# actually ran (byte volumes live in _copied/_moved under "shuffle")
+ZEROCOPY_KEYS = ("shuffle_mmap_hits", "shuffle_mmap_fallbacks",
+                 "dict_cols_encoded")
+_zerocopy: Dict[str, int] = {k: 0 for k in ZEROCOPY_KEYS}
+# executor-side ship watermark (drain ships disjoint deltas, like
+# drain_remote_deltas does for the per-query accumulators)
+_zerocopy_shipped: Dict[str, int] = {k: 0 for k in ZEROCOPY_KEYS}
+
+
+def count_zerocopy(key: str, n: int = 1) -> None:
+    """Count one zero-copy data-plane event: a same-host mmap shuffle
+    fetch served without streaming ("shuffle_mmap_hits"), a mmap attempt
+    that fell back to the socket ("shuffle_mmap_fallbacks"), or a string
+    column shipped dictionary-encoded ("dict_cols_encoded"). Call sites
+    gate on conf.monitor_enabled; self-gated too for safety."""
+    if not conf.monitor_enabled:
+        return
+    with _lock:
+        _zerocopy[key] = _zerocopy.get(key, 0) + int(n)
+
+
+def zerocopy_stats() -> Dict[str, int]:
+    """Process-lifetime zero-copy event counters."""
+    with _lock:
+        return {k: _zerocopy.get(k, 0) for k in ZEROCOPY_KEYS}
+
+
+def drain_zerocopy() -> Dict[str, int]:
+    """Executor-side: zero-copy counter deltas since the last drain
+    (empty when nothing new) — shipped in telemetry frames next to the
+    per-query deltas and folded in driver-side by merge_zerocopy."""
+    out: Dict[str, int] = {}
+    with _lock:
+        for k in ZEROCOPY_KEYS:
+            d = _zerocopy.get(k, 0) - _zerocopy_shipped.get(k, 0)
+            if d:
+                out[k] = d
+                _zerocopy_shipped[k] = _zerocopy.get(k, 0)
+    return out
+
+
+def merge_zerocopy(deltas: Dict[str, int]) -> None:
+    """Driver-side ingest of executor zero-copy deltas."""
+    if not deltas or not conf.monitor_enabled:
+        return
+    with _lock:
+        for k, n in deltas.items():
+            _zerocopy[k] = _zerocopy.get(k, 0) + int(n)
+
+
 def leaks_total() -> int:
     with _lock:
         return _leaks_total
@@ -189,6 +250,10 @@ def reset() -> None:
             _copied[b] = 0
         for b in list(_moved):
             _moved[b] = 0
+        for k in list(_zerocopy):
+            _zerocopy[k] = 0
+        for k in list(_zerocopy_shipped):
+            _zerocopy_shipped[k] = 0
         _queries.clear()
         _active_qid = None
         _leaks_total = 0
@@ -327,6 +392,14 @@ def query_end(qid: str, manager=None) -> Dict[str, int]:
         moved_total += m
     roll["bytes_copied_total"] = copied_total
     roll["bytes_moved_total"] = moved_total
+    # zero-copy event deltas over the query's lifetime (process-global
+    # counters diffed against the begin_query watermark: concurrent
+    # queries share the plane, so treat these as attribution, not an
+    # exact ledger — the doctor's serde_bound evidence reads them)
+    with _lock:
+        zc_now = {k: _zerocopy.get(k, 0) for k in ZEROCOPY_KEYS}
+    for k in ZEROCOPY_KEYS:
+        roll[k] = max(zc_now.get(k, 0) - acct.zc0.get(k, 0), 0)
     if manager is not None:
         roll["peak_mem_bytes"] = max(manager.observe_peak(),
                                      manager.peak_used)
@@ -593,6 +666,9 @@ GAUGE_NAMES = (
     "blaze_executor_reconnects_total",
     "blaze_executor_drains_total",
     "blaze_shuffle_conn_dropped_total",
+    "blaze_shuffle_mmap_hits_total",
+    "blaze_shuffle_mmap_fallbacks_total",
+    "blaze_dict_cols_encoded_total",
     "blaze_service_capacity",
     "blaze_artifact_corruptions_total",
     "blaze_recovered_queries_total",
@@ -665,6 +741,17 @@ def prometheus_text() -> str:
     emit("blaze_resource_leaks_total", "counter",
          "Queries that ended with leaked streams/reservations/consumers",
          [({}, leaks_total())])
+
+    zc = zerocopy_stats()
+    emit("blaze_shuffle_mmap_hits_total", "counter",
+         "Same-host shuffle fetches served as zero-copy mmap views",
+         [({}, zc.get("shuffle_mmap_hits", 0))])
+    emit("blaze_shuffle_mmap_fallbacks_total", "counter",
+         "mmap shuffle fetch attempts that fell back to the socket path",
+         [({}, zc.get("shuffle_mmap_fallbacks", 0))])
+    emit("blaze_dict_cols_encoded_total", "counter",
+         "String columns shipped dictionary-encoded in serde frames",
+         [({}, zc.get("dict_cols_encoded", 0))])
 
     mgr = memory.get_manager()
     emit("blaze_mem_used_bytes", "gauge",

@@ -285,6 +285,32 @@ class ShuffleServer:
                     if "mid-frame" in str(e):
                         self._conn_dropped("eof_mid_frame")
                     return
+                if msg.get("type") == "locate":
+                    # publish the committed artifact paths for a shuffle
+                    # rid so a same-host client can mmap the .data files
+                    # instead of streaming segments over the socket.
+                    # Redirects are resolved HERE: quarantine/repair
+                    # state lives in this (driver) process, so clients
+                    # re-locating after a checksum fallback see the
+                    # repaired pair, not the quarantined one.
+                    rid = msg.get("rid", "")
+                    echo = {k: msg[k] for k in ("req",) if k in msg}
+                    with self._lock:
+                        outputs = self._shuffles.get(rid)
+                    if outputs is None:
+                        # broadcast frame lists have no file backing;
+                        # unknown rids are equally non-mappable
+                        send_msg(conn, {"ok": False, "rid": rid,
+                                        "error": f"not file-backed: {rid}",
+                                        **echo})
+                        continue
+                    from blaze_tpu.runtime import artifacts
+
+                    resolved = [list(artifacts.resolve_artifact(d, i))
+                                for d, i in outputs]
+                    send_msg(conn, {"ok": True, "rid": rid,
+                                    "outputs": resolved, **echo})
+                    continue
                 if msg.get("type") != "fetch":
                     send_msg(conn, {"ok": False,
                                     "error": "unknown request type"})
@@ -351,6 +377,11 @@ class ShuffleClient:
         # stale reply (net.* dup chaos, a retry racing its first answer)
         # is discarded instead of being matched to the wrong request
         self._req = 0
+        # rid -> same-host mmap fast-path state: a list of per-output
+        # dicts (buf/offsets/frames/seen, see _map_one), or None caching
+        # a negative answer (broadcast rid, legacy index without frame
+        # checksums, paths not visible from this process)
+        self._maps: Dict[str, Optional[List[dict]]] = {}
 
     @staticmethod
     def _timeout_ms() -> float:
@@ -438,9 +469,185 @@ class ShuffleClient:
             raise KeyError(msg.get("error", f"fetch failed: {rid}"))
         return blob
 
+    # -- same-host mmap fast path -------------------------------------
+
+    def _locate_locked(self, rid: str) -> Optional[List[Tuple[str, str]]]:
+        """Ask the server for rid's committed (data, index) paths.
+        None when the rid is not file-backed (broadcast frame list) or
+        the server predates the locate message (it replies ok=False
+        "unknown request type" without a req echo — accepted here the
+        same way fetch accepts echo-less replies from old servers)."""
+        sock = self._ensure_locked()
+        self._req += 1
+        req = self._req
+        send_msg(sock, {"type": "locate", "rid": rid, "req": req})
+        while True:
+            msg, _blob = recv_msg(sock)
+            got = msg.get("req")
+            if got is None or got == req:
+                break
+            if got > req:
+                raise WireError(f"reply for future request {got} > {req}")
+        if not msg.get("ok"):
+            return None
+        return [(str(d), str(i)) for d, i in msg.get("outputs") or []]
+
+    @staticmethod
+    def _map_one(data_path: str, index_path: str) -> Optional[dict]:
+        """mmap one committed output read-only. None when the pair is
+        not visible from this process or the index carries no per-frame
+        checksums (legacy commit): lazy verification is then impossible
+        and the socket path — which verifies whole segments server-side
+        — stays authoritative."""
+        import mmap as _mmap
+
+        from blaze_tpu.runtime import artifacts
+
+        if not (os.path.exists(data_path) and os.path.exists(index_path)):
+            return None
+        offsets_bytes, meta = artifacts.read_index(index_path)
+        if not meta or not meta.get("frames"):
+            return None
+        n = len(offsets_bytes) // 8
+        offsets = struct.unpack("<%dQ" % n, offsets_bytes[: 8 * n])
+        with open(data_path, "rb") as f:
+            size = os.fstat(f.fileno()).st_size
+            buf = (_mmap.mmap(f.fileno(), 0, prot=_mmap.PROT_READ)
+                   if size else b"")
+        return {"buf": buf, "offsets": offsets,
+                "frames": dict(meta["frames"]), "seen": set()}
+
+    @staticmethod
+    def _slice_frames(state: dict,
+                      partition: int) -> Optional[List[memoryview]]:
+        """Zero-copy frame views for one partition of a mapped output,
+        verifying each frame's committed CRC32 on FIRST touch only
+        (`seen` remembers verified frame offsets). None on any
+        discrepancy — truncated mapping, unindexed frame boundary,
+        checksum mismatch — so the caller falls back to the socket path
+        where fetch_segment quarantines + lineage-repairs the pair."""
+        offsets = state["offsets"]
+        if partition + 1 >= len(offsets):
+            return None
+        lo, hi = offsets[partition], offsets[partition + 1]
+        buf = state["buf"]
+        if hi > len(buf) or lo > hi:
+            return None
+        view = memoryview(buf)
+        frames: List[memoryview] = []
+        off = lo
+        while off < hi:
+            if off + 12 > hi:
+                return None
+            (comp_len,) = struct.unpack_from("<I", buf, off + 8)
+            end = off + 12 + comp_len
+            if end > hi:
+                return None
+            if off not in state["seen"]:
+                want = state["frames"].get(off)
+                if want is None:
+                    return None
+                if zlib.crc32(view[off:end]) & 0xFFFFFFFF != want:
+                    return None
+                state["seen"].add(off)
+            frames.append(view[off:end])
+            off = end
+        return frames
+
+    def _mmap_fetch(self, rid: str, partition: int):
+        """Returns (frames, nbytes, status) with status one of "hit"
+        (zero-copy views returned), "miss" (rid is not mmap-eligible —
+        broadcast, legacy index, remote paths; cached so later fetches
+        skip the locate round-trip), "fallback" (mapping was live but
+        verification failed: the cache is dropped so the next fetch
+        re-locates, picking up any repaired redirect)."""
+        with self._lock:
+            if rid not in self._maps:
+                outputs = self._locate_locked(rid)
+                if outputs is None:
+                    self._maps[rid] = None
+                    return None, 0, "miss"
+                states: Optional[List[dict]] = []
+                for d, i in outputs:
+                    st = self._map_one(d, i)
+                    if st is None:
+                        states = None
+                        break
+                    states.append(st)
+                self._maps[rid] = states
+                if states is None:
+                    return None, 0, "fallback"
+            states = self._maps[rid]
+            if states is None:
+                return None, 0, "miss"
+            frames: List[memoryview] = []
+            nbytes = 0
+            for st in states:
+                part = self._slice_frames(st, partition)
+                if part is None:
+                    self._maps.pop(rid, None)
+                    return None, 0, "fallback"
+                frames.extend(part)
+                nbytes += sum(len(f) for f in part)
+            return frames, nbytes, "hit"
+
+    def fetch_frames(self, rid: str, partition: int) -> List:
+        """One partition's serde frames (memoryview on the mmap path,
+        bytes on the socket path), preferring the same-host
+        zero-copy path: when the server's committed .data/.index pair is
+        visible from this process, the data file is mmap'd read-only and
+        partition segments come back as memoryview slices — no socket
+        streaming, no blob copy — with per-frame CRC32s verified lazily
+        on first touch. Any discrepancy falls back to the socket fetch,
+        whose server-side fetch_segment runs the existing quarantine +
+        lineage-repair protocol; a later fetch_frames re-locates and
+        maps the repaired pair. Bookkeeping is single-entry per logical
+        transfer: a mmap hit books moved bytes only (nothing was
+        copied), the socket path books copied bytes reader-side."""
+        from blaze_tpu.config import conf
+
+        status = "miss"
+        if conf.shuffle_mmap_enabled:
+            try:
+                frames, nbytes, status = self._mmap_fetch(rid, partition)
+            except (ConnectionError, OSError, ValueError, struct.error):
+                # locate/map plumbing failure: the socket retry ladder
+                # below owns reconnection; treat as a fallback
+                frames, status = None, "fallback"
+                self._drop_maps(rid)
+            if frames is not None:
+                from blaze_tpu.runtime import monitor
+
+                if conf.monitor_enabled:
+                    monitor.count_move("shuffle", nbytes)
+                    monitor.count_zerocopy("shuffle_mmap_hits")
+                if conf.trace_enabled:
+                    from blaze_tpu.runtime import trace
+
+                    trace.event("shuffle_mmap_fetch", rid=rid,
+                                partition=partition, nbytes=nbytes,
+                                frames=len(frames))
+                return frames
+        blob = self.fetch(rid, partition)
+        from blaze_tpu.runtime import monitor
+
+        if conf.monitor_enabled:
+            monitor.count_copy("shuffle", len(blob))
+            if status == "fallback":
+                monitor.count_zerocopy("shuffle_mmap_fallbacks")
+        return split_frames(blob)
+
+    def _drop_maps(self, rid: Optional[str] = None) -> None:
+        with self._lock:
+            if rid is None:
+                self._maps.clear()
+            else:
+                self._maps.pop(rid, None)
+
     def close(self) -> None:
         with self._lock:
             self._close_locked()
+            self._maps.clear()
 
 
 def split_frames(blob: bytes) -> List[bytes]:

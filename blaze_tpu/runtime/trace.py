@@ -212,6 +212,10 @@ EVENT_KINDS = (
     "deadline_exceeded",    # executor: task/query budget exhausted
     "deadline_kill",        # supervisor: budget exhausted mid-attempt
     "degrade",              # executor: resilience-ladder rung taken
+    "dict_decode",          # serde: dictionary string column expanded
+                            # at the result-merge edge
+    "dict_encode",          # serde: string column shipped as
+                            # (dictionary, codes) instead of raw bytes
     "driver_failover",      # standby: warm standby fenced the dead
                             # primary's lease and took over the fleet
     "driver_recovery",      # journal: recovery scan replayed a journal
@@ -253,6 +257,8 @@ EVENT_KINDS = (
                             # arrivals / SLO burn / utilization)
     "shuffle_conn_dropped", # shuffle_server: client connection dropped
                             # mid-request (reset/torn frame/CRC mismatch)
+    "shuffle_mmap_fetch",   # shuffle_server client: partition served as
+                            # zero-copy mmap views (no socket stream)
     "slo_burn",             # service: tenant SLO budget burning hot
     "speculation_launch",   # supervisor: straggler twin launched
     "speculation_loss",     # supervisor: attempt lost the commit race

@@ -740,11 +740,12 @@ def _resume_shuffle_stage(stage: Stage, stages: List[Stage], shuffle_mgr,
 def _pool_stage_rids(stage: Stage) -> Optional[List[str]]:
     """Reader resource ids of a shuffle-map stage when EVERY one is
     servable to executor processes over the driver's shuffle server
-    (committed shuffle partitions, broadcast frame lists). None marks the
-    stage pool-ineligible — it needs driver-local state a worker process
+    (committed shuffle partitions — including `:all` build-side reads,
+    which workers reassemble by fetching every partition of the base
+    rid, mmap-first — and broadcast frame lists). None marks the stage
+    pool-ineligible — it needs driver-local state a worker process
     cannot reach (FFI export iterators, UDF eval callbacks, RSS/sink
-    consumers, fs providers, or an `:all` reader whose provider exists
-    only in the driver registry) — and it runs in-process instead."""
+    consumers, fs providers) — and it runs in-process instead."""
     rids: List[str] = []
     servable = True
 
@@ -757,8 +758,7 @@ def _pool_stage_rids(stage: Stage) -> Optional[List[str]]:
                     walk(v)
             elif fd.name == "provider_resource_id":
                 local = local_resource_id(val)
-                if ((local.startswith("shuffle:")
-                     and not local.endswith(":all"))
+                if (local.startswith("shuffle:")
                         or local.startswith("broadcast:")):
                     rids.append(val)
                 else:
@@ -802,6 +802,14 @@ def _run_shuffle_stage_pooled(stage: Stage, stages: List[Stage],
     # counter attribution share the driver's query/stage/task ids (the
     # telemetry-federation join key)
     ctx = trace.current_context()
+    # `:all` build-side reads: the worker reassembles the whole relation
+    # by fetching every partition of the base rid (mmap-first), so ship
+    # each one's partition count — the only driver-local fact it needs
+    rid_parts = {}
+    for rid in rids:
+        local = local_resource_id(rid)
+        if local.startswith("shuffle:") and local.endswith(":all"):
+            rid_parts[rid] = stages[int(local.split(":")[1])].num_partitions
     specs: List[executor_pool.PoolTaskSpec] = []
     slots = []
     for task in range(ntasks):
@@ -814,7 +822,7 @@ def _run_shuffle_stage_pooled(stage: Stage, stages: List[Stage],
             key=f"{ns}shuffle:{stage.stage_id}:{task}",
             kind="plan",
             payload={"partition": task, "num_partitions": ntasks,
-                     "rids": rids,
+                     "rids": rids, "rid_parts": rid_parts,
                      "query_id": ctx.get("query_id"),
                      "tenant_id": ctx.get("tenant_id"),
                      "stage_id": stage.stage_id,

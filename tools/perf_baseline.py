@@ -53,7 +53,11 @@ QUERIES = [  # same catalogue as chaos_soak/trace_report
 # gate thresholds (see module docstring for the asymmetry rationale)
 TIME_RATIO = 2.5
 TIME_GRACE_S = 2.0
-COPY_RATIO = 1.25
+# tightened from 1.25 with the zero-copy plane (ISSUE 24): with mmap
+# shuffle reads booking moved-only and strings shipping dict-encoded,
+# baseline copy counts are lower AND steadier, so the gate can bite
+# harder before grace bytes absorb a regression
+COPY_RATIO = 1.15
 COPY_GRACE_BYTES = 64 << 10
 
 COPY_KEYS = ("bytes_copied_serde", "bytes_copied_ffi",
